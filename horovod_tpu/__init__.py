@@ -1,0 +1,104 @@
+"""horovod_tpu — a TPU-native distributed training framework with the
+capability surface of Horovod (reference: nateagr/horovod, a fork of
+horovod/horovod; see SURVEY.md).
+
+Design: SPMD over a `jax.sharding.Mesh` instead of an eager negotiation
+runtime.  Collectives are XLA programs over TPU ICI; the coordination
+thread, tensor queue, fusion buffer, and response cache of the reference
+become trace/compile-time constructs (see SURVEY.md §7).
+
+Canonical usage mirrors `import horovod.torch as hvd`:
+
+    import horovod_tpu as hvd
+    hvd.init()
+    ...
+    grads = hvd.allreduce(grads)           # eager, or inside jit
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+"""
+
+from .version import __version__
+
+from .common.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    size,
+    rank,
+    local_size,
+    local_rank,
+    cross_size,
+    cross_rank,
+    process_index,
+    num_processes,
+    local_device_ranks,
+    is_homogeneous,
+    global_mesh,
+    global_devices,
+    tpu_built,
+    xla_built,
+    mpi_built,
+    nccl_built,
+    gloo_built,
+    ccl_built,
+    mpi_threads_supported,
+    add_process_set,
+    remove_process_set,
+    get_process_set,
+    global_process_set,
+    ProcessSet,
+    GLOBAL_AXIS,
+)
+
+from .common.exceptions import (  # noqa: F401
+    HorovodTpuError,
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+
+from .ops.collectives import (  # noqa: F401
+    Average,
+    Sum,
+    Min,
+    Max,
+    Product,
+    Adasum,
+    PerRank,
+    allreduce,
+    allreduce_async,
+    grouped_allreduce,
+    allgather,
+    allgather_async,
+    grouped_allgather,
+    broadcast,
+    broadcast_async,
+    alltoall,
+    alltoall_async,
+    reducescatter,
+    grouped_reducescatter,
+    barrier,
+    join,
+    poll,
+    synchronize,
+)
+
+from .ops.compression import Compression  # noqa: F401
+
+from .ops.functions import (  # noqa: F401
+    broadcast_parameters,
+    broadcast_optimizer_state,
+    broadcast_object,
+    allgather_object,
+)
+
+from .parallel.optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    DistributedGradientTransformation,
+)
+
+from .parallel.data_parallel import (  # noqa: F401
+    data_parallel,
+    DistributedGradientTape,
+    shard_batch,
+)
+
+from . import elastic  # noqa: F401

@@ -1,0 +1,41 @@
+"""Exception types mirroring horovod/common/exceptions.py.
+
+HorovodInternalError / HostsUpdatedInterrupt drive the elastic
+commit/restore protocol (see horovod/common/elastic.py:run_fn).
+"""
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """A collective failed mid-flight; elastic training treats this as a
+    signal to restore state and re-initialize (reference:
+    horovod/common/exceptions.py)."""
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """Cluster membership changed; raised at a commit boundary so elastic
+    training can re-rendezvous without losing state."""
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    def __init__(self, what: str = "horovod_tpu"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+class TensorShapeMismatchError(HorovodTpuError):
+    """Shape/dtype mismatch across ranks (reference: message.cc response
+    construction errors)."""
+
+
+class DuplicateTensorNameError(HorovodTpuError):
+    """Same tensor name submitted twice in one step (reference:
+    controller.cc "Duplicate tensor name" semantic race detector)."""

@@ -1,0 +1,52 @@
+"""Small shared utilities: env parsing, caching, dtype helpers.
+
+Reference parity: horovod/common/utils/env_parser.cc (SetBoolFromEnv et al.)
+and horovod/common/util.py. On TPU these collapse into plain Python since
+there is no C env-parser boundary.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Env vars keep the HOROVOD_ prefix for drop-in familiarity.
+_ENV_PREFIXES = ("HOROVOD_", "HVD_TPU_")
+
+
+def getenv(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Look up NAME under every accepted prefix (HOROVOD_NAME wins)."""
+    for prefix in _ENV_PREFIXES:
+        val = os.environ.get(prefix + name)
+        if val is not None:
+            return val
+    return default
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    val = getenv(name)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_int(name: str, default: int) -> int:
+    val = getenv(name)
+    if val is None:
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    val = getenv(name)
+    if val is None:
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        return default
+
+

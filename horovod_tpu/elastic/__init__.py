@@ -1,0 +1,286 @@
+"""Elastic / fault-tolerant training: state commit-restore-sync protocol.
+
+Reference parity (SURVEY.md §2.5, §3.5):
+  - horovod/common/elastic.py (`run_fn`, `State`, `ObjectState`)
+      → `run`, `State`, `ObjectState`
+  - horovod/torch/elastic/state.py (`TorchState`)
+      → `TpuState` (pytree-based: params + optimizer state + scalars)
+  - horovod/torch/elastic/sampler.py (`ElasticSampler`)
+      → `ElasticSampler`
+
+Protocol (identical to reference): the training function is decorated with
+`@hvd.elastic.run` and receives a `State`.  `state.commit()` snapshots
+host-side; on `HorovodInternalError` (a collective failed) the wrapper
+restores the last commit, re-initializes the runtime over the new device
+set, and `state.sync()` re-broadcasts from the new rank 0; on
+`HostsUpdatedInterrupt` (membership changed at a commit boundary) it skips
+the rollback and just re-syncs.
+
+TPU-native note (SURVEY.md §7 hard-part #1): membership change means mesh
+change means recompile.  `_reset()` tears down the mesh and collective
+caches; recompilation happens lazily on the first post-reset step.  Slices
+are slice-granular: workers join/leave in whole-host units.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..common import basics
+from ..common.exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from ..ops import collectives as C
+from ..ops import functions as F
+
+logger = logging.getLogger("horovod_tpu.elastic")
+
+__all__ = [
+    "State", "ObjectState", "TpuState", "ElasticSampler", "run",
+    "notify_hosts_updated",
+]
+
+# Host-update notifications pushed by the elastic driver (or tests).
+_host_update_queue: "queue.Queue[bool]" = queue.Queue()
+
+
+def notify_hosts_updated(skip_sync: bool = False) -> None:
+    """Called by the worker-notification client when the driver reports a
+    membership change (reference: WorkerNotificationManager)."""
+    _host_update_queue.put(skip_sync)
+
+
+class State:
+    """Base state with commit/restore/sync (reference:
+    horovod/common/elastic.py `State`)."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks: List[Callable[[], None]] = []
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self) -> None:
+        pass
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt if the driver pushed an update."""
+        updated = False
+        skip_sync = False
+        while True:
+            try:
+                skip = _host_update_queue.get_nowait()
+                updated = True
+                skip_sync = skip_sync or skip
+            except queue.Empty:
+                break
+        if updated:
+            self.on_hosts_updated()
+            raise HostsUpdatedInterrupt(skip_sync)
+
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """State of arbitrary picklable attributes (reference:
+    horovod/common/elastic.py `ObjectState`)."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._known = list(kwargs.keys())
+        self.save()
+
+    def save(self) -> None:
+        self._saved = {k: copy.deepcopy(getattr(self, k))
+                       for k in self._known}
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        synced = F.broadcast_object(
+            {k: getattr(self, k) for k in self._known}, root_rank=0
+        )
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class TpuState(ObjectState):
+    """Model/optimizer state for elastic TPU training (reference:
+    TorchState / TensorFlowKerasState).
+
+    Pytree attributes (jax arrays) are snapshotted to host numpy on
+    `save()` (surviving a mesh teardown) and re-broadcast as device arrays
+    on `sync()`.
+    """
+
+    def __init__(self, params=None, opt_state=None, **scalars):
+        self.params = params
+        self.opt_state = opt_state
+        super().__init__(**scalars)
+        self._known = ["params", "opt_state"] + list(scalars.keys())
+        self.save()
+
+    def _to_host(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if hasattr(x, "shape") else x, tree
+        )
+
+    def save(self) -> None:
+        self._saved = {
+            "params": self._to_host(self.params),
+            "opt_state": self._to_host(self.opt_state),
+        }
+        for k in self._known:
+            if k not in ("params", "opt_state"):
+                self._saved[k] = copy.deepcopy(getattr(self, k))
+
+    def restore(self) -> None:
+        self.params = self._saved["params"]
+        self.opt_state = self._saved["opt_state"]
+        for k in self._known:
+            if k not in ("params", "opt_state"):
+                setattr(self, k, copy.deepcopy(self._saved[k]))
+
+    def sync(self) -> None:
+        # Broadcast arrays (fused) from the new rank 0, scalars via object
+        # broadcast.
+        self.params = F.broadcast_parameters(self.params, root_rank=0)
+        self.opt_state = F.broadcast_parameters(self.opt_state, root_rank=0)
+        scalars = {k: getattr(self, k) for k in self._known
+                   if k not in ("params", "opt_state")}
+        if scalars:
+            synced = F.broadcast_object(scalars, root_rank=0)
+            for k, v in synced.items():
+                setattr(self, k, v)
+        self.save()
+
+
+class ElasticSampler:
+    """Shard an index space over ranks, skipping processed indices after a
+    restore (reference: horovod/torch/elastic/sampler.py)."""
+
+    def __init__(self, num_samples: int, shuffle: bool = True, seed: int = 0):
+        self.num_samples = num_samples
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: List[int] = []
+        self._reset_index_list()
+
+    def _reset_index_list(self):
+        idx = np.arange(self.num_samples)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(idx)
+        processed = set(self.processed_indices)
+        remaining = [i for i in idx if i not in processed]
+        n, r = basics.size(), basics.rank()
+        per = len(remaining) // n if n else 0
+        self.local_indices = remaining[r * per:(r + 1) * per]
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+        self.processed_indices = []
+        self._reset_index_list()
+
+    def record_batch(self, batch_idx: int, batch_size: int):
+        start = batch_idx * batch_size
+        self.processed_indices.extend(
+            self.local_indices[start:start + batch_size]
+        )
+
+    def reset(self):
+        """After membership change: re-shard remaining indices."""
+        # All ranks need the union of processed indices.
+        all_processed = F.allgather_object(self.processed_indices)
+        merged = sorted({i for sub in all_processed for i in sub})
+        self.processed_indices = merged
+        self._reset_index_list()
+
+    def __iter__(self):
+        return iter(self.local_indices)
+
+    def __len__(self):
+        return len(self.local_indices)
+
+
+def _reset() -> None:
+    """Tear down and re-initialize the runtime over the current device set
+    (reference: elastic 'reset' = hvd.shutdown + hvd.init re-rendezvous)."""
+    basics.shutdown()
+    basics.init()
+
+
+def run(func: Callable) -> Callable:
+    """Decorator for elastic training (reference: horovod/common/elastic.py
+    `run_fn`):
+
+        @hvd.elastic.run
+        def train(state, ...): ...
+    """
+
+    def wrapper(state: State, *args, **kwargs):
+        notification_manager_init()
+        reset_required = False
+        skip_sync = False
+        while True:
+            if reset_required:
+                _reset()
+                state.on_reset()
+                if not skip_sync:
+                    state.sync()
+                reset_required = False
+                skip_sync = False
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                logger.warning("Collective failure — restoring last commit")
+                state.restore()
+                reset_required = True
+            except HostsUpdatedInterrupt as e:
+                logger.info("Hosts updated — re-initializing")
+                reset_required = True
+                skip_sync = e.skip_sync
+
+    return wrapper
+
+
+def notification_manager_init() -> None:
+    """Start listening for driver host-update pushes.  The in-process queue
+    is always active; the network listener is started by the runner's
+    worker client when HOROVOD_ELASTIC_NOTIFY_ADDR is set."""
+    try:
+        from ..runner.elastic_worker import maybe_start_notification_client
+
+        maybe_start_notification_client()
+    except ImportError:
+        pass

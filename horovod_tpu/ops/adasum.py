@@ -1,0 +1,142 @@
+"""Adasum: convergence-preserving gradient combination.
+
+Reference parity (SURVEY.md §2.2): horovod/common/ops/adasum/adasum.h
+(`Adasum::FusedPairwiseReduceWithComms`, `DispatchComputeDotAndNormSqrds`),
+adasum_mpi_operations.cc, adasum_gpu_operations.cc.
+
+The math: two gradients a, b are combined not by a + b but by
+
+    adasum(a, b) = (1 - a.b / (2 ||a||^2)) * a  +  (1 - a.b / (2 ||b||^2)) * b
+
+which subtracts the projection overlap so the effective learning rate does
+not grow with the number of workers.  Ranks combine pairwise in a binary
+tree: (0,1), (2,3), ... then the pair-results combine again, log2(n) levels
+(upstream's recursive vector-halving distance-doubling produces exactly this
+tree result replicated on every rank).
+
+TPU-native redesign: instead of MPI send/recv of vector halves, each level
+exchanges full tensors with the partner rank via `lax.ppermute` over the
+mesh axis and computes dots/norms locally (they are replicated within the
+merged group after each level).  XLA schedules the permutes over ICI.  The
+eager path compiles the whole tree as one XLA program over the
+rank-sharded stacked array.  Low-precision inputs are accumulated at f32
+(SURVEY.md hard-part #3: Adasum numerics at bf16).
+
+Requires power-of-two rank counts, as upstream's VHDD core does for the
+in-node ladder.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..common import basics
+from ..common.basics import GLOBAL_AXIS, ProcessSet
+from ..common.exceptions import HorovodTpuError
+
+_EPS = 1e-30
+
+
+def _pair_combine(a, b):
+    """Combine one pair of gradients (computed at f32)."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.vdot(af.ravel(), bf.ravel())
+    na = jnp.vdot(af.ravel(), af.ravel())
+    nb = jnp.vdot(bf.ravel(), bf.ravel())
+    # Guard zero norms: fall back to plain sum contribution for that side.
+    ca = jnp.where(na > _EPS, 1.0 - dot / (2.0 * jnp.maximum(na, _EPS)), 1.0)
+    cb = jnp.where(nb > _EPS, 1.0 - dot / (2.0 * jnp.maximum(nb, _EPS)), 1.0)
+    return (ca * af + cb * bf).astype(a.dtype)
+
+
+def adasum_tree_reduce(xs):
+    """Reduce (n, *s) stacked gradients with the Adasum binary tree.
+
+    Pure function of the stacked array; usable under jit.  `n` must be a
+    power of two.
+    """
+    n = xs.shape[0]
+    if n & (n - 1):
+        raise HorovodTpuError(f"Adasum requires power-of-two ranks, got {n}")
+    while n > 1:
+        a = xs[0::2]
+        b = xs[1::2]
+        xs = jax.vmap(_pair_combine)(a, b)
+        n //= 2
+    return xs[0]
+
+
+def adasum_in_axis(x, axis_name: str = GLOBAL_AXIS):
+    """In-jit Adasum over a mesh axis via a ppermute pairing ladder.
+
+    Level k: rank r exchanges its current (group-combined) gradient with
+    rank r XOR 2^k and combines, lower index as `a`.  After log2(n) levels
+    every rank holds the tree-combined result — the same value
+    `adasum_tree_reduce` computes.
+    """
+    n = lax.axis_size(axis_name)
+    if n & (n - 1):
+        raise HorovodTpuError(f"Adasum requires power-of-two ranks, got {n}")
+    idx = lax.axis_index(axis_name)
+    v = x
+    d = 1
+    while d < n:
+        perm = [(i, i ^ d) for i in range(n)]
+        w = lax.ppermute(v, axis_name, perm=perm)
+        is_lower = ((idx & d) == 0)
+        a = jnp.where(is_lower, v, w)
+        b = jnp.where(is_lower, w, v)
+        v = _pair_combine(a, b)
+        d *= 2
+    return v
+
+
+def adasum_allreduce(
+    tensor,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: Optional[str] = None,
+):
+    """Eager/in-jit entry used by `allreduce(op=Adasum)`."""
+    from . import collectives as C
+
+    if C._is_tracer(tensor):
+        return adasum_in_axis(tensor, axis_name or GLOBAL_AXIS)
+
+    ps = C._resolve_set(process_set)
+    xs, _ = C._make_global(tensor, ps)
+
+    def build():
+        return jax.jit(
+            adasum_tree_reduce,
+            in_shardings=(C._rank_sharded(ps),),
+            out_shardings=C._replicated(ps),
+        )
+
+    program = C._cached_program(("adasum", ps.process_set_id), build)
+    return program(xs)
+
+
+def adasum_reference(arrays):
+    """NumPy reference model of the Adasum recursion (mirrors the numerical
+    model in test_adasum_pytorch.py / test_adasum_tensorflow.py; used by
+    tests to validate the distributed implementations)."""
+    arrays = [np.asarray(a, np.float64) for a in arrays]
+
+    def pair(a, b):
+        dot = float(np.vdot(a.ravel(), b.ravel()))
+        na = float(np.vdot(a.ravel(), a.ravel()))
+        nb = float(np.vdot(b.ravel(), b.ravel()))
+        ca = 1.0 - dot / (2 * na) if na > _EPS else 1.0
+        cb = 1.0 - dot / (2 * nb) if nb > _EPS else 1.0
+        return ca * a + cb * b
+
+    while len(arrays) > 1:
+        arrays = [pair(arrays[i], arrays[i + 1])
+                  for i in range(0, len(arrays), 2)]
+    return arrays[0]

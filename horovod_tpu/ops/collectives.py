@@ -1,0 +1,802 @@
+"""Collective operations: allreduce / allgather / broadcast / alltoall /
+barrier / grouped ops, in both eager and in-jit form.
+
+Reference parity map (SURVEY.md §2.1–2.3, §3.3):
+  - horovod/common/operations.cc `EnqueueTensorAllreduce(s)/Allgather/
+    Broadcast/Alltoall`                      → the public functions here
+  - horovod/common/ops/{nccl,mpi,gloo}_operations.*  → XLA collectives over
+    the mesh (psum/all_gather/all_to_all lowered onto TPU ICI DMA rings)
+  - horovod/common/fusion_buffer_manager.*   → `grouped_allreduce` bucketing
+    (concatenate-in-graph; XLA materializes the fused buffer)
+  - horovod/common/response_cache.*          → the compiled-program cache
+    (`_program_cache` + jit's own trace cache)
+  - horovod/torch/handle_manager.*           → `HandleManager` (async API)
+
+TPU-native redesign notes
+-------------------------
+Horovod needs a background thread + negotiation because eager GPU workers
+must dynamically agree on what to reduce.  Here every eager collective is a
+*compiled XLA program* over the global device mesh: inputs are per-rank
+shards (NamedSharding over the `hvd` axis), outputs are fully replicated,
+and XLA inserts the all-reduce / all-gather / all-to-all over ICI.  The
+first call per (shape, dtype, op, process-set) traces and compiles; repeats
+hit the executable cache — the moral equivalent of Horovod's response-cache
+bitvector fast path, but with zero per-step negotiation traffic.
+
+Inside `jit`/`shard_map` the same functions detect tracers and emit
+`lax.psum`/`pmean`/... directly, so user step functions can call
+``hvd.allreduce(grad)`` in either world (reference analog: xla_mpi_ops.cc,
+HOROVOD_ENABLE_XLA_OPS=1 — the upstream feature closest to this design).
+
+Rank model: one rank per chip.  A process contributes one slice per local
+device.  Plain-array inputs mean "every local rank contributes this value"
+(the SPMD per-host view); `PerRank([...])` supplies distinct contributions
+for this process's local ranks (used heavily by tests to emulate N ranks in
+one process).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common import basics
+from ..common.basics import GLOBAL_AXIS, ProcessSet
+from ..common.exceptions import HorovodTpuError
+
+__all__ = [
+    "Average", "Sum", "Min", "Max", "Product", "Adasum",
+    "PerRank",
+    "allreduce", "allreduce_async", "grouped_allreduce",
+    "allgather", "allgather_async", "grouped_allgather",
+    "broadcast", "broadcast_async",
+    "alltoall", "alltoall_async",
+    "reducescatter", "grouped_reducescatter",
+    "barrier", "join",
+    "poll", "synchronize",
+    "clear_caches",
+]
+
+
+# ---------------------------------------------------------------------------
+# Reduce-op enum (reference: common.h ReduceOp / horovod's hvd.Sum etc.)
+# ---------------------------------------------------------------------------
+
+class ReduceOp:
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return f"ReduceOp.{self.name}"
+
+
+Average = ReduceOp("Average")
+Sum = ReduceOp("Sum")
+Min = ReduceOp("Min")
+Max = ReduceOp("Max")
+Product = ReduceOp("Product")
+Adasum = ReduceOp("Adasum")
+
+
+class PerRank:
+    """Distinct contributions for this process's local ranks.
+
+    ``PerRank([a, b, ...])`` — one array per local device, all identical
+    shape/dtype.  The single-process-8-device test harness uses this to act
+    as 8 Horovod ranks at once.
+    """
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = [jnp.asarray(v) for v in values]
+        if not self.values:
+            raise HorovodTpuError("PerRank requires at least one value")
+        # Ragged first dims are allowed (allgather pads them); dtype and
+        # rank must agree.
+        kinds = {(str(v.dtype), v.ndim) for v in self.values}
+        if len(kinds) > 1:
+            raise HorovodTpuError(
+                f"PerRank values must share dtype/rank, got {kinds}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Program cache — one compiled executable per (process set, op kind, statics)
+# ---------------------------------------------------------------------------
+
+_program_cache: Dict[Tuple, Callable] = {}
+_cache_lock = threading.Lock()
+
+
+def clear_caches() -> None:
+    with _cache_lock:
+        _program_cache.clear()
+    HandleManager.global_instance().clear()
+
+
+def _cached_program(key: Tuple, builder: Callable[[], Callable]) -> Callable:
+    with _cache_lock:
+        fn = _program_cache.get(key)
+        if fn is None:
+            fn = builder()
+            _program_cache[key] = fn
+    return fn
+
+
+def _resolve_set(process_set: Optional[ProcessSet]) -> ProcessSet:
+    ps = process_set or basics.global_process_set()
+    if not ps.included():
+        raise HorovodTpuError(
+            f"This process has no ranks in process set {ps.process_set_id}"
+        )
+    return ps
+
+
+def _set_devices(ps: ProcessSet) -> List[jax.Device]:
+    devs = basics.global_devices()
+    return [devs[r] for r in ps.ranks]
+
+
+def _is_tracer(x: Any) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+# ---------------------------------------------------------------------------
+# Building global (per-rank-sharded) arrays from local contributions
+# ---------------------------------------------------------------------------
+
+def _local_contributions(
+    tensor: Union[Any, PerRank], ps: ProcessSet
+) -> List[jnp.ndarray]:
+    """One array per local device participating in `ps`."""
+    st_local = [r for r in basics.local_device_ranks() if r in ps.ranks]
+    if isinstance(tensor, PerRank):
+        if len(tensor.values) != len(st_local):
+            raise HorovodTpuError(
+                f"PerRank has {len(tensor.values)} values but this process "
+                f"drives {len(st_local)} ranks of process set "
+                f"{ps.process_set_id}"
+            )
+        return tensor.values
+    x = jnp.asarray(tensor)
+    return [x] * len(st_local)
+
+
+def _make_global(tensor: Union[Any, PerRank], ps: ProcessSet) -> jax.Array:
+    """Build the (set_size, *shape) array sharded one-rank-per-device."""
+    contribs = _local_contributions(tensor, ps)
+    shape = contribs[0].shape
+    dtype = contribs[0].dtype
+    devs = _set_devices(ps)
+    local_devs = [
+        d for d in devs if d.process_index == basics.process_index()
+    ]
+    sharding = NamedSharding(ps.mesh, P(GLOBAL_AXIS))
+    shards = [
+        jax.device_put(np.asarray(c)[None], d)
+        for c, d in zip(contribs, local_devs)
+    ]
+    global_shape = (ps.size(),) + tuple(shape)
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, shards
+    ), dtype
+
+
+def _replicated(ps: ProcessSet) -> NamedSharding:
+    return NamedSharding(ps.mesh, P())
+
+
+def _rank_sharded(ps: ProcessSet) -> NamedSharding:
+    return NamedSharding(ps.mesh, P(GLOBAL_AXIS))
+
+
+# ---------------------------------------------------------------------------
+# Allreduce
+# ---------------------------------------------------------------------------
+
+def _reduce_in_graph(xs, op: ReduceOp, n: int):
+    """Reduce (n, *s) over axis 0.  With rank-sharded input and replicated
+    output sharding XLA lowers this to a single fused all-reduce over ICI."""
+    if op is Average:
+        # Sum in the wire dtype (bandwidth-optimal, matches reference),
+        # divide at f32, return the input dtype.
+        s = jnp.sum(xs, axis=0)
+        return (s.astype(jnp.float32) / n).astype(xs.dtype)
+    if op is Sum:
+        return jnp.sum(xs, axis=0)
+    if op is Min:
+        return jnp.min(xs, axis=0)
+    if op is Max:
+        return jnp.max(xs, axis=0)
+    if op is Product:
+        return jnp.prod(xs, axis=0)
+    raise HorovodTpuError(f"Unsupported reduce op {op}")
+
+
+def _allreduce_program(ps: ProcessSet, op: ReduceOp) -> Callable:
+    def build():
+        n = ps.size()
+
+        def fn(xs, prescale, postscale):
+            x = xs * prescale.astype(xs.dtype)
+            out = _reduce_in_graph(x, op, n)
+            return out * postscale.astype(out.dtype)
+
+        return jax.jit(
+            fn,
+            in_shardings=(_rank_sharded(ps), _replicated(ps), _replicated(ps)),
+            out_shardings=_replicated(ps),
+        )
+
+    return _cached_program(("allreduce", ps.process_set_id, op.name), build)
+
+
+def allreduce(
+    tensor,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: Optional[str] = None,
+):
+    """Allreduce `tensor` across all ranks of the process set.
+
+    Eager (outside jit): returns the reduced value, replicated.
+    Inside jit/shard_map: emits `lax.psum`/`pmean` etc. on `axis_name`
+    (default: the global `hvd` axis).
+
+    Reference: EnqueueTensorAllreduce (operations.cc); op semantics incl.
+    prescale/postscale follow collective_operations.cc ScaleBuffer.
+    """
+    if op is None:
+        op = Sum if average is False else Average
+    if op is Adasum:
+        from . import adasum as _adasum
+
+        return _adasum.adasum_allreduce(
+            tensor, process_set=process_set, axis_name=axis_name
+        )
+
+    if _is_tracer(tensor):
+        ax = axis_name or GLOBAL_AXIS
+        x = tensor * jnp.asarray(prescale_factor, tensor.dtype) \
+            if prescale_factor != 1.0 else tensor
+        if op is Average:
+            out = lax.pmean(x, ax)
+        elif op is Sum:
+            out = lax.psum(x, ax)
+        elif op is Min:
+            out = lax.pmin(x, ax)
+        elif op is Max:
+            out = lax.pmax(x, ax)
+        elif op is Product:
+            g = lax.all_gather(x, ax)
+            out = jnp.prod(g, axis=0)
+        else:
+            raise HorovodTpuError(f"Unsupported in-jit reduce op {op}")
+        if postscale_factor != 1.0:
+            out = out * jnp.asarray(postscale_factor, out.dtype)
+        return out
+
+    ps = _resolve_set(process_set)
+    xs, dtype = _make_global(tensor, ps)
+    program = _allreduce_program(ps, op)
+    pre = jnp.asarray(prescale_factor, jnp.float32)
+    post = jnp.asarray(postscale_factor, jnp.float32)
+    return program(xs, pre, post)
+
+
+def grouped_allreduce(
+    tensors: Sequence[Any],
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: Optional[str] = None,
+) -> List[Any]:
+    """Fused allreduce of a tensor group (reference: EnqueueTensorAllreduces
+    + group_table.cc; the fusion-buffer pack/unpack happens in-graph —
+    flatten/concat before one collective, split/reshape after).
+    """
+    if op is None:
+        op = Sum if average is False else Average
+    if not tensors:
+        return []
+
+    if _is_tracer(tensors[0]):
+        ax = axis_name or GLOBAL_AXIS
+        flat = [jnp.ravel(t).astype(jnp.result_type(t)) for t in tensors]
+        sizes = [t.size for t in flat]
+        # Bucket by dtype, one fused collective per bucket.
+        out: List[Any] = [None] * len(tensors)
+        by_dtype: Dict[Any, List[int]] = {}
+        for i, f in enumerate(flat):
+            by_dtype.setdefault(f.dtype, []).append(i)
+        for dt, idxs in by_dtype.items():
+            buf = jnp.concatenate([flat[i] for i in idxs])
+            red = allreduce(
+                buf, op=op, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor, axis_name=ax,
+            )
+            offset = 0
+            for i in idxs:
+                out[i] = red[offset: offset + sizes[i]].reshape(
+                    tensors[i].shape
+                )
+                offset += sizes[i]
+        return out
+
+    ps = _resolve_set(process_set)
+    results = []
+    # Eager path: fuse same-dtype tensors into one flat program call.
+    contribs = [_local_contributions(t, ps) for t in tensors]
+    n_local = len(contribs[0])
+    by_dtype: Dict[Any, List[int]] = {}
+    for i, c in enumerate(contribs):
+        by_dtype.setdefault(c[0].dtype, []).append(i)
+    out: List[Any] = [None] * len(tensors)
+    for dt, idxs in by_dtype.items():
+        shapes = [contribs[i][0].shape for i in idxs]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        fused_per_rank = [
+            jnp.concatenate(
+                [jnp.ravel(contribs[i][r]) for i in idxs]
+            )
+            for r in range(n_local)
+        ]
+        red = allreduce(
+            PerRank(fused_per_rank), op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=ps,
+        )
+        offset = 0
+        for i, sz, shp in zip(idxs, sizes, shapes):
+            out[i] = red[offset: offset + sz].reshape(shp)
+            offset += sz
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Allgather
+# ---------------------------------------------------------------------------
+
+def allgather(
+    tensor,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: Optional[str] = None,
+):
+    """Concatenate each rank's tensor along dim 0 (reference:
+    EnqueueTensorAllgather; variable first-dim supported like
+    AllgatherOp::SetDisplacements — ragged inputs are padded in-graph and
+    sliced on the way out).
+    """
+    if _is_tracer(tensor):
+        ax = axis_name or GLOBAL_AXIS
+        return lax.all_gather(tensor, ax, tiled=True)
+
+    ps = _resolve_set(process_set)
+    contribs = _local_contributions(tensor, ps)
+    # Ragged first dim: find per-rank dim0 via a small fixed-shape allgather.
+    dim0_local = [c.shape[0] if c.ndim else 1 for c in contribs]
+    if isinstance(tensor, PerRank) or basics.num_processes() > 1:
+        sizes = allgather_sizes(dim0_local, ps)
+    else:
+        sizes = [dim0_local[0]] * ps.size()
+    max0 = max(sizes) if sizes else 0
+    padded = []
+    for c in contribs:
+        if c.ndim == 0:
+            c = c[None]
+        pad = max0 - c.shape[0]
+        if pad > 0:
+            padding = [(0, pad)] + [(0, 0)] * (c.ndim - 1)
+            c = jnp.pad(c, padding)
+        padded.append(c)
+    xs, _ = _make_global(PerRank(padded), ps)
+
+    def build():
+        def fn(x):
+            n = ps.size()
+            return x.reshape((n * x.shape[1],) + x.shape[2:])
+
+        return jax.jit(
+            fn,
+            in_shardings=(_rank_sharded(ps),),
+            out_shardings=_replicated(ps),
+        )
+
+    program = _cached_program(("allgather", ps.process_set_id), build)
+    gathered = program(xs)
+    if all(s == max0 for s in sizes):
+        return gathered
+    # Slice out the padding (host-side, sizes are concrete).
+    pieces = []
+    for r, s in enumerate(sizes):
+        pieces.append(gathered[r * max0: r * max0 + s])
+    return jnp.concatenate(pieces, axis=0)
+
+
+def allgather_sizes(local_dim0: Sequence[int], ps: ProcessSet) -> List[int]:
+    """Gather each rank's first-dim size (the displacement exchange of
+    AllgatherOp::SetDisplacements done as one tiny int32 collective)."""
+    per_rank = PerRank([jnp.asarray([d], jnp.int32) for d in local_dim0])
+    xs, _ = _make_global(per_rank, ps)
+
+    def build():
+        return jax.jit(
+            lambda x: x.reshape((ps.size(),)),
+            in_shardings=(_rank_sharded(ps),),
+            out_shardings=_replicated(ps),
+        )
+
+    program = _cached_program(("allgather_sizes", ps.process_set_id), build)
+    return [int(v) for v in np.asarray(program(xs))]
+
+
+def grouped_allgather(
+    tensors: Sequence[Any],
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: Optional[str] = None,
+) -> List[Any]:
+    return [
+        allgather(t, process_set=process_set, axis_name=axis_name)
+        for t in tensors
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast(
+    tensor,
+    root_rank: int = 0,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: Optional[str] = None,
+):
+    """Broadcast root_rank's tensor to every rank (reference:
+    EnqueueTensorBroadcast)."""
+    if _is_tracer(tensor):
+        ax = axis_name or GLOBAL_AXIS
+        idx = lax.axis_index(ax)
+        masked = jnp.where(idx == root_rank, tensor,
+                           jnp.zeros_like(tensor))
+        return lax.psum(masked, ax)
+
+    ps = _resolve_set(process_set)
+    if root_rank not in range(ps.size()):
+        raise HorovodTpuError(
+            f"root_rank {root_rank} out of range for set of size {ps.size()}"
+        )
+    xs, _ = _make_global(tensor, ps)
+
+    def build():
+        def fn(x, root):
+            return jnp.take(x, root, axis=0)
+
+        return jax.jit(
+            fn,
+            in_shardings=(_rank_sharded(ps), _replicated(ps)),
+            out_shardings=_replicated(ps),
+        )
+
+    program = _cached_program(("broadcast", ps.process_set_id), build)
+    return program(xs, jnp.asarray(root_rank, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Alltoall
+# ---------------------------------------------------------------------------
+
+def alltoall(
+    tensor,
+    splits=None,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: Optional[str] = None,
+):
+    """Distribute slices of `tensor` to every rank (reference:
+    EnqueueTensorAlltoall + AlltoallOp::PrepareOutputAndParams).
+
+    Without `splits`: dim 0 must divide evenly by set size; rank r receives
+    the r-th chunk from every rank, concatenated in rank order.  With
+    `splits` (len = set size): uneven send counts; returns
+    (received, received_splits) like the reference.
+    """
+    if _is_tracer(tensor):
+        if splits is not None:
+            raise HorovodTpuError(
+                "alltoall with splits is not supported inside jit; uneven "
+                "splits require host-side size exchange (use the eager API)"
+            )
+        ax = axis_name or GLOBAL_AXIS
+        return lax.all_to_all(tensor, ax, split_axis=0, concat_axis=0,
+                              tiled=True)
+
+    ps = _resolve_set(process_set)
+    n = ps.size()
+    contribs = _local_contributions(tensor, ps)
+
+    if splits is None:
+        d0 = contribs[0].shape[0]
+        if d0 % n != 0:
+            raise HorovodTpuError(
+                f"alltoall without splits requires dim0 ({d0}) divisible by "
+                f"set size ({n})"
+            )
+        xs, _ = _make_global(PerRank(contribs), ps)
+
+        def build():
+            def fn(x):
+                # x: (n, d0, *s) rank-sharded on axis 0.
+                c = x.shape[1] // n
+                y = x.reshape((n, n, c) + x.shape[2:])
+                y = jnp.swapaxes(y, 0, 1)  # (recv, send, c, *s)
+                return y.reshape((n, n * c) + x.shape[2:])
+
+            return jax.jit(
+                fn,
+                in_shardings=(_rank_sharded(ps),),
+                out_shardings=_rank_sharded(ps),
+            )
+
+        program = _cached_program(("alltoall", ps.process_set_id), build)
+        out = program(xs)
+        # Return this process's received rows, one per local rank.
+        local = [r for r in basics.local_device_ranks() if r in ps.ranks]
+        rows = [out[ps.ranks.index(r)] for r in local]
+        if isinstance(tensor, PerRank):
+            return PerRank(rows)
+        return rows[0]
+
+    # Uneven splits: pad each outgoing chunk to the max split then slice.
+    splits_arr = (
+        splits.values if isinstance(splits, PerRank) else
+        [np.asarray(splits, np.int32)] * len(contribs)
+    )
+    all_splits = _alltoall_exchange_splits(splits_arr, ps)
+    maxc = int(max(int(s) for row in all_splits for s in row)) or 1
+    padded = []
+    for c, sp in zip(contribs, splits_arr):
+        sp = np.asarray(sp, np.int64)
+        offs = np.concatenate([[0], np.cumsum(sp)])
+        chunks = []
+        for r in range(n):
+            chunk = c[int(offs[r]): int(offs[r + 1])]
+            pad = maxc - chunk.shape[0]
+            if pad:
+                padding = [(0, pad)] + [(0, 0)] * (chunk.ndim - 1)
+                chunk = jnp.pad(chunk, padding)
+            chunks.append(chunk)
+        padded.append(jnp.stack(chunks))  # (n, maxc, *s)
+    xs, _ = _make_global(PerRank(padded), ps)
+
+    def build():
+        def fn(x):
+            # x: (n_send, n_recv, maxc, *s) sharded on axis 0.
+            y = jnp.swapaxes(x, 0, 1)  # (n_recv, n_send, maxc, *s)
+            return y
+
+        return jax.jit(
+            fn,
+            in_shardings=(_rank_sharded(ps),),
+            out_shardings=_rank_sharded(ps),
+        )
+
+    program = _cached_program(("alltoallv", ps.process_set_id), build)
+    out = np.asarray(program(xs))
+    local = [r for r in basics.local_device_ranks() if r in ps.ranks]
+    results, rsplits = [], []
+    for r in local:
+        i = ps.ranks.index(r)
+        recv_counts = [int(all_splits[s][i]) for s in range(n)]
+        pieces = [out[i, s, : recv_counts[s]] for s in range(n)]
+        results.append(jnp.concatenate(pieces, axis=0))
+        rsplits.append(jnp.asarray(recv_counts, jnp.int32))
+    if isinstance(tensor, PerRank):
+        return PerRank(results), PerRank(rsplits)
+    return results[0], rsplits[0]
+
+
+def _alltoall_exchange_splits(splits_arr, ps: ProcessSet) -> List[List[int]]:
+    """All ranks learn everyone's send-split table (reference:
+    MPIController::AlltoallGetRecvSplits)."""
+    per_rank = PerRank([jnp.asarray(s, jnp.int32) for s in splits_arr])
+    xs, _ = _make_global(per_rank, ps)
+
+    def build():
+        return jax.jit(
+            lambda x: x,
+            in_shardings=(_rank_sharded(ps),),
+            out_shardings=_replicated(ps),
+        )
+
+    program = _cached_program(("alltoall_splits", ps.process_set_id), build)
+    table = np.asarray(program(xs))
+    return [list(row) for row in table]
+
+
+# ---------------------------------------------------------------------------
+# Reduce-scatter
+# ---------------------------------------------------------------------------
+
+def reducescatter(
+    tensor,
+    op: ReduceOp = Average,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: Optional[str] = None,
+):
+    """Reduce across ranks, scatter result slices (reference: upstream
+    reducescatter support; on TPU this is `lax.psum_scatter`).
+    Supports Sum and Average, as the reference does."""
+    if op not in (Sum, Average):
+        raise HorovodTpuError(
+            f"reducescatter supports Sum and Average, got {op}"
+        )
+    if _is_tracer(tensor):
+        ax = axis_name or GLOBAL_AXIS
+        out = lax.psum_scatter(tensor, ax, tiled=True)
+        if op is Average:
+            out = (out / lax.axis_size(ax)).astype(tensor.dtype)
+        return out
+
+    ps = _resolve_set(process_set)
+    n = ps.size()
+    contribs = _local_contributions(tensor, ps)
+    d0 = contribs[0].shape[0]
+    if d0 % n != 0:
+        raise HorovodTpuError(
+            f"reducescatter requires dim0 ({d0}) divisible by set size ({n})"
+        )
+    xs, _ = _make_global(PerRank(contribs), ps)
+
+    def build():
+        def fn(x):
+            red = jnp.sum(x, axis=0) if op is Sum else jnp.mean(x, axis=0)
+            return red.reshape((n, d0 // n) + x.shape[2:])
+
+        return jax.jit(
+            fn,
+            in_shardings=(_rank_sharded(ps),),
+            out_shardings=_rank_sharded(ps),
+        )
+
+    program = _cached_program(
+        ("reducescatter", ps.process_set_id, op.name), build
+    )
+    out = program(xs)
+    local = [r for r in basics.local_device_ranks() if r in ps.ranks]
+    rows = [out[ps.ranks.index(r)] for r in local]
+    if isinstance(tensor, PerRank):
+        return PerRank(rows)
+    return rows[0]
+
+
+def grouped_reducescatter(tensors, op: ReduceOp = Average, **kw):
+    return [reducescatter(t, op=op, **kw) for t in tensors]
+
+
+# ---------------------------------------------------------------------------
+# Barrier / join
+# ---------------------------------------------------------------------------
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    """Block until every rank reaches the barrier (reference: BarrierOp).
+    Implemented as a 1-element allreduce + block_until_ready."""
+    out = allreduce(jnp.zeros((1,), jnp.int32), op=Sum,
+                    process_set=process_set)
+    jax.block_until_ready(out)
+
+
+def join(process_set: Optional[ProcessSet] = None) -> int:
+    """Uneven-data join (reference: EnqueueJoin / JoinOp).
+
+    Under SPMD a compiled step cannot run with absent ranks, so join's
+    contract degrades gracefully to its observable behavior: a barrier that
+    returns the last rank to join.  Rank order of arrival is not observable
+    without a control plane, so we return the max rank present, matching
+    Horovod's return of the last joining rank in the common all-join case.
+    """
+    ps = process_set or basics.global_process_set()
+    local = [r for r in basics.local_device_ranks() if r in ps.ranks]
+    out = allreduce(
+        PerRank([jnp.asarray([r], jnp.int32) for r in local]),
+        op=Max, process_set=ps,
+    )
+    jax.block_until_ready(out)
+    return int(np.asarray(out)[0])
+
+
+# ---------------------------------------------------------------------------
+# Async API (reference: torch/handle_manager.* + mpi_ops.py poll/synchronize)
+# ---------------------------------------------------------------------------
+
+class HandleManager:
+    """Integer handles → in-flight results.  JAX dispatch is already async
+    (collectives execute on device while Python continues); a handle wraps
+    the not-yet-materialized jax.Array(s)."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: Dict[int, Any] = {}
+
+    @classmethod
+    def global_instance(cls) -> "HandleManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def allocate(self, result: Any) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._results[h] = result
+            return h
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            result = self._results[handle]
+        ready = True
+        for leaf in jax.tree_util.tree_leaves(result):
+            if hasattr(leaf, "is_ready") and not leaf.is_ready():
+                ready = False
+        return ready
+
+    def release(self, handle: int) -> Any:
+        with self._lock:
+            result = self._results.pop(handle)
+        return jax.block_until_ready(result)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._results.clear()
+
+
+def allreduce_async(tensor, **kwargs) -> int:
+    return HandleManager.global_instance().allocate(
+        allreduce(tensor, **kwargs)
+    )
+
+
+def allgather_async(tensor, **kwargs) -> int:
+    return HandleManager.global_instance().allocate(
+        allgather(tensor, **kwargs)
+    )
+
+
+def broadcast_async(tensor, root_rank: int = 0, **kwargs) -> int:
+    return HandleManager.global_instance().allocate(
+        broadcast(tensor, root_rank=root_rank, **kwargs)
+    )
+
+
+def alltoall_async(tensor, splits=None, **kwargs) -> int:
+    return HandleManager.global_instance().allocate(
+        alltoall(tensor, splits=splits, **kwargs)
+    )
+
+
+def poll(handle: int) -> bool:
+    return HandleManager.global_instance().poll(handle)
+
+
+def synchronize(handle: int):
+    return HandleManager.global_instance().release(handle)

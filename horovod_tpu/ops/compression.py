@@ -1,0 +1,66 @@
+"""Gradient wire-compression hooks.
+
+Reference parity: horovod/torch/compression.py & horovod/tensorflow/
+compression.py — `Compression.none` / `Compression.fp16` with
+`Compressor.compress/decompress`.
+
+TPU note: bf16 is the native low-precision dtype (first-class on the MXU
+and halves ICI bytes), so `Compression.bf16` is provided alongside fp16.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context_for_decompress)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype = None
+
+    @classmethod
+    def compress(cls, tensor):
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor.astype(cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Namespace matching ``hvd.Compression``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,86 @@
+"""High-level convenience functions over the collectives.
+
+Reference parity: horovod/torch/functions.py (`broadcast_parameters`,
+`broadcast_optimizer_state`, `broadcast_object`) and
+horovod/tensorflow/functions.py (`broadcast_variables`).
+
+On TPU these operate on pytrees (flax/optax states are pytrees), which
+subsumes the per-framework variants.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import basics
+from ..common.basics import ProcessSet
+from . import collectives as C
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0,
+                         process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast a pytree of arrays from root_rank to all ranks
+    (reference: torch/functions.py broadcast_parameters; TF
+    broadcast_variables).  Fuses all leaves into grouped broadcasts."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = [
+        C.broadcast(leaf, root_rank=root_rank, process_set=process_set)
+        for leaf in leaves
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# Optimizer state is a pytree in optax — same mechanism.
+broadcast_optimizer_state = broadcast_parameters
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast an arbitrary picklable object (reference:
+    torch/functions.py broadcast_object): pickle → uint8 tensor →
+    size bcast → payload bcast → unpickle."""
+    ps = process_set or basics.global_process_set()
+    # root_rank indexes the process set; this process owns the root when
+    # the root's device is one of its local devices (rank = chip model).
+    root_global = ps.ranks[root_rank]
+    if root_global in basics.local_device_ranks():
+        payload = pickle.dumps(obj)
+        data = np.frombuffer(payload, dtype=np.uint8).copy()
+        size = np.asarray([data.size], np.int64)
+    else:
+        data = None
+        size = np.asarray([0], np.int64)
+
+    size = np.asarray(C.broadcast(jnp.asarray(size), root_rank=root_rank,
+                                  process_set=process_set))
+    n = int(size[0])
+    if data is None:
+        data = np.zeros((n,), np.uint8)
+    out = np.asarray(C.broadcast(jnp.asarray(data), root_rank=root_rank,
+                                 process_set=process_set))
+    return pickle.loads(out.tobytes())
+
+
+def allgather_object(obj: Any,
+                     process_set: Optional[ProcessSet] = None) -> list:
+    """Gather a picklable object from every rank (reference:
+    torch/functions.py allgather_object): pickle → ragged uint8
+    allgather → unpickle each."""
+    payload = pickle.dumps(obj)
+    data = jnp.asarray(np.frombuffer(payload, dtype=np.uint8).copy())
+    ps = process_set or basics.global_process_set()
+    sizes = C.allgather_sizes([data.shape[0]] * len(
+        [r for r in basics.local_device_ranks() if r in ps.ranks]), ps)
+    gathered = np.asarray(C.allgather(data, process_set=ps))
+    objs, off = [], 0
+    for s in sizes:
+        objs.append(pickle.loads(gathered[off: off + s].tobytes()))
+        off += s
+    return objs

@@ -1,0 +1,41 @@
+"""Test harness: 8 simulated TPU ranks via the CPU host platform.
+
+Mirrors the reference's test strategy (SURVEY.md §4): Horovod runs its
+parallel suites under a real 2-process `horovodrun`; here N ranks are N
+virtual devices in one process (`--xla_force_host_platform_device_count=8`),
+which exercises the identical SPMD collective code paths that run on a pod
+slice — better coverage per test than the reference's 2 processes.
+"""
+
+import os
+
+# Must happen before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pins jax_platforms to the TPU plugin at
+# interpreter start; env alone cannot override it, so force CPU here
+# before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def hvd_init():
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+@pytest.fixture()
+def mesh():
+    return hvd.global_mesh()

@@ -1,0 +1,83 @@
+"""Adasum numerics vs the recursion reference model (mirrors
+test_adasum_pytorch.py / test_adasum_tensorflow.py, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import PerRank
+from horovod_tpu.ops.adasum import (
+    adasum_in_axis, adasum_reference, adasum_tree_reduce,
+)
+
+N = 8
+
+
+def grads(shape=(16,), seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.uniform(-1, 1, size=shape).astype(np.float32)
+            for _ in range(N)]
+
+
+def test_adasum_identical_inputs_is_identity():
+    # adasum(a, a) == a at every tree level.
+    x = np.random.RandomState(1).uniform(size=(8,)).astype(np.float32)
+    out = hvd.allreduce(x, op=hvd.Adasum)
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-5)
+
+
+def test_adasum_orthogonal_inputs_sum():
+    # Orthogonal gradients: dot = 0 → plain sum (2 ranks worth).
+    ps = hvd.add_process_set([0, 1])
+    try:
+        a = np.array([1.0, 0.0], np.float32)
+        b = np.array([0.0, 1.0], np.float32)
+        out = hvd.allreduce(PerRank([a, b]), op=hvd.Adasum, process_set=ps)
+        np.testing.assert_allclose(np.asarray(out), a + b, rtol=1e-5)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_adasum_matches_reference_model(seed):
+    gs = grads(seed=seed)
+    out = hvd.allreduce(PerRank(gs), op=hvd.Adasum)
+    expected = adasum_reference(gs)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_adasum_tree_reduce_matches_reference():
+    gs = grads(seed=3)
+    out = adasum_tree_reduce(jnp.stack(gs))
+    np.testing.assert_allclose(np.asarray(out), adasum_reference(gs),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_in_axis_matches_tree(mesh):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    gs = grads(seed=4)
+    stacked = jnp.stack(gs)
+
+    def f(x):
+        return adasum_in_axis(x[0], hvd.GLOBAL_AXIS)
+
+    sm = shard_map(f, mesh=mesh, in_specs=(P(hvd.GLOBAL_AXIS),),
+                   out_specs=P(), check_vma=False)
+    out = jax.jit(sm)(stacked)
+    np.testing.assert_allclose(np.asarray(out), adasum_reference(gs),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_requires_power_of_two():
+    ps = hvd.add_process_set([0, 1, 2])
+    try:
+        with pytest.raises(Exception):
+            hvd.allreduce(PerRank(grads()[:3]), op=hvd.Adasum,
+                          process_set=ps)
+    finally:
+        hvd.remove_process_set(ps)

@@ -1,0 +1,66 @@
+"""Init/rank/size/process-set tests (reference: basics exposed via
+horovod/common/basics.py; process sets via horovod/common/process_sets.py).
+"""
+
+import jax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.exceptions import HorovodTpuError
+
+
+def test_sizes():
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+    assert hvd.local_device_ranks() == list(range(8))
+
+
+def test_double_init_is_noop():
+    hvd.init()
+    assert hvd.size() == 8
+
+
+def test_build_info():
+    assert hvd.xla_built()
+    assert hvd.gloo_built()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.ccl_built()
+    assert not hvd.mpi_threads_supported()
+
+
+def test_global_process_set():
+    ps = hvd.global_process_set()
+    assert ps.process_set_id == 0
+    assert ps.ranks == list(range(8))
+    assert ps.size() == 8
+    assert ps.included()
+    assert ps.rank() == 0
+
+
+def test_add_remove_process_set():
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    assert ps.process_set_id > 0
+    assert ps.size() == 4
+    assert ps.mesh is not None
+    with pytest.raises(HorovodTpuError):
+        hvd.add_process_set([0, 2, 4, 6])  # duplicate
+    hvd.remove_process_set(ps)
+    with pytest.raises(HorovodTpuError):
+        hvd.get_process_set(ps.process_set_id)
+
+
+def test_cannot_remove_global_set():
+    with pytest.raises(HorovodTpuError):
+        hvd.remove_process_set(hvd.global_process_set())
+
+
+def test_out_of_range_process_set():
+    with pytest.raises(HorovodTpuError):
+        hvd.add_process_set([0, 99])
