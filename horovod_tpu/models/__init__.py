@@ -1,0 +1,19 @@
+"""Model zoo: the reference's benchmark/example models, rebuilt TPU-first.
+
+- `resnet`: ResNet-18/34/50/101/152 (reference headline benchmark —
+  pytorch_synthetic_benchmark.py / tf_cnn_benchmarks, SURVEY.md §6)
+- `mnist`: the pytorch_mnist.py Net (BASELINE config 1)
+- `transformer`: flagship sharded transformer (TP/SP/EP/PP-capable) —
+  beyond-parity model exercising the full parallelism substrate.
+"""
+
+from .resnet import (  # noqa: F401
+    resnet_init,
+    resnet_apply,
+    resnet50_init,
+)
+from .mnist import (  # noqa: F401
+    mnist_cnn_init,
+    mnist_cnn_apply,
+    nll_loss,
+)

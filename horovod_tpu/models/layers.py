@@ -1,0 +1,202 @@
+"""Functional NN layers: explicit param pytrees, TPU-native layouts.
+
+Models in this package are plain init/apply pairs over nested-dict pytrees
+(no framework Module system), so every parameter is directly addressable
+for sharding annotations (`jax.sharding` PartitionSpec trees) — the
+property the parallelism substrate in `horovod_tpu.parallel` relies on.
+
+Layout choices are TPU-first:
+  - activations NHWC, conv kernels HWIO — XLA's preferred TPU conv layout
+    (feeds the MXU without transposes);
+  - matmuls keep the contracting dim a multiple of 128 where the model
+    allows (MXU tiling);
+  - a `compute_dtype` (default bf16-capable) separate from the f32 param
+    dtype, mirroring mixed-precision practice on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Initializers
+# ---------------------------------------------------------------------------
+
+def he_normal(key, shape, fan_in, dtype=jnp.float32):
+    std = math.sqrt(2.0 / fan_in)
+    return jax.random.normal(key, shape, dtype) * jnp.asarray(std, dtype)
+
+
+def uniform_fan_in(key, shape, fan_in, dtype=jnp.float32):
+    bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+# ---------------------------------------------------------------------------
+# Dense
+# ---------------------------------------------------------------------------
+
+def dense_init(key, in_features: int, out_features: int,
+               dtype=jnp.float32, bias: bool = True) -> Params:
+    kw, kb = jax.random.split(key)
+    p = {"kernel": uniform_fan_in(kw, (in_features, out_features),
+                                  in_features, dtype)}
+    if bias:
+        p["bias"] = uniform_fan_in(kb, (out_features,), in_features, dtype)
+    return p
+
+
+def dense_apply(p: Params, x, compute_dtype=None):
+    k = p["kernel"]
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        k = k.astype(compute_dtype)
+    y = x @ k
+    if "bias" in p:
+        y = y + p["bias"].astype(y.dtype)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Conv2D (NHWC / HWIO)
+# ---------------------------------------------------------------------------
+
+def conv2d_init(key, in_ch: int, out_ch: int, kernel: int,
+                dtype=jnp.float32, bias: bool = False) -> Params:
+    kw, kb = jax.random.split(key)
+    fan_in = in_ch * kernel * kernel
+    p = {"kernel": he_normal(kw, (kernel, kernel, in_ch, out_ch),
+                             fan_in, dtype)}
+    if bias:
+        p["bias"] = jnp.zeros((out_ch,), dtype)
+    return p
+
+
+def conv2d_apply(p: Params, x, stride: int = 1,
+                 padding="SAME", compute_dtype=None):
+    k = p["kernel"]
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        k = k.astype(compute_dtype)
+    y = lax.conv_general_dilated(
+        x, k,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "bias" in p:
+        y = y + p["bias"].astype(y.dtype)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm (train-mode batch stats; optional cross-rank sync via psum)
+# ---------------------------------------------------------------------------
+
+def batchnorm_init(features: int, dtype=jnp.float32) -> Tuple[Params, Params]:
+    params = {"scale": jnp.ones((features,), dtype),
+              "bias": jnp.zeros((features,), dtype)}
+    stats = {"mean": jnp.zeros((features,), dtype),
+             "var": jnp.ones((features,), dtype)}
+    return params, stats
+
+
+def batchnorm_apply(
+    params: Params,
+    stats: Params,
+    x,
+    train: bool = True,
+    momentum: float = 0.9,
+    eps: float = 1e-5,
+    axis_name: Optional[str] = None,
+):
+    """Normalize over all axes but the last.  `axis_name` enables
+    cross-rank synchronized statistics (reference: horovod's
+    SyncBatchNormalization — sync_batch_norm.py computes global batch
+    mean/var with allreduce; here a `lax.pmean` over the mesh axis).
+
+    Returns (y, new_stats).
+    """
+    reduce_axes = tuple(range(x.ndim - 1))
+    if train:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        mean2 = jnp.mean(jnp.square(xf), axis=reduce_axes)
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            mean2 = lax.pmean(mean2, axis_name)
+        var = mean2 - jnp.square(mean)
+        new_stats = {
+            "mean": (momentum * stats["mean"]
+                     + (1 - momentum) * mean).astype(stats["mean"].dtype),
+            "var": (momentum * stats["var"]
+                    + (1 - momentum) * var).astype(stats["var"].dtype),
+        }
+    else:
+        mean = stats["mean"].astype(jnp.float32)
+        var = stats["var"].astype(jnp.float32)
+        new_stats = stats
+    inv = lax.rsqrt(var + eps) * params["scale"].astype(jnp.float32)
+    y = (x.astype(jnp.float32) - mean) * inv + params["bias"].astype(
+        jnp.float32)
+    return y.astype(x.dtype), new_stats
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm / RMSNorm (transformer building blocks)
+# ---------------------------------------------------------------------------
+
+def layernorm_init(features: int, dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((features,), dtype),
+            "bias": jnp.zeros((features,), dtype)}
+
+
+def layernorm_apply(p: Params, x, eps: float = 1e-6):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def rmsnorm_init(features: int, dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((features,), dtype)}
+
+
+def rmsnorm_apply(p: Params, x, eps: float = 1e-6):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(ms + eps) * p["scale"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+def max_pool(x, window: int, stride: int, padding="VALID"):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        (1, window, window, 1), (1, stride, stride, 1), padding,
+    )
+
+
+def avg_pool(x, window: int, stride: int, padding="VALID"):
+    s = lax.reduce_window(
+        x, 0.0, lax.add,
+        (1, window, window, 1), (1, stride, stride, 1), padding,
+    )
+    return s / (window * window)
+
+
+def global_avg_pool(x):
+    return jnp.mean(x, axis=(1, 2))

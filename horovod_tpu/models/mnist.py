@@ -1,0 +1,52 @@
+"""MNIST CNN — parity model for the reference's first-run config.
+
+Reference: `examples/pytorch/pytorch_mnist.py` `Net` (conv(1→10,5) →
+maxpool → relu → conv(10→20,5) → dropout2d → maxpool → relu → fc(320→50)
+→ fc(50→10) → log_softmax); BASELINE.json config 1.  Same topology,
+TPU-native NHWC layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+
+def mnist_cnn_init(key, dtype=jnp.float32) -> Dict[str, Any]:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "conv1": L.conv2d_init(k1, 1, 10, 5, dtype, bias=True),
+        "conv2": L.conv2d_init(k2, 10, 20, 5, dtype, bias=True),
+        "fc1": L.dense_init(k3, 320, 50, dtype),
+        "fc2": L.dense_init(k4, 50, 10, dtype),
+    }
+
+
+def mnist_cnn_apply(params: Dict[str, Any], x, train: bool = False,
+                    dropout_rng: Optional[jax.Array] = None):
+    """x: (N, 28, 28, 1) → log-probabilities (N, 10)."""
+    y = L.conv2d_apply(params["conv1"], x, 1, padding="VALID")
+    y = L.max_pool(y, 2, 2)
+    y = jax.nn.relu(y)
+    y = L.conv2d_apply(params["conv2"], y, 1, padding="VALID")
+    if train and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 0.5, y.shape[:1] + (1, 1,) +
+                                    y.shape[3:])
+        y = jnp.where(keep, y / 0.5, 0.0)
+    y = L.max_pool(y, 2, 2)
+    y = jax.nn.relu(y)
+    y = y.reshape((y.shape[0], -1))
+    y = jax.nn.relu(L.dense_apply(params["fc1"], y))
+    y = L.dense_apply(params["fc2"], y)
+    return jax.nn.log_softmax(y, axis=-1)
+
+
+def nll_loss(log_probs, labels):
+    """Negative log-likelihood (reference: F.nll_loss in pytorch_mnist.py)."""
+    return -jnp.mean(
+        jnp.take_along_axis(log_probs, labels[:, None], axis=-1)
+    )

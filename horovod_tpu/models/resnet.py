@@ -1,0 +1,153 @@
+"""ResNet family (v1.5) — the benchmark model of the reference.
+
+Reference parity: `examples/pytorch/pytorch_synthetic_benchmark.py` drives
+`torchvision.models.resnet50` as the headline Horovod number (SURVEY.md §6,
+BASELINE.json "ResNet-50 img/sec/chip").  This is a from-scratch TPU-first
+implementation, not a port: NHWC activations, HWIO kernels, bf16 compute
+path, f32 batch-norm statistics, stride-on-3x3 (the "v1.5" variant both
+torchvision and tf_cnn_benchmarks use).
+
+API:
+    variables = resnet50_init(key, num_classes=1000)
+    logits, new_stats = resnet_apply(variables, images, train=True)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+STAGE_SIZES = {
+    18: [2, 2, 2, 2],
+    34: [3, 4, 6, 3],
+    50: [3, 4, 6, 3],
+    101: [3, 4, 23, 3],
+    152: [3, 8, 36, 3],
+}
+BOTTLENECK = {18: False, 34: False, 50: True, 101: True, 152: True}
+STAGE_WIDTHS = [64, 128, 256, 512]
+
+
+def _block_init(key, in_ch: int, width: int, stride: int,
+                bottleneck: bool, dtype) -> Tuple[Dict, Dict, int]:
+    """One residual block. Returns (params, stats, out_ch)."""
+    keys = jax.random.split(key, 4)
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    out_ch = width * 4 if bottleneck else width
+    if bottleneck:
+        params["conv1"] = L.conv2d_init(keys[0], in_ch, width, 1, dtype)
+        params["conv2"] = L.conv2d_init(keys[1], width, width, 3, dtype)
+        params["conv3"] = L.conv2d_init(keys[2], width, out_ch, 1, dtype)
+        for i, ch in (("1", width), ("2", width), ("3", out_ch)):
+            params[f"bn{i}"], stats[f"bn{i}"] = L.batchnorm_init(ch, dtype)
+    else:
+        params["conv1"] = L.conv2d_init(keys[0], in_ch, width, 3, dtype)
+        params["conv2"] = L.conv2d_init(keys[1], width, out_ch, 3, dtype)
+        for i, ch in (("1", width), ("2", out_ch)):
+            params[f"bn{i}"], stats[f"bn{i}"] = L.batchnorm_init(ch, dtype)
+    if stride != 1 or in_ch != out_ch:
+        params["proj"] = L.conv2d_init(keys[3], in_ch, out_ch, 1, dtype)
+        params["bn_proj"], stats["bn_proj"] = L.batchnorm_init(out_ch, dtype)
+    return params, stats, out_ch
+
+
+def _block_apply(p, s, x, stride: int, bottleneck: bool, train: bool,
+                 compute_dtype, axis_name) -> Tuple[jnp.ndarray, Dict]:
+    ns: Dict[str, Any] = {}
+    residual = x
+    if bottleneck:
+        y = L.conv2d_apply(p["conv1"], x, 1, compute_dtype=compute_dtype)
+        y, ns["bn1"] = L.batchnorm_apply(p["bn1"], s["bn1"], y, train,
+                                         axis_name=axis_name)
+        y = jax.nn.relu(y)
+        # v1.5: stride on the 3x3, not the 1x1.
+        y = L.conv2d_apply(p["conv2"], y, stride, compute_dtype=compute_dtype)
+        y, ns["bn2"] = L.batchnorm_apply(p["bn2"], s["bn2"], y, train,
+                                         axis_name=axis_name)
+        y = jax.nn.relu(y)
+        y = L.conv2d_apply(p["conv3"], y, 1, compute_dtype=compute_dtype)
+        y, ns["bn3"] = L.batchnorm_apply(p["bn3"], s["bn3"], y, train,
+                                         axis_name=axis_name)
+    else:
+        y = L.conv2d_apply(p["conv1"], x, stride, compute_dtype=compute_dtype)
+        y, ns["bn1"] = L.batchnorm_apply(p["bn1"], s["bn1"], y, train,
+                                         axis_name=axis_name)
+        y = jax.nn.relu(y)
+        y = L.conv2d_apply(p["conv2"], y, 1, compute_dtype=compute_dtype)
+        y, ns["bn2"] = L.batchnorm_apply(p["bn2"], s["bn2"], y, train,
+                                         axis_name=axis_name)
+    if "proj" in p:
+        residual = L.conv2d_apply(p["proj"], x, stride,
+                                  compute_dtype=compute_dtype)
+        residual, ns["bn_proj"] = L.batchnorm_apply(
+            p["bn_proj"], s["bn_proj"], residual, train, axis_name=axis_name)
+    return jax.nn.relu(y + residual.astype(y.dtype)), ns
+
+
+def resnet_init(key, depth: int = 50, num_classes: int = 1000,
+                dtype=jnp.float32) -> Dict[str, Any]:
+    """Build {params, batch_stats} for a ResNet of the given depth."""
+    if depth not in STAGE_SIZES:
+        raise ValueError(f"Unsupported ResNet depth {depth}")
+    bottleneck = BOTTLENECK[depth]
+    sizes = STAGE_SIZES[depth]
+    keys = jax.random.split(key, 3)
+    params: Dict[str, Any] = {
+        "stem": L.conv2d_init(keys[0], 3, 64, 7, dtype),
+    }
+    stats: Dict[str, Any] = {}
+    params["bn_stem"], stats["bn_stem"] = L.batchnorm_init(64, dtype)
+
+    in_ch = 64
+    bkeys = jax.random.split(keys[1], sum(sizes))
+    ki = 0
+    for stage, (n_blocks, width) in enumerate(zip(sizes, STAGE_WIDTHS)):
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            name = f"stage{stage}_block{b}"
+            params[name], stats[name], in_ch = _block_init(
+                bkeys[ki], in_ch, width, stride, bottleneck, dtype)
+            ki += 1
+    params["head"] = L.dense_init(keys[2], in_ch, num_classes, dtype)
+    return {"params": params, "batch_stats": stats,
+            "config": {"depth": depth, "bottleneck": bottleneck,
+                       "sizes": tuple(sizes)}}
+
+
+def resnet_apply(variables: Dict[str, Any], x, train: bool = True,
+                 compute_dtype=jnp.bfloat16,
+                 axis_name: Optional[str] = None):
+    """Forward pass. x: (N, H, W, 3). Returns (logits_f32, new_batch_stats).
+
+    `axis_name` turns every batch-norm into a synchronized (cross-rank)
+    batch-norm when running inside shard_map — the TPU-native form of
+    horovod's SyncBatchNormalization.
+    """
+    p, s = variables["params"], variables["batch_stats"]
+    cfg = variables["config"]
+    bottleneck, sizes = cfg["bottleneck"], cfg["sizes"]
+    ns: Dict[str, Any] = {}
+    y = L.conv2d_apply(p["stem"], x, 2, compute_dtype=compute_dtype)
+    y, ns["bn_stem"] = L.batchnorm_apply(p["bn_stem"], s["bn_stem"], y,
+                                         train, axis_name=axis_name)
+    y = jax.nn.relu(y)
+    y = L.max_pool(y, 3, 2, padding="SAME")
+    for stage, n_blocks in enumerate(sizes):
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and stage > 0) else 1
+            name = f"stage{stage}_block{b}"
+            y, ns[name] = _block_apply(
+                p[name], s[name], y, stride, bottleneck, train,
+                compute_dtype, axis_name)
+    y = L.global_avg_pool(y)
+    logits = L.dense_apply(p["head"], y, compute_dtype=compute_dtype)
+    return logits.astype(jnp.float32), ns
+
+
+def resnet50_init(key, num_classes: int = 1000, dtype=jnp.float32):
+    return resnet_init(key, 50, num_classes, dtype)

@@ -254,15 +254,50 @@ def allreduce(
 
     Reference: EnqueueTensorAllreduce (operations.cc); op semantics incl.
     prescale/postscale follow collective_operations.cc ScaleBuffer.
+
+    Pytree inputs (dict/list/tuple, e.g. a gradient tree) are flattened and
+    reduced via `grouped_allreduce` (fused, dtype-bucketed) and the tree is
+    rebuilt — the natural JAX extension of the per-tensor reference API.
     """
     if op is None:
         op = Sum if average is False else Average
+    if isinstance(tensor, (dict, list, tuple)):
+        leaves, treedef = jax.tree_util.tree_flatten(tensor)
+        if op is Adasum:
+            red = [
+                allreduce(l, op=op, prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=process_set, axis_name=axis_name)
+                for l in leaves
+            ]
+        else:
+            red = grouped_allreduce(
+                leaves, op=op, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                process_set=process_set, axis_name=axis_name,
+            )
+        return jax.tree_util.tree_unflatten(treedef, red)
     if op is Adasum:
         from . import adasum as _adasum
 
-        return _adasum.adasum_allreduce(
+        # Adasum is nonlinear, so prescale must be applied to the inputs
+        # and postscale to the result (reference: ScaleBuffer brackets the
+        # op in collective_operations.cc).
+        if prescale_factor != 1.0:
+            if isinstance(tensor, PerRank):
+                tensor = PerRank([
+                    v * jnp.asarray(prescale_factor, v.dtype)
+                    for v in tensor.values
+                ])
+            else:
+                t = tensor if _is_tracer(tensor) else jnp.asarray(tensor)
+                tensor = t * jnp.asarray(prescale_factor, t.dtype)
+        out = _adasum.adasum_allreduce(
             tensor, process_set=process_set, axis_name=axis_name
         )
+        if postscale_factor != 1.0:
+            out = out * jnp.asarray(postscale_factor, out.dtype)
+        return out
 
     if _is_tracer(tensor):
         ax = axis_name or GLOBAL_AXIS
@@ -312,7 +347,9 @@ def grouped_allreduce(
     if not tensors:
         return []
 
-    if _is_tracer(tensors[0]):
+    # Any tracer leaf means we are inside jit: a grad tree can mix closed-
+    # over constants with tracers, and the eager path cannot handle tracers.
+    if any(_is_tracer(t) for t in tensors):
         ax = axis_name or GLOBAL_AXIS
         flat = [jnp.ravel(t).astype(jnp.result_type(t)) for t in tensors]
         sizes = [t.size for t in flat]
