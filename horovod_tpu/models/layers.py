@@ -195,7 +195,14 @@ def avg_pool(x, window: int, stride: int, padding="VALID"):
         x, 0.0, lax.add,
         (1, window, window, 1), (1, stride, stride, 1), padding,
     )
-    return s / (window * window)
+    if padding == "VALID":
+        return s / (window * window)
+    # SAME: divide by the per-position count of valid (non-pad) elements.
+    counts = lax.reduce_window(
+        jnp.ones_like(x), 0.0, lax.add,
+        (1, window, window, 1), (1, stride, stride, 1), padding,
+    )
+    return s / counts
 
 
 def global_avg_pool(x):

@@ -366,8 +366,9 @@ def grouped_allreduce(
             )
             offset = 0
             for i in idxs:
+                # jnp.shape: leaves may be Python scalars (no .shape attr).
                 out[i] = red[offset: offset + sizes[i]].reshape(
-                    tensors[i].shape
+                    jnp.shape(tensors[i])
                 )
                 offset += sizes[i]
         return out
