@@ -101,4 +101,9 @@ from .parallel.data_parallel import (  # noqa: F401
     shard_batch,
 )
 
+from .utils.timeline import (  # noqa: F401
+    start_timeline,
+    stop_timeline,
+)
+
 from . import elastic  # noqa: F401

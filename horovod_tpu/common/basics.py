@@ -140,10 +140,6 @@ class _GlobalState:
         self.local_size = len(self.local_device_ranks)
         global_set = ProcessSet(ranks=list(range(self.size)), mesh=mesh)
         self.process_set_table = ProcessSetTable(global_set)
-        # Set lazily by aux subsystems.
-        self.timeline = None
-        self.stall_inspector = None
-        self.parameter_manager = None
         self.elastic_enabled = False
 
 
@@ -209,6 +205,16 @@ def init(
             for ranks in process_sets:
                 add_process_set(ranks)
 
+        # Aux subsystems, env-gated like the reference (SURVEY.md §5):
+        # HOROVOD_TIMELINE / HOROVOD_STALL_CHECK_TIME_SECONDS.  Their
+        # single source of truth is the module-level handle in each module
+        # (timeline.get_timeline() / stall_inspector.get_inspector()).
+        from ..utils import stall_inspector as _stall_mod
+        from ..utils import timeline as _tl_mod
+
+        _tl_mod.init_from_env(rank())
+        _stall_mod.init_from_env()
+
         logger.info(
             "horovod_tpu initialized: size=%d local_size=%d process=%d/%d "
             "platform=%s",
@@ -233,8 +239,12 @@ def shutdown() -> None:
             return
         # Clear cached compiled collectives — they bake in the old mesh.
         from ..ops import collectives as _coll  # local import: avoid cycle
+        from ..utils import stall_inspector as _stall_mod
+        from ..utils import timeline as _tl_mod
 
         _coll.clear_caches()
+        _tl_mod.stop_timeline()
+        _stall_mod.shutdown_inspector()
         _global_state = None
 
 

@@ -49,6 +49,59 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..common import basics
 from ..common.basics import GLOBAL_AXIS, ProcessSet
 from ..common.exceptions import HorovodTpuError
+from ..utils import stall_inspector as _stall
+from ..utils import timeline as _tl
+
+
+class _traced:
+    """Timeline + stall-inspector bracket around one eager collective.
+
+    Reference analog: the per-tensor Timeline activities and the stall
+    inspector's submitted-tensor table (timeline.cc / stall_inspector.cc).
+    Overhead when both are disabled: two attribute loads and None checks.
+
+    JAX dispatch is async — the dispatch call returning does NOT mean the
+    collective completed on device.  So the bracket hands the dispatched
+    result to the stall inspector via `track(result)`; the watchdog then
+    polls `is_ready()` and clears the entry itself, which is what lets it
+    observe a collective hung on a dead peer.  The timeline event covers
+    host-side dispatch only (device-side timing belongs to jax.profiler).
+    """
+
+    __slots__ = ("_desc", "_si", "_key", "_tl", "_token", "_tracked")
+
+    def __init__(self, kind: str, name: Optional[str]):
+        self._desc = f"{kind}:{name}" if name else kind
+        self._tl = _tl.get_timeline()
+        self._si = _stall.get_inspector()
+        self._key = None
+        self._token = None
+        self._tracked = False
+
+    def __enter__(self):
+        if self._si is not None:
+            self._key = self._si.record_start(self._desc)
+        if self._tl is not None:
+            self._token = self._tl.activity_start(
+                self._desc, self._desc.split(":", 1)[0])
+        return self
+
+    def track(self, result):
+        """Keep the stall entry open until `result` is device-ready."""
+        if self._si is not None and self._key is not None:
+            self._si.record_result(self._key, result)
+            self._tracked = True
+        return result
+
+    def __exit__(self, exc_type, *exc):
+        if self._tl is not None and self._token is not None:
+            self._tl.activity_end(self._token)
+        if self._si is not None and self._key is not None:
+            # On exception, or when no result was handed over, close now;
+            # otherwise the watchdog owns the entry until readiness.
+            if exc_type is not None or not self._tracked:
+                self._si.record_end(self._key)
+        return False
 
 __all__ = [
     "Average", "Sum", "Min", "Max", "Product", "Adasum",
@@ -321,11 +374,12 @@ def allreduce(
         return out
 
     ps = _resolve_set(process_set)
-    xs, dtype = _make_global(tensor, ps)
-    program = _allreduce_program(ps, op)
-    pre = jnp.asarray(prescale_factor, jnp.float32)
-    post = jnp.asarray(postscale_factor, jnp.float32)
-    return program(xs, pre, post)
+    with _traced("ALLREDUCE", name) as tr:
+        xs, dtype = _make_global(tensor, ps)
+        program = _allreduce_program(ps, op)
+        pre = jnp.asarray(prescale_factor, jnp.float32)
+        post = jnp.asarray(postscale_factor, jnp.float32)
+        return tr.track(program(xs, pre, post))
 
 
 def grouped_allreduce(
@@ -454,7 +508,8 @@ def allgather(
         )
 
     program = _cached_program(("allgather", ps.process_set_id), build)
-    gathered = program(xs)
+    with _traced("ALLGATHER", name) as tr:
+        gathered = tr.track(program(xs))
     if all(s == max0 for s in sizes):
         return gathered
     # Slice out the padding (host-side, sizes are concrete).
@@ -478,7 +533,9 @@ def allgather_sizes(local_dim0: Sequence[int], ps: ProcessSet) -> List[int]:
         )
 
     program = _cached_program(("allgather_sizes", ps.process_set_id), build)
-    return [int(v) for v in np.asarray(program(xs))]
+    with _traced("ALLGATHER_SIZES", None):
+        # Blocking host fetch (displacement exchange) — bracket covers it.
+        return [int(v) for v in np.asarray(program(xs))]
 
 
 def grouped_allgather(
@@ -531,7 +588,8 @@ def broadcast(
         )
 
     program = _cached_program(("broadcast", ps.process_set_id), build)
-    return program(xs, jnp.asarray(root_rank, jnp.int32))
+    with _traced("BROADCAST", name) as tr:
+        return tr.track(program(xs, jnp.asarray(root_rank, jnp.int32)))
 
 
 # ---------------------------------------------------------------------------
@@ -591,7 +649,8 @@ def alltoall(
             )
 
         program = _cached_program(("alltoall", ps.process_set_id), build)
-        out = program(xs)
+        with _traced("ALLTOALL", name) as tr:
+            out = tr.track(program(xs))
         # Return this process's received rows, one per local rank.
         local = [r for r in basics.local_device_ranks() if r in ps.ranks]
         rows = [out[ps.ranks.index(r)] for r in local]
@@ -634,7 +693,11 @@ def alltoall(
         )
 
     program = _cached_program(("alltoallv", ps.process_set_id), build)
-    out = np.asarray(program(xs))
+    with _traced("ALLTOALL", name):
+        # np.asarray is a blocking device→host fetch: the bracket stays
+        # open across the genuinely-blocking part, so a hang here is
+        # visible to the watchdog without readiness tracking.
+        out = np.asarray(program(xs))
     local = [r for r in basics.local_device_ranks() if r in ps.ranks]
     results, rsplits = [], []
     for r in local:
@@ -662,7 +725,8 @@ def _alltoall_exchange_splits(splits_arr, ps: ProcessSet) -> List[List[int]]:
         )
 
     program = _cached_program(("alltoall_splits", ps.process_set_id), build)
-    table = np.asarray(program(xs))
+    with _traced("ALLTOALL_SPLITS", None):
+        table = np.asarray(program(xs))
     return [list(row) for row in table]
 
 
@@ -715,7 +779,8 @@ def reducescatter(
     program = _cached_program(
         ("reducescatter", ps.process_set_id, op.name), build
     )
-    out = program(xs)
+    with _traced("REDUCESCATTER", name) as tr:
+        out = tr.track(program(xs))
     local = [r for r in basics.local_device_ranks() if r in ps.ranks]
     rows = [out[ps.ranks.index(r)] for r in local]
     if isinstance(tensor, PerRank):
@@ -734,9 +799,10 @@ def grouped_reducescatter(tensors, op: ReduceOp = Average, **kw):
 def barrier(process_set: Optional[ProcessSet] = None) -> None:
     """Block until every rank reaches the barrier (reference: BarrierOp).
     Implemented as a 1-element allreduce + block_until_ready."""
-    out = allreduce(jnp.zeros((1,), jnp.int32), op=Sum,
-                    process_set=process_set)
-    jax.block_until_ready(out)
+    with _traced("BARRIER", None):
+        out = allreduce(jnp.zeros((1,), jnp.int32), op=Sum,
+                        process_set=process_set)
+        jax.block_until_ready(out)
 
 
 def join(process_set: Optional[ProcessSet] = None) -> int:

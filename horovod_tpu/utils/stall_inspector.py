@@ -1,0 +1,203 @@
+"""Stall / deadlock watchdog.
+
+Reference parity (SURVEY.md §2.1, §5):
+  - horovod/common/stall_inspector.cc `StallInspector::CheckForStalledTensors`
+    → `StallInspector.check()`
+  - env `HOROVOD_STALL_CHECK_TIME_SECONDS` (warn threshold, default 60),
+    `HOROVOD_STALL_SHUTDOWN_TIME_SECONDS` (abort threshold, default 0 =
+    disabled), `HOROVOD_STALL_CHECK_DISABLE=1`
+
+TPU-native redesign: the reference detects tensors submitted on some-but-
+not-all ranks during negotiation.  Under SPMD there is no negotiation to
+observe — the equivalent failure mode is a *blocking dispatch that never
+completes* (one host lags or died, so the compiled collective's ICI/DCN
+exchange stalls every other host) or an async handle that is never
+synchronized.  So the inspector watches *outstanding operations*: every
+eager collective registers on entry and deregisters on completion; a
+daemon watchdog thread reports operations pending past the warn threshold
+and (optionally) aborts the process past the shutdown threshold, exactly
+the two-tier policy of the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common import util
+
+logger = logging.getLogger("horovod_tpu.stall_inspector")
+
+
+class StallInspector:
+    """Watchdog over outstanding collective operations."""
+
+    def __init__(
+        self,
+        warn_time_seconds: float = 60.0,
+        shutdown_time_seconds: float = 0.0,
+        check_interval_seconds: float = 1.0,
+        warn_fn: Optional[Callable[[str], None]] = None,
+        abort_fn: Optional[Callable[[str], None]] = None,
+    ):
+        self.warn_time = warn_time_seconds
+        self.shutdown_time = shutdown_time_seconds
+        self.check_interval = check_interval_seconds
+        self._warn_fn = warn_fn or (lambda msg: logger.warning(msg))
+        self._abort_fn = abort_fn or self._default_abort
+        self._lock = threading.Lock()
+        # op key -> (description, start wall time, result-or-None).
+        # A None result means the op is closed explicitly by record_end;
+        # a jax result means the watchdog polls `is_ready()` and clears the
+        # entry itself — JAX dispatch is async, so returning from the
+        # dispatch call does NOT mean the collective completed.
+        self._pending: Dict[int, Tuple[str, float, object]] = {}
+        self._warned: set = set()
+        self._next_key = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _default_abort(msg: str) -> None:
+        # Reference behavior: the background thread shuts Horovod down,
+        # which surfaces as a fatal error in every framework op.  With no
+        # background runtime to unwind, fail the process hard so the
+        # launcher/elastic driver observes the exit (stall_inspector.cc's
+        # shutdown path has the same end state).
+        logger.error(msg)
+        os._exit(57)
+
+    # -- registration (hot path) -----------------------------------------
+    def record_start(self, description: str) -> int:
+        with self._lock:
+            key = self._next_key
+            self._next_key += 1
+            self._pending[key] = (description, time.time(), None)
+        return key
+
+    def record_end(self, key: int) -> None:
+        with self._lock:
+            self._pending.pop(key, None)
+            self._warned.discard(key)
+
+    def record_result(self, key: int, result: object) -> None:
+        """Convert `key` to readiness-tracked: the watchdog clears it once
+        every leaf of `result` reports `is_ready()` (the dispatch returned,
+        but the device-side collective may still be in flight or hung)."""
+        with self._lock:
+            entry = self._pending.get(key)
+            if entry is not None:
+                self._pending[key] = (entry[0], entry[1], result)
+
+    @staticmethod
+    def _result_ready(result: object) -> bool:
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(result):
+            if hasattr(leaf, "is_ready") and not leaf.is_ready():
+                return False
+        return True
+
+    def pending_ops(self) -> List[str]:
+        self._clear_ready()
+        with self._lock:
+            return [d for d, _, _ in self._pending.values()]
+
+    def _clear_ready(self) -> None:
+        with self._lock:
+            tracked = [
+                (k, r) for k, (_, _, r) in self._pending.items()
+                if r is not None
+            ]
+        for key, result in tracked:
+            if self._result_ready(result):
+                self.record_end(key)
+
+    # -- the check (reference: CheckForStalledTensors) --------------------
+    def check(self, now: Optional[float] = None) -> List[str]:
+        """Report newly-stalled ops; trigger abort if past shutdown_time.
+
+        Returns the list of descriptions warned about on this check (used
+        directly by tests; the watchdog thread calls this periodically).
+        """
+        self._clear_ready()
+        now = time.time() if now is None else now
+        warned_now: List[str] = []
+        worst: Optional[Tuple[str, float]] = None
+        with self._lock:
+            items = list(self._pending.items())
+        for key, (desc, start, _result) in items:
+            age = now - start
+            if age >= self.warn_time and key not in self._warned:
+                self._warned.add(key)
+                warned_now.append(desc)
+                self._warn_fn(
+                    f"One or more collectives stalled for {age:.0f}s: "
+                    f"[{desc}]. A rank may be lagging, dead, or running a "
+                    f"different program. Ranks pending: see launcher logs."
+                )
+            if worst is None or age > worst[1]:
+                worst = (desc, age)
+        if (
+            self.shutdown_time > 0
+            and worst is not None
+            and worst[1] >= self.shutdown_time
+        ):
+            self._abort_fn(
+                f"Collective [{worst[0]}] stalled for {worst[1]:.0f}s "
+                f">= HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
+                f"{self.shutdown_time:.0f}; aborting."
+            )
+        return warned_now
+
+    # -- watchdog thread ---------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-stall-inspector", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.check_interval):
+            self.check()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_inspector: Optional[StallInspector] = None
+
+
+def get_inspector() -> Optional[StallInspector]:
+    return _inspector
+
+
+def init_from_env() -> Optional[StallInspector]:
+    """Called by `hvd.init()`: honor the reference env surface."""
+    global _inspector
+    shutdown_inspector()
+    if util.env_bool("STALL_CHECK_DISABLE", False):
+        return None
+    warn = util.env_float("STALL_CHECK_TIME_SECONDS", 60.0)
+    shutdown = util.env_float("STALL_SHUTDOWN_TIME_SECONDS", 0.0)
+    _inspector = StallInspector(
+        warn_time_seconds=warn, shutdown_time_seconds=shutdown
+    )
+    _inspector.start()
+    return _inspector
+
+
+def shutdown_inspector() -> None:
+    global _inspector
+    if _inspector is not None:
+        _inspector.stop()
+        _inspector = None

@@ -1,0 +1,214 @@
+"""Chrome-trace timeline profiler.
+
+Reference parity (SURVEY.md §2.1, §5):
+  - horovod/common/timeline.cc/.h `Timeline` / `TimelineWriter` /
+    `TimelineController` → `Timeline` / `_TimelineWriter` here
+  - env `HOROVOD_TIMELINE=/path.json` enables it at `hvd.init()`;
+    `HOROVOD_TIMELINE_MARK_CYCLES=1` marks step cycles
+  - per-tensor phases NEGOTIATE→QUEUE→MEMCPY_IN_FUSION_BUFFER→
+    NCCL_ALLREDUCE→MEMCPY_OUT_FUSION_BUFFER become the TPU-native phases
+    ENQUEUE (host staging) → COMPILE (first-call trace+compile, the moral
+    analog of negotiation: it happens once per shape, not per step) →
+    EXECUTE (XLA program incl. the ICI collective)
+
+TPU-native redesign: the reference writes events from the background
+coordination thread as each tensor moves through negotiation and the fusion
+buffer.  Under SPMD those stages happen inside one compiled program, so the
+device-side story belongs to `jax.profiler` (perfetto); this timeline covers
+the *host-side control plane* — eager collective dispatch, compile hits, step
+cycles, elastic events — in the same Chrome ``chrome://tracing`` JSON format
+the reference emits, so the two traces can be viewed with the same tooling.
+
+The writer mirrors the reference design: events are appended to an in-memory
+queue by the hot path (no IO), and a dedicated writer thread drains it to
+disk (`TimelineWriter` with its short-circuit buffer, timeline.cc).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+from ..common import util
+
+
+class _TimelineWriter:
+    """Background thread draining event records to a Chrome-trace JSON file.
+
+    Reference: timeline.cc `TimelineWriter` — own thread, lock-free-ish
+    handoff.  We use a `queue.Queue`; the hot path only does `put_nowait`.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-timeline-writer", daemon=True
+        )
+        self._healthy = True
+        self._thread.start()
+
+    def enqueue(self, record: dict) -> None:
+        if self._healthy:
+            self._queue.put_nowait(record)
+
+    def _run(self) -> None:
+        try:
+            with open(self.filename, "w") as f:
+                # Chrome trace "JSON Array Format": open bracket, one event
+                # per line; readers accept a missing close bracket, so the
+                # file is valid even if the process dies mid-run (same
+                # property the reference relies on).
+                f.write("[\n")
+                first = True
+                while True:
+                    rec = self._queue.get()
+                    if rec is _TimelineWriter._SENTINEL:
+                        break
+                    if not first:
+                        f.write(",\n")
+                    # default=str: event args may carry numpy/jax scalars.
+                    f.write(json.dumps(rec, default=str))
+                    first = False
+                    f.flush()
+                f.write("\n]\n")
+        except Exception:
+            # Mark unhealthy so the hot path stops feeding a dead writer
+            # (otherwise the queue grows unboundedly).
+            self._healthy = False
+
+    def close(self) -> None:
+        if self._thread.is_alive():
+            self._queue.put(_TimelineWriter._SENTINEL)
+            self._thread.join(timeout=5)
+
+
+class Timeline:
+    """Per-process timeline of control-plane activities.
+
+    Chrome-trace mapping: pid = global rank, tid = tensor/activity name.
+    Complete events (`ph="X"`) are emitted on activity end so each phase is
+    a single record (the reference emits B/E pairs; X halves the volume).
+    """
+
+    def __init__(self, filename: str, rank: int = 0,
+                 mark_cycles: bool = False):
+        self._writer = _TimelineWriter(filename)
+        self._rank = rank
+        self._mark_cycles = mark_cycles
+        # token -> (tensor_name, activity, start_us); tokens are unique per
+        # bracket so concurrent unnamed collectives never collide.
+        self._starts: dict = {}
+        self._next_token = 0
+        self._lock = threading.Lock()
+        self._cycle = 0
+        self._t0 = time.perf_counter()
+
+    # -- clock ------------------------------------------------------------
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    # -- per-tensor activities (reference: ActivityStart/ActivityEnd) -----
+    def activity_start(self, tensor_name: str, activity: str) -> int:
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            self._starts[token] = (tensor_name, activity, self._now_us())
+        return token
+
+    def activity_end(self, token: int) -> None:
+        now = self._now_us()
+        with self._lock:
+            entry = self._starts.pop(token, None)
+        if entry is None:
+            return
+        tensor_name, activity, start = entry
+        self._writer.enqueue({
+            "name": activity,
+            "cat": "collective",
+            "ph": "X",
+            "ts": round(start, 1),
+            "dur": round(now - start, 1),
+            "pid": self._rank,
+            "tid": tensor_name,
+        })
+
+    # -- instant events ---------------------------------------------------
+    def instant(self, name: str, category: str = "event",
+                args: Optional[dict] = None) -> None:
+        self._writer.enqueue({
+            "name": name,
+            "cat": category,
+            "ph": "i",
+            "s": "p",
+            "ts": round(self._now_us(), 1),
+            "pid": self._rank,
+            "tid": category,
+            **({"args": args} if args else {}),
+        })
+
+    # -- cycle marks (reference: HOROVOD_TIMELINE_MARK_CYCLES) ------------
+    def mark_cycle(self) -> None:
+        if not self._mark_cycles:
+            return
+        self._cycle += 1
+        self.instant(f"CYCLE_{self._cycle}", category="cycle")
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+# ---------------------------------------------------------------------------
+# Module-level hooks used by the collectives hot path.  Kept as a plain
+# global so the disabled-case check is one attribute load (the reference
+# guards every Timeline call on `timeline_enabled_`).
+# ---------------------------------------------------------------------------
+
+_timeline: Optional[Timeline] = None
+
+
+def get_timeline() -> Optional[Timeline]:
+    return _timeline
+
+
+def start_timeline(filename: str, rank: int = 0,
+                   mark_cycles: Optional[bool] = None) -> Timeline:
+    """Programmatic start (reference: horovod_start_timeline API)."""
+    global _timeline
+    stop_timeline()
+    if mark_cycles is None:
+        mark_cycles = util.env_bool("TIMELINE_MARK_CYCLES", False)
+    _timeline = Timeline(filename, rank=rank, mark_cycles=mark_cycles)
+    return _timeline
+
+
+def stop_timeline() -> None:
+    global _timeline
+    if _timeline is not None:
+        _timeline.close()
+        _timeline = None
+
+
+def init_from_env(rank: int) -> None:
+    """Called by `hvd.init()`: honor HOROVOD_TIMELINE like the reference.
+
+    Like the reference, only rank 0 writes (timeline.cc gates on rank)
+    unless HOROVOD_TIMELINE_ALL_RANKS is set, in which case the filename
+    gets a per-rank suffix.
+    """
+    fname = util.getenv("TIMELINE")
+    if not fname:
+        return
+    all_ranks = util.env_bool("TIMELINE_ALL_RANKS", False)
+    if rank != 0 and not all_ranks:
+        return
+    if all_ranks and rank != 0:
+        base, ext = os.path.splitext(fname)
+        fname = f"{base}.rank{rank}{ext or '.json'}"
+    start_timeline(fname, rank=rank)

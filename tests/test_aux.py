@@ -1,0 +1,174 @@
+"""Timeline + stall inspector tests (reference: timeline.cc behavior via
+docs/timeline.rst; stall_inspector.cc via the framework tests that assert
+stall warnings — SURVEY.md §5).
+"""
+
+import json
+import time
+
+import jax.numpy as jnp
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.utils import stall_inspector as stall_mod
+from horovod_tpu.utils import timeline as tl_mod
+
+
+# ---------------------------------------------------------------------------
+# Timeline
+# ---------------------------------------------------------------------------
+
+def _read_trace(path):
+    text = path.read_text()
+    # Writer emits valid JSON once closed.
+    return json.loads(text)
+
+
+def test_timeline_records_collectives(tmp_path):
+    f = tmp_path / "timeline.json"
+    hvd.start_timeline(str(f))
+    try:
+        hvd.allreduce(jnp.ones((4,)), name="grad.w")
+        hvd.allgather(jnp.ones((2, 2)), name="gath")
+        hvd.broadcast(jnp.ones((3,)), root_rank=1, name="bc")
+    finally:
+        hvd.stop_timeline()
+    events = _read_trace(f)
+    names = {(e["name"], e["tid"]) for e in events}
+    assert ("ALLREDUCE", "ALLREDUCE:grad.w") in names
+    assert ("ALLGATHER", "ALLGATHER:gath") in names
+    assert ("BROADCAST", "BROADCAST:bc") in names
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["dur"] >= 0
+
+
+def test_timeline_mark_cycles_and_instants(tmp_path):
+    f = tmp_path / "cyc.json"
+    tl = tl_mod.start_timeline(str(f), mark_cycles=True)
+    tl.mark_cycle()
+    tl.mark_cycle()
+    tl.instant("host_update", category="elastic", args={"np": 4})
+    tl_mod.stop_timeline()
+    events = _read_trace(f)
+    cycles = [e for e in events if e["cat"] == "cycle"]
+    assert [e["name"] for e in cycles] == ["CYCLE_1", "CYCLE_2"]
+    inst = [e for e in events if e["cat"] == "elastic"]
+    assert inst[0]["args"] == {"np": 4}
+
+
+def test_timeline_env_gating(tmp_path, monkeypatch):
+    # Non-zero rank without ALL_RANKS: no timeline.
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(tmp_path / "t.json"))
+    tl_mod.stop_timeline()
+    tl_mod.init_from_env(rank=3)
+    assert tl_mod.get_timeline() is None
+    # Rank 0: enabled.
+    tl_mod.init_from_env(rank=0)
+    assert tl_mod.get_timeline() is not None
+    tl_mod.stop_timeline()
+    # ALL_RANKS: per-rank suffix.
+    monkeypatch.setenv("HOROVOD_TIMELINE_ALL_RANKS", "1")
+    tl_mod.init_from_env(rank=2)
+    tl = tl_mod.get_timeline()
+    assert tl is not None and "rank2" in tl._writer.filename
+    tl_mod.stop_timeline()
+
+
+# ---------------------------------------------------------------------------
+# Stall inspector
+# ---------------------------------------------------------------------------
+
+def test_stall_inspector_warns_once_per_op():
+    warnings = []
+    si = stall_mod.StallInspector(
+        warn_time_seconds=0.05, warn_fn=warnings.append
+    )
+    key = si.record_start("ALLREDUCE:grad.w")
+    assert si.check() == []          # not yet stalled
+    time.sleep(0.06)
+    assert si.check() == ["ALLREDUCE:grad.w"]
+    assert si.check() == []          # warn exactly once (reference behavior)
+    assert "ALLREDUCE:grad.w" in warnings[0]
+    si.record_end(key)
+    assert si.pending_ops() == []
+
+
+def test_stall_inspector_shutdown_threshold():
+    aborted = []
+    si = stall_mod.StallInspector(
+        warn_time_seconds=0.01,
+        shutdown_time_seconds=0.05,
+        warn_fn=lambda m: None,
+        abort_fn=aborted.append,
+    )
+    si.record_start("BARRIER")
+    time.sleep(0.06)
+    si.check()
+    assert aborted and "BARRIER" in aborted[0]
+
+
+def test_stall_inspector_watchdog_thread():
+    warnings = []
+    si = stall_mod.StallInspector(
+        warn_time_seconds=0.02,
+        check_interval_seconds=0.01,
+        warn_fn=warnings.append,
+    )
+    si.start()
+    si.record_start("ALLGATHER:x")
+    time.sleep(0.2)
+    si.stop()
+    assert warnings
+
+
+def test_stall_inspector_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_DISABLE", "1")
+    assert stall_mod.init_from_env() is None
+    monkeypatch.delenv("HOROVOD_STALL_CHECK_DISABLE")
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "5")
+    si = stall_mod.init_from_env()
+    assert si is not None and si.warn_time == 5.0
+    stall_mod.shutdown_inspector()
+
+
+class _FakeResult:
+    """Mimics a jax.Array still in flight on device."""
+
+    def __init__(self):
+        self.ready = False
+
+    def is_ready(self):
+        return self.ready
+
+
+def test_stall_inspector_tracks_async_results():
+    # A dispatched-but-not-completed collective must stay visible: JAX
+    # dispatch returns before the device-side collective finishes.
+    warnings = []
+    si = stall_mod.StallInspector(
+        warn_time_seconds=0.05, warn_fn=warnings.append
+    )
+    key = si.record_start("ALLREDUCE:hung")
+    result = _FakeResult()
+    si.record_result(key, result)
+    assert si.pending_ops() == ["ALLREDUCE:hung"]
+    time.sleep(0.06)
+    assert si.check() == ["ALLREDUCE:hung"]   # still in flight → warned
+    result.ready = True
+    assert si.pending_ops() == []             # watchdog clears it itself
+
+
+def test_collectives_register_with_inspector():
+    si = stall_mod.StallInspector(warn_time_seconds=60.0)
+    stall_mod._inspector = si
+    try:
+        out = hvd.allreduce(jnp.ones((2,)))
+        # In-flight dispatch stays visible until device-ready...
+        import jax
+
+        jax.block_until_ready(out)
+        # ...and clears once the result is ready.
+        assert si.pending_ops() == []
+    finally:
+        stall_mod._inspector = None
