@@ -1,0 +1,5 @@
+"""`python -m horovod_tpu.runner` — same entry as `horovodrun_tpu`."""
+
+from .launch import main
+
+main()

@@ -1,0 +1,97 @@
+"""Python launch API (reference: horovod/runner/__init__.py `run()`).
+
+`run(func, args=(), np=2, ...)` executes `func` on every worker process
+and returns the per-rank results in rank order, like the reference's
+in-process API (which pickles the function to workers over the task
+service).  Here the function ships via a pickle file and results return
+through the rendezvous KV store before the server shuts down.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import pickle
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional
+
+from ..common.exceptions import HorovodTpuError
+from . import hosts as hosts_mod
+from .exec_run import exec_run
+from .settings import Settings
+
+_WORKER_SNIPPET = """\
+import base64, os, pickle, sys
+extra_path = os.environ.get("HVD_TPU_RUN_FUNC_PATH")
+if extra_path:
+    sys.path.insert(0, extra_path)
+with open(os.environ["HVD_TPU_RUN_FUNC_FILE"], "rb") as f:
+    func, args, kwargs = pickle.load(f)
+result = func(*args, **kwargs)
+from horovod_tpu.runner.rendezvous import RendezvousClient
+client = RendezvousClient(
+    os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+    int(os.environ["HOROVOD_RENDEZVOUS_PORT"]),
+    os.environ["HOROVOD_SECRET_KEY"])
+client.put("runfunc/result/" + os.environ["HOROVOD_RANK"],
+           base64.b64encode(pickle.dumps(result)).decode())
+"""
+
+
+def run(
+    func: Callable,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    np: int = 1,
+    hosts: Optional[str] = None,
+    verbose: int = 0,
+    extra_env: Optional[dict] = None,
+    start_timeout: float = 120.0,
+) -> List[Any]:
+    """Run `func(*args, **kwargs)` on `np` workers; return results by rank."""
+    host_list = (hosts_mod.parse_hosts(hosts) if hosts
+                 else [hosts_mod.HostInfo("localhost", np)])
+    slots = hosts_mod.get_host_assignments(host_list, np)
+
+    with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as f:
+        pickle.dump((func, args, kwargs or {}), f)
+        func_file = f.name
+    env = dict(extra_env or {})
+    env["HVD_TPU_RUN_FUNC_FILE"] = func_file
+    # Pickle serializes `func` by module reference; make its defining
+    # module importable in the workers (reference ships the function over
+    # the task service instead).
+    try:
+        import inspect
+        env["HVD_TPU_RUN_FUNC_PATH"] = os.path.dirname(
+            os.path.abspath(inspect.getfile(func)))
+    except TypeError:
+        pass
+
+    settings = Settings(
+        num_proc=np, hosts=host_list, verbose=verbose, extra_env=env,
+        start_timeout=start_timeout,
+        command=[sys.executable, "-c", _WORKER_SNIPPET],
+    )
+
+    results: List[Any] = [None] * np
+    missing: List[int] = []
+
+    def collect(server):
+        for r in range(np):
+            val = server.store.get(f"runfunc/result/{r}")
+            if val is None:
+                missing.append(r)
+            else:
+                results[r] = pickle.loads(base64.b64decode(val))
+
+    try:
+        rc = exec_run(settings, slots, result_hook=collect)
+    finally:
+        os.unlink(func_file)
+    if rc != 0:
+        raise HorovodTpuError(f"run() workers failed with exit code {rc}")
+    if missing:
+        raise HorovodTpuError(f"run(): no result from ranks {missing}")
+    return results

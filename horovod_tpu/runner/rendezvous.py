@@ -1,0 +1,323 @@
+"""Rendezvous: an HMAC-authenticated TCP key-value store with barriers.
+
+Reference parity: horovod/runner/http/http_server.py (`RendezvousServer`,
+the KV store the Gloo controller rendezvouses against) plus
+runner/common/service/network.py's HMAC-signed message envelope.
+
+TPU-native role: XLA collectives need no negotiation, so this store only
+carries the *control plane* — worker registration, elastic membership,
+barriers, health beacons, and stall reports — over DCN.  The data plane
+never touches it.
+
+Wire protocol (one request per line, newline-terminated):
+    <hmac_sha256_hex(secret, payload)> <base64(payload)>\n
+payload = JSON {"op": PUT|GET|WAIT|DEL|KEYS|BARRIER|PING|SHUTDOWN, ...}.
+Responses use the same envelope.  The protocol is deliberately trivial so
+the C++ control-plane server (`horovod_tpu._native`) can speak it
+byte-for-byte; `RendezvousServer` prefers the native engine when built.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import logging
+import secrets as _secrets
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..common.exceptions import HorovodTpuError
+
+logger = logging.getLogger("horovod_tpu.runner.rendezvous")
+
+
+def new_secret() -> str:
+    """Reference: horovod/runner/common/util/secret.py make_secret_key."""
+    return _secrets.token_hex(16)
+
+
+def _sign(secret: str, payload: bytes) -> str:
+    return hmac.new(secret.encode(), payload, hashlib.sha256).hexdigest()
+
+
+def _encode(secret: str, obj: dict) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    return (_sign(secret, payload) + " "
+            + base64.b64encode(payload).decode() + "\n").encode()
+
+
+def _decode(secret: str, line: bytes) -> dict:
+    try:
+        sig, b64 = line.strip().split(b" ", 1)
+        payload = base64.b64decode(b64)
+    except Exception as e:
+        raise HorovodTpuError(f"Malformed rendezvous message: {e}") from e
+    if not hmac.compare_digest(sig.decode(), _sign(secret, payload)):
+        raise HorovodTpuError("Rendezvous message failed HMAC verification")
+    return json.loads(payload)
+
+
+class KVStore:
+    """The in-memory store + barrier table (shared by the Python server;
+    the C++ engine keeps its own equivalent)."""
+
+    def __init__(self):
+        self._data: Dict[str, str] = {}
+        self._cv = threading.Condition()
+        # barrier name -> (generation, arrived_count)
+        self._barriers: Dict[str, Tuple[int, int]] = {}
+
+    def put(self, key: str, value: str) -> None:
+        with self._cv:
+            self._data[key] = value
+            self._cv.notify_all()
+
+    def get(self, key: str) -> Optional[str]:
+        with self._cv:
+            return self._data.get(key)
+
+    def wait(self, key: str, timeout: float) -> Optional[str]:
+        deadline = time.time() + timeout
+        with self._cv:
+            while key not in self._data:
+                remaining = deadline - time.time()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    return None
+            return self._data[key]
+
+    def delete(self, key: str) -> bool:
+        with self._cv:
+            return self._data.pop(key, None) is not None
+
+    def keys(self, prefix: str = "") -> List[str]:
+        with self._cv:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+    def barrier(self, name: str, count: int, timeout: float) -> bool:
+        """Block until `count` callers reach barrier `name`.  Generation
+        counter makes the barrier reusable (successive barriers with the
+        same name don't bleed into each other)."""
+        deadline = time.time() + timeout
+        with self._cv:
+            gen, arrived = self._barriers.get(name, (0, 0))
+            arrived += 1
+            my_gen = gen
+            if arrived >= count:
+                self._barriers[name] = (gen + 1, 0)
+                self._cv.notify_all()
+                return True
+            self._barriers[name] = (gen, arrived)
+            while True:
+                cur_gen, _ = self._barriers.get(name, (0, 0))
+                if cur_gen > my_gen:
+                    return True
+                remaining = deadline - time.time()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    # Withdraw our arrival so a failed barrier can retry.
+                    g, a = self._barriers.get(name, (0, 0))
+                    if g == my_gen and a > 0:
+                        self._barriers[name] = (g, a - 1)
+                    return False
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        server: "RendezvousServer" = self.server.owner  # type: ignore
+        try:
+            for line in self.rfile:
+                if not line.strip():
+                    continue
+                try:
+                    req = _decode(server.secret, line)
+                except HorovodTpuError as e:
+                    self.wfile.write(_encode(server.secret,
+                                             {"ok": False, "error": str(e)}))
+                    return
+                resp = server.handle_request(req)
+                self.wfile.write(_encode(server.secret, resp))
+                self.wfile.flush()
+                if req.get("op") == "SHUTDOWN":
+                    return
+        except (ConnectionError, BrokenPipeError):
+            pass
+
+
+class _ThreadedTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class RendezvousServer:
+    """Control-plane server run by the launcher (reference:
+    RendezvousServer in runner/http/http_server.py).
+
+    Uses the C++ engine from `horovod_tpu._native` when available (same
+    wire protocol), falling back to the threaded Python server.
+    """
+
+    def __init__(self, secret: Optional[str] = None, verbose: int = 0,
+                 prefer_native: bool = True):
+        self.secret = secret or new_secret()
+        self.verbose = verbose
+        self.store = KVStore()
+        self._server: Optional[_ThreadedTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._native = None
+        self._port: Optional[int] = None
+        self._prefer_native = prefer_native
+
+    # -- request dispatch (shared with tests; mirrors the C++ engine) ----
+    def handle_request(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "PUT":
+            self.store.put(req["key"], req["value"])
+            return {"ok": True}
+        if op == "GET":
+            val = self.store.get(req["key"])
+            return {"ok": True, "value": val}
+        if op == "WAIT":
+            val = self.store.wait(req["key"], float(req.get("timeout", 30)))
+            if val is None:
+                return {"ok": False, "error": f"timeout waiting {req['key']}"}
+            return {"ok": True, "value": val}
+        if op == "DEL":
+            return {"ok": self.store.delete(req["key"])}
+        if op == "KEYS":
+            return {"ok": True, "keys": self.store.keys(req.get("prefix", ""))}
+        if op == "BARRIER":
+            ok = self.store.barrier(req["name"], int(req["count"]),
+                                    float(req.get("timeout", 30)))
+            return {"ok": ok} if ok else {"ok": False, "error": "barrier timeout"}
+        if op == "PING":
+            return {"ok": True, "value": "pong"}
+        if op == "SHUTDOWN":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def start(self, port: int = 0) -> int:
+        """Start serving; returns the bound port."""
+        if self._prefer_native:
+            try:
+                from .._native import control_plane as _cp
+                self._native = _cp.NativeRendezvousServer(self.secret)
+                self._port = self._native.start(port)
+                logger.info("native rendezvous server on port %d", self._port)
+                return self._port
+            except Exception as e:  # fall back to Python implementation
+                logger.debug("native control plane unavailable (%s)", e)
+                self._native = None
+        self._server = _ThreadedTCPServer(("0.0.0.0", port), _Handler)
+        self._server.owner = self  # type: ignore
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        logger.info("rendezvous server on port %d", self._port)
+        return self._port
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._port
+
+    def stop(self) -> None:
+        if self._native is not None:
+            self._native.stop()
+            self._native = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class RendezvousClient:
+    """Worker-side client (reference: runner/http/http_client.py).
+
+    One short-lived connection per request; retries with backoff so
+    workers can start before the server."""
+
+    def __init__(self, addr: str, port: int, secret: str,
+                 connect_retries: int = 3):
+        self.addr = addr
+        self.port = port
+        self.secret = secret
+        self.connect_retries = connect_retries
+
+    def _request(self, req: dict, timeout: float = 60.0) -> dict:
+        # Retry only the *connection*; once the request is on the wire it
+        # may have been delivered, and re-sending a non-idempotent op
+        # (BARRIER arrival, PUT) would double-count it.
+        last_err: Optional[Exception] = None
+        sock = None
+        for attempt in range(self.connect_retries):
+            try:
+                sock = socket.create_connection(
+                    (self.addr, self.port), timeout=timeout)
+                break
+            except (ConnectionError, socket.timeout, OSError) as e:
+                last_err = e
+                time.sleep(0.5 * (attempt + 1))
+        if sock is None:
+            raise HorovodTpuError(
+                f"Cannot reach rendezvous server {self.addr}:{self.port}: "
+                f"{last_err}")
+        try:
+            with sock:
+                sock.sendall(_encode(self.secret, req))
+                f = sock.makefile("rb")
+                line = f.readline()
+                if not line:
+                    raise ConnectionError("empty rendezvous response")
+                return _decode(self.secret, line)
+        except (ConnectionError, socket.timeout, OSError) as e:
+            raise HorovodTpuError(
+                f"Rendezvous request {req.get('op')} to "
+                f"{self.addr}:{self.port} failed mid-flight: {e}") from e
+
+    def put(self, key: str, value: str) -> None:
+        resp = self._request({"op": "PUT", "key": key, "value": value})
+        if not resp.get("ok"):
+            raise HorovodTpuError(resp.get("error", "PUT failed"))
+
+    def get(self, key: str) -> Optional[str]:
+        resp = self._request({"op": "GET", "key": key})
+        return resp.get("value")
+
+    def wait(self, key: str, timeout: float = 30.0) -> str:
+        resp = self._request({"op": "WAIT", "key": key, "timeout": timeout},
+                             timeout=timeout + 10)
+        if not resp.get("ok"):
+            raise HorovodTpuError(resp.get("error", f"WAIT {key} failed"))
+        return resp["value"]
+
+    def delete(self, key: str) -> bool:
+        return bool(self._request({"op": "DEL", "key": key}).get("ok"))
+
+    def keys(self, prefix: str = "") -> List[str]:
+        return self._request({"op": "KEYS", "prefix": prefix}).get("keys", [])
+
+    def barrier(self, name: str, count: int, timeout: float = 30.0) -> None:
+        resp = self._request(
+            {"op": "BARRIER", "name": name, "count": count,
+             "timeout": timeout},
+            timeout=timeout + 10)
+        if not resp.get("ok"):
+            raise HorovodTpuError(
+                resp.get("error", f"barrier {name} failed"))
+
+    def ping(self) -> bool:
+        try:
+            return self._request({"op": "PING"}).get("value") == "pong"
+        except HorovodTpuError:
+            return False
+
+    def shutdown_server(self) -> None:
+        try:
+            self._request({"op": "SHUTDOWN"})
+        except HorovodTpuError:
+            pass

@@ -1,0 +1,311 @@
+"""Runner/launcher tests.
+
+Mirrors the reference's test/single/test_run.py strategy (SURVEY.md §4):
+parse_args flag surface, host parsing, get_host_assignments rank math,
+rendezvous KV semantics (reference test_http_server.py), and real
+multi-process launches over localhost.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.runner import (
+    HostInfo,
+    parse_hosts,
+    parse_hostfile,
+    get_host_assignments,
+)
+from horovod_tpu.runner.launch import check_build, make_settings, parse_args
+from horovod_tpu.runner.rendezvous import (
+    RendezvousClient,
+    RendezvousServer,
+    new_secret,
+)
+
+
+# ---------------------------------------------------------------------------
+# Host parsing (reference: test_run.py host parsing cases)
+# ---------------------------------------------------------------------------
+
+class TestHosts:
+    def test_parse_hosts(self):
+        hosts = parse_hosts("a:2,b:4")
+        assert hosts == [HostInfo("a", 2), HostInfo("b", 4)]
+
+    def test_parse_hosts_invalid(self):
+        for bad in ("a", "a:", ":2", "a:2:3", "a:x", ""):
+            with pytest.raises(HorovodTpuError):
+                parse_hosts(bad)
+
+    def test_parse_hosts_duplicate(self):
+        with pytest.raises(HorovodTpuError):
+            parse_hosts("a:2,a:2")
+
+    def test_parse_hostfile(self, tmp_path):
+        hf = tmp_path / "hosts"
+        hf.write_text(
+            "# comment\n"
+            "node1 slots=2\n"
+            "node2 4\n"
+            "node3\n"
+            "\n"
+        )
+        hosts = parse_hostfile(str(hf))
+        assert hosts == [HostInfo("node1", 2), HostInfo("node2", 4),
+                         HostInfo("node3", 1)]
+
+    def test_assignments_basic(self):
+        slots = get_host_assignments(parse_hosts("a:2,b:2"), 4)
+        assert [(s.hostname, s.rank, s.local_rank, s.cross_rank)
+                for s in slots] == [
+            ("a", 0, 0, 0), ("a", 1, 1, 0), ("b", 2, 0, 1), ("b", 3, 1, 1)]
+        for s in slots:
+            assert s.size == 4 and s.local_size == 2 and s.cross_size == 2
+
+    def test_assignments_uneven(self):
+        # Reference rank math: cross_size per local_rank column.
+        slots = get_host_assignments(parse_hosts("a:1,b:2"), 3)
+        a0, b0, b1 = slots
+        assert (a0.hostname, a0.rank, a0.local_rank) == ("a", 0, 0)
+        assert (b0.hostname, b0.rank, b0.local_rank) == ("b", 1, 0)
+        assert (b1.hostname, b1.rank, b1.local_rank) == ("b", 2, 1)
+        assert a0.cross_size == 2 and b0.cross_size == 2
+        assert b1.cross_size == 1 and b1.cross_rank == 0
+        assert a0.local_size == 1 and b0.local_size == 2
+
+    def test_assignments_insufficient(self):
+        with pytest.raises(HorovodTpuError):
+            get_host_assignments(parse_hosts("a:1"), 2)
+
+    def test_assignments_max_np(self):
+        slots = get_host_assignments(parse_hosts("a:4"), 1, max_np=2)
+        assert len(slots) == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI arg surface (reference: test_run.py parse_args cases)
+# ---------------------------------------------------------------------------
+
+class TestParseArgs:
+    def test_minimal(self):
+        args = parse_args(["-np", "2", "python", "train.py"])
+        assert args.np == 2
+        assert args.command == ["python", "train.py"]
+
+    def test_full_surface(self):
+        args = parse_args([
+            "-np", "8", "-H", "a:4,b:4", "--timeline-filename", "/tmp/t.json",
+            "--fusion-threshold-mb", "32", "--cycle-time-ms", "3.5",
+            "--cache-capacity", "2048", "--autotune",
+            "--autotune-log-file", "/tmp/at.csv", "--verbose",
+            "--start-timeout", "60", "--output-filename", "/tmp/logs",
+            "--log-level", "DEBUG", "python", "train.py", "--lr", "0.1",
+        ])
+        s = make_settings(args)
+        assert s.num_proc == 8
+        assert [h.hostname for h in s.hosts] == ["a", "b"]
+        assert s.timeline_filename == "/tmp/t.json"
+        assert s.fusion_threshold_mb == 32
+        assert s.cycle_time_ms == 3.5
+        assert s.cache_capacity == 2048
+        assert s.autotune and s.autotune_log_file == "/tmp/at.csv"
+        assert s.command == ["python", "train.py", "--lr", "0.1"]
+
+    def test_elastic_flags(self):
+        args = parse_args([
+            "--min-np", "2", "--max-np", "4",
+            "--host-discovery-script", "/tmp/discover.sh", "--slots", "1",
+            "python", "train.py"])
+        s = make_settings(args)
+        assert s.elastic
+        assert s.min_np == 2 and s.max_np == 4 and s.slots_per_host == 1
+
+    def test_backend_selectors_accepted(self):
+        # --gloo/--mpi accepted for drop-in compat, ignored.
+        args = parse_args(["-np", "2", "--gloo", "python", "x.py"])
+        assert args.np == 2
+
+    def test_check_build_output(self):
+        out = check_build()
+        assert "XLA collectives" in out
+        assert "elastic" in out and "adasum" in out
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous KV store (reference: test_http_server.py)
+# ---------------------------------------------------------------------------
+
+class TestRendezvous:
+    @pytest.fixture()
+    def server(self):
+        srv = RendezvousServer(prefer_native=False)
+        port = srv.start()
+        yield srv, port
+        srv.stop()
+
+    def _client(self, server):
+        srv, port = server
+        return RendezvousClient("127.0.0.1", port, srv.secret)
+
+    def test_put_get(self, server):
+        c = self._client(server)
+        assert c.get("missing") is None
+        c.put("k", "v")
+        assert c.get("k") == "v"
+        c.put("k", "v2")
+        assert c.get("k") == "v2"
+
+    def test_delete_keys(self, server):
+        c = self._client(server)
+        c.put("a/1", "x")
+        c.put("a/2", "y")
+        c.put("b/1", "z")
+        assert c.keys("a/") == ["a/1", "a/2"]
+        assert c.delete("a/1")
+        assert not c.delete("a/1")
+        assert c.keys("a/") == ["a/2"]
+
+    def test_wait_blocks_until_put(self, server):
+        c = self._client(server)
+        result = {}
+
+        def waiter():
+            result["v"] = c.wait("later", timeout=10)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        c.put("later", "arrived")
+        t.join(timeout=10)
+        assert result["v"] == "arrived"
+
+    def test_wait_timeout(self, server):
+        c = self._client(server)
+        with pytest.raises(HorovodTpuError):
+            c.wait("never", timeout=0.3)
+
+    def test_barrier(self, server):
+        c = self._client(server)
+        n, reached = 3, []
+
+        def enter(i):
+            self._client(server).barrier("b1", n, timeout=10)
+            reached.append(i)
+
+        threads = [threading.Thread(target=enter, args=(i,))
+                   for i in range(n - 1)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        assert reached == []  # nobody through until the last arrives
+        c.barrier("b1", n, timeout=10)
+        for t in threads:
+            t.join(timeout=10)
+        assert sorted(reached) == [0, 1]
+
+    def test_barrier_timeout(self, server):
+        c = self._client(server)
+        with pytest.raises(HorovodTpuError):
+            c.barrier("alone", 2, timeout=0.3)
+
+    def test_hmac_rejects_wrong_secret(self, server):
+        srv, port = server
+        bad = RendezvousClient("127.0.0.1", port, new_secret(),
+                               connect_retries=1)
+        with pytest.raises(HorovodTpuError):
+            bad.put("k", "v")
+
+    def test_ping(self, server):
+        assert self._client(server).ping()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end launch over localhost (reference: test_static_run.py)
+# ---------------------------------------------------------------------------
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(cli_args, timeout=120):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # Workers must not inherit the test process's TPU/device pinning.
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner"] + cli_args,
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO_ROOT)
+
+
+class TestStaticRun:
+    def test_check_build_cli(self):
+        r = _run_cli(["--check-build"])
+        assert r.returncode == 0
+        assert "XLA collectives" in r.stdout
+
+    def test_no_command_errors(self):
+        r = _run_cli(["-np", "2"])
+        assert r.returncode == 2
+
+    def test_single_proc_env_injection(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os\n"
+            "print('RANK=%s SIZE=%s LOCAL=%s' % ("
+            "os.environ['HOROVOD_RANK'], os.environ['HOROVOD_SIZE'],"
+            "os.environ['HOROVOD_LOCAL_RANK']))\n")
+        r = _run_cli(["-np", "1", sys.executable, str(script)])
+        assert r.returncode == 0, r.stderr
+        assert "RANK=0 SIZE=1 LOCAL=0" in r.stdout
+
+    def test_two_proc_rendezvous(self, tmp_path):
+        # Two workers coordinate through the control-plane KV store.
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os\n"
+            "from horovod_tpu.runner.rendezvous import RendezvousClient\n"
+            "rank = os.environ['HOROVOD_RANK']\n"
+            "c = RendezvousClient(os.environ['HOROVOD_RENDEZVOUS_ADDR'],\n"
+            "    int(os.environ['HOROVOD_RENDEZVOUS_PORT']),\n"
+            "    os.environ['HOROVOD_SECRET_KEY'])\n"
+            "c.put('hello/' + rank, 'from-' + rank)\n"
+            "c.barrier('done', 2, timeout=60)\n"
+            "other = '1' if rank == '0' else '0'\n"
+            "assert c.get('hello/' + other) == 'from-' + other\n"
+            "print('rank %s ok' % rank)\n")
+        r = _run_cli(["-np", "2", sys.executable, str(script)])
+        assert r.returncode == 0, r.stderr
+        assert "rank 0 ok" in r.stdout and "rank 1 ok" in r.stdout
+
+    def test_failure_propagates(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "if os.environ['HOROVOD_RANK'] == '1':\n"
+            "    sys.exit(3)\n"
+            "time.sleep(60)\n")
+        t0 = time.time()
+        r = _run_cli(["-np", "2", sys.executable, str(script)])
+        # Rank 1 fails; the launcher must kill rank 0 and exit nonzero
+        # well before rank 0's 60s sleep finishes.
+        assert r.returncode != 0
+        assert time.time() - t0 < 45
+
+
+class TestRunAPI:
+    def test_run_func(self):
+        # Top-level function so it pickles.
+        from horovod_tpu.runner import run
+        results = run(_rank_times_two, np=2)
+        assert results == [0, 2]
+
+
+def _rank_times_two():
+    import os
+    return int(os.environ["HOROVOD_RANK"]) * 2
