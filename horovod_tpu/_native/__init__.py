@@ -1,0 +1,82 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Reference parity: the reference's runtime is C++ (horovod/common/*.cc);
+here the compute path is XLA, and the C++ surface is what must stay
+runtime on TPU (SURVEY.md §7): the control plane (rendezvous KV +
+barriers, elastic membership) and the timeline writer.
+
+The library is built on demand with g++ (no pybind11 in the image — plain
+`extern "C"` + ctypes).  Every consumer has a pure-Python fallback, so a
+missing toolchain degrades gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger("horovod_tpu._native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "control_plane.cc")
+_LIB = os.path.join(_HERE, "libhvdtpu.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", _LIB, _SRC,
+    ]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.debug("native build failed to run: %s", e)
+        return False
+    if out.returncode != 0:
+        logger.warning("native build failed:\n%s", out.stderr)
+        return False
+    return True
+
+
+def load(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
+    """Load the native library; None if unavailable.
+
+    `build_if_missing=False` callers are on latency-sensitive paths
+    (e.g. Timeline inside `hvd.init()`) and only accept a prebuilt .so —
+    a synchronous g++ run there would stall every rank's startup.
+    """
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        stale = not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB))
+        if stale:
+            if not build_if_missing or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            logger.warning("cannot load %s: %s", _LIB, e)
+            return None
+        lib.hvdtpu_cp_start.restype = ctypes.c_void_p
+        lib.hvdtpu_cp_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        lib.hvdtpu_cp_stop.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_tl_open.restype = ctypes.c_void_p
+        lib.hvdtpu_tl_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.hvdtpu_tl_event.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+        lib.hvdtpu_tl_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib

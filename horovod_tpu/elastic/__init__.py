@@ -28,6 +28,7 @@ import copy
 import logging
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -235,8 +236,38 @@ class ElasticSampler:
 
 def _reset() -> None:
     """Tear down and re-initialize the runtime over the current device set
-    (reference: elastic 'reset' = hvd.shutdown + hvd.init re-rendezvous)."""
+    (reference: elastic 'reset' = hvd.shutdown + hvd.init re-rendezvous).
+
+    Under a driver-managed elastic run, re-rendezvous first: fetch the new
+    generation's rank/size/coordinator from the control plane so `init()`
+    builds the new mesh."""
     basics.shutdown()
+    try:
+        from ..runner.elastic_worker import (
+            _elastic_env,
+            refresh_from_control_plane,
+        )
+        have_client = _elastic_env()
+    except ImportError:
+        have_client = False
+    if have_client:
+        # The driver may be mid-restart of the rendezvous server or not yet
+        # have published the next generation — retry transient failures
+        # instead of killing a healthy worker.
+        last_err = None
+        for _ in range(15):
+            try:
+                refresh_from_control_plane()
+                last_err = None
+                break
+            except HorovodInternalError:
+                raise
+            except Exception as e:  # HorovodTpuError, socket errors
+                last_err = e
+                time.sleep(2.0)
+        if last_err is not None:
+            raise HorovodInternalError(
+                f"cannot re-rendezvous with elastic driver: {last_err}")
     basics.init()
 
 
@@ -252,6 +283,15 @@ def run(func: Callable) -> Callable:
         notification_manager_init()
         reset_required = False
         skip_sync = False
+        # A worker spawned into an already-running job must pull current
+        # state from rank 0 before its first step (reference: joining
+        # workers hit the initial broadcast in state.sync()).
+        try:
+            from ..runner.elastic_worker import is_joining_worker
+            if is_joining_worker():
+                state.sync()
+        except ImportError:
+            pass
         while True:
             if reset_required:
                 _reset()

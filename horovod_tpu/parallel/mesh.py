@@ -1,0 +1,97 @@
+"""Hybrid device meshes: dp × tp × pp × sp × ep axes over TPU ICI.
+
+The reference is data-parallel only (SURVEY.md §2.6) — its single
+communicator maps to our 1-D `hvd` mesh in `common/basics.py`.  This
+module is the substrate the reference lacks: named multi-axis meshes that
+XLA lays onto the ICI torus, so tensor/pipeline/sequence/expert
+parallelism compose with the Horovod-style DP API.
+
+Axis conventions (order = mesh axis order, outermost first):
+    dp  — data parallel (gradient psum; maps to DCN across slices)
+    pp  — pipeline stages (ppermute ring)
+    ep  — expert parallel (all_to_all token dispatch)
+    tp  — tensor parallel (allreduce/reduce-scatter of activations)
+    sp  — sequence/context parallel (ring attention ppermute / Ulysses
+          all_to_all)
+
+tp innermost so its latency-critical collectives ride the shortest ICI
+hops — the layout the scaling-book recipe prescribes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common.exceptions import HorovodTpuError
+
+AXIS_ORDER = ("dp", "pp", "ep", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXIS_ORDER)
+
+    def total(self) -> int:
+        return math.prod(self.sizes())
+
+
+def create_hybrid_mesh(
+    dp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh with the requested parallelism degrees.
+
+    Axis sizes must multiply to the device count.  `dp=-1` (or any single
+    -1 axis) absorbs the remaining devices, e.g.
+    `create_hybrid_mesh(dp=-1, tp=4)` on 32 chips → dp=8, tp=4.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    sizes = {"dp": dp, "pp": pp, "ep": ep, "tp": tp, "sp": sp}
+    wild = [a for a, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise HorovodTpuError("at most one mesh axis may be -1")
+    if wild:
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if n % fixed:
+            raise HorovodTpuError(
+                f"{n} devices not divisible by fixed axes product {fixed}")
+        sizes[wild[0]] = n // fixed
+    if math.prod(sizes.values()) != n:
+        raise HorovodTpuError(
+            f"mesh {sizes} needs {math.prod(sizes.values())} devices, "
+            f"have {n}")
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    return Mesh(np.asarray(devs).reshape(shape), AXIS_ORDER)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """PartitionSpec for a [batch, ...] input: batch over dp (and ep when
+    experts ride the data axis)."""
+    axes = [a for a in ("dp", "ep") if mesh_axis_size(mesh, a) > 1]
+    return P(tuple(axes) if axes else None)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

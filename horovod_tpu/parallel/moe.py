@@ -1,0 +1,141 @@
+"""Expert parallelism: Switch-style MoE layer over an `ep` mesh axis.
+
+The reference has no MoE (SURVEY.md §2.6); `alltoall` is its only related
+primitive.  This is the TPU-native einsum formulation: top-k gating builds
+one-hot dispatch/combine tensors, token routing is two `all_to_all`s over
+the `ep` axis, and the expert FFNs run as one batched matmul on the MXU —
+no gather/scatter, fully static shapes (XLA requirement).
+
+Capacity model: each expert processes at most
+`capacity = ceil(tokens_per_shard / n_experts) * capacity_factor` tokens;
+overflow tokens are dropped (standard Switch behavior) and pass through
+the residual connection.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import layers as L
+
+
+def moe_init(key, n_experts: int, d_model: int, d_ff: int,
+             dtype=jnp.float32) -> Dict:
+    """Stacked expert FFN weights: [E, ...] leading expert axis (sharded
+    over `ep` by the caller's sharding rules)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / math.sqrt(d_model)
+    scale_out = 1.0 / math.sqrt(d_ff)
+    return {
+        "gate": {"kernel": jax.random.normal(
+            k1, (d_model, n_experts), dtype) * scale_in},
+        "wi": jax.random.normal(
+            k2, (n_experts, d_model, d_ff), dtype) * scale_in,
+        "wo": jax.random.normal(
+            k3, (n_experts, d_ff, d_model), dtype) * scale_out,
+    }
+
+
+def _gating(logits, n_experts: int, capacity: int):
+    """Top-1 gating → dispatch [T, E, C] (bool) and combine [T, E, C]
+    (f32 weights).  T = local token count."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                  # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], -1)[:, 0]  # [T]
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+    # Position of each token within its expert's queue.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0          # [T, E]
+    keep = (pos >= 0) & (pos < capacity)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=jnp.float32)               # [T, E, C]
+    dispatch = pos_oh * keep[..., None]
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine, expert_idx, probs
+
+
+def moe_apply_shard(params: Dict, x, axis: str = "ep",
+                    capacity_factor: float = 1.25,
+                    compute_dtype=None) -> Tuple[jnp.ndarray, Dict]:
+    """Switch MoE inside shard_map: tokens sharded over `axis`, experts
+    sharded over `axis` (E % ep_size == 0).
+
+    x: [B, T_local, D] per shard.  Returns (output [B, T_local, D],
+    aux dict with load-balancing loss).
+    """
+    ep = lax.psum(1, axis)
+    B, Tl, D = x.shape
+    E = params["wi"].shape[0]          # global expert count
+    if E % ep:
+        raise ValueError(f"experts ({E}) must divide over ep ({ep})")
+    e_local = E // ep
+    tokens = x.reshape(B * Tl, D)
+    dtype = compute_dtype or x.dtype
+
+    logits = tokens.astype(dtype) @ params["gate"]["kernel"].astype(dtype)
+    capacity = max(1, int(math.ceil(B * Tl / E) * capacity_factor))
+    dispatch, combine, expert_idx, probs = _gating(logits, E, capacity)
+
+    # Load-balancing auxiliary loss (Switch eq. 4): mean prob * mean
+    # assignment fraction per expert, psum-averaged over the axis.
+    frac_tokens = lax.pmean(
+        jnp.mean(jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=0),
+        axis)
+    frac_probs = lax.pmean(jnp.mean(probs, axis=0), axis)
+    aux_loss = E * jnp.sum(frac_tokens * frac_probs)
+
+    # Dispatch: [T, E, C] x [T, D] -> [E, C, D]; route expert shards to
+    # their owners over the ep axis.
+    expert_inputs = jnp.einsum("tec,td->ecd",
+                               dispatch.astype(dtype), tokens.astype(dtype))
+    # [E, C, D] -> all_to_all -> [e_local, ep*C, D]: each shard keeps its
+    # local experts' queues from every peer.
+    expert_inputs = lax.all_to_all(
+        expert_inputs.reshape(ep, e_local, capacity, D),
+        axis, split_axis=0, concat_axis=2, tiled=False,
+    ).reshape(e_local, ep * capacity, D)
+
+    # Expert FFN (relu MLP) — one batched MXU matmul per projection.
+    wi = lax.dynamic_slice_in_dim(
+        params["wi"], lax.axis_index(axis) * e_local, e_local, 0)
+    wo = lax.dynamic_slice_in_dim(
+        params["wo"], lax.axis_index(axis) * e_local, e_local, 0)
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_inputs,
+                               wi.astype(dtype)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(dtype))
+
+    # Route back and combine.
+    expert_out = lax.all_to_all(
+        expert_out.reshape(e_local, ep, capacity, D),
+        axis, split_axis=1, concat_axis=0, tiled=False,
+    ).reshape(E, capacity, D)
+    out = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
+    return out.reshape(B, Tl, D).astype(x.dtype), {"aux_loss": aux_loss}
+
+
+def moe_apply_dense(params: Dict, x, capacity_factor: float = 1.25,
+                    compute_dtype=None) -> Tuple[jnp.ndarray, Dict]:
+    """Single-device oracle: identical math with ep=1 (used by tests and
+    by the transformer when no ep axis is present)."""
+    B, Tl, D = x.shape
+    E = params["wi"].shape[0]
+    tokens = x.reshape(B * Tl, D)
+    dtype = compute_dtype or x.dtype
+    logits = tokens.astype(dtype) @ params["gate"]["kernel"].astype(dtype)
+    capacity = max(1, int(math.ceil(B * Tl / E) * capacity_factor))
+    dispatch, combine, expert_idx, probs = _gating(logits, E, capacity)
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(expert_idx, E, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(frac_tokens * frac_probs)
+    expert_inputs = jnp.einsum("tec,td->ecd", dispatch.astype(dtype),
+                               tokens.astype(dtype))
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_inputs,
+                               params["wi"].astype(dtype)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["wo"].astype(dtype))
+    out = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
+    return out.reshape(B, Tl, D).astype(x.dtype), {"aux_loss": aux_loss}

@@ -1,0 +1,98 @@
+"""Pipeline parallelism: GPipe schedule over a `pp` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.6 — only
+`backward_passes_per_step` gradient accumulation, which is not PP).  This
+is the TPU-native construction: stages are mesh shards, activations move
+between stages with non-cyclic `ppermute` hops, and the whole schedule is
+a `lax.scan` the compiler can overlap — autodiff through
+scan+ppermute yields the reverse-schedule backward pass for free.
+
+Schedule (forward): T = M + pp - 1 ticks for M microbatches.  Every stage
+computes every tick (bubble ticks compute on zeros and are masked out),
+which keeps the program SPMD-uniform — the XLA requirement.
+Stage i processes microbatch m at tick t = m + i; the last stage's outputs
+are gathered and psum-broadcast over the axis so every shard returns the
+full [M, ...] output block.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def gpipe_shard(stage_fn: Callable, stage_params: Any, x_mb, axis: str = "pp"):
+    """GPipe forward inside shard_map.
+
+    stage_fn(stage_params, x) applies this stage's layer block.
+    stage_params: this shard's parameters (leading pp dim already split).
+    x_mb: [M, B_mb, ...] microbatched input (used by stage 0 only).
+    Returns [M, B_mb, ...] final-stage outputs, replicated over `axis`.
+    """
+    pp = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    M = x_mb.shape[0]
+    total = M + pp - 1
+    is_first = idx == 0
+    is_last = idx == pp - 1
+    # Forward-only chain: stage i sends to i+1; stage 0 receives zeros.
+    perm = [(i, i + 1) for i in range(pp - 1)]
+
+    out_shape = jax.eval_shape(lambda x: stage_fn(stage_params, x), x_mb[0])
+    if tuple(out_shape.shape) != tuple(x_mb.shape[1:]):
+        raise ValueError(
+            f"GPipe stages must preserve activation shape; stage maps "
+            f"{tuple(x_mb.shape[1:])} -> {tuple(out_shape.shape)}")
+    recv0 = jnp.zeros(out_shape.shape, out_shape.dtype)
+    outputs0 = jnp.zeros((M,) + out_shape.shape, out_shape.dtype)
+
+    def tick(carry, t):
+        recv, outputs = carry
+        mb_idx = jnp.clip(t, 0, M - 1)
+        my_in = lax.dynamic_index_in_dim(x_mb, mb_idx, 0, keepdims=False)
+        inp = jnp.where(is_first & (t < M), my_in.astype(recv.dtype), recv)
+        y = stage_fn(stage_params, inp)
+        # Last stage completes microbatch t - (pp - 1) at this tick.
+        out_idx = t - (pp - 1)
+        valid = is_last & (out_idx >= 0) & (out_idx < M)
+        safe_idx = jnp.clip(out_idx, 0, M - 1)
+        cur = lax.dynamic_index_in_dim(outputs, safe_idx, 0, keepdims=False)
+        upd = jnp.where(valid, y, cur)
+        outputs = lax.dynamic_update_index_in_dim(outputs, upd, safe_idx, 0)
+        recv_next = lax.ppermute(y, axis, perm)
+        return (recv_next, outputs), None
+
+    (_, outputs), _ = lax.scan(
+        tick, (recv0, outputs0), jnp.arange(total))
+    # Replicate final-stage outputs across the axis (zeros elsewhere).
+    outputs = jnp.where(is_last, outputs, jnp.zeros_like(outputs))
+    return lax.psum(outputs, axis)
+
+
+def gpipe(mesh: Mesh, stage_fn: Callable, params: Any, x,
+          n_microbatches: int, axis: str = "pp"):
+    """Mesh-level GPipe: params leaves have leading dim pp (stage-stacked);
+    x is [B, ...] with B divisible by n_microbatches."""
+    pp = mesh.shape[axis]
+    B = x.shape[0]
+    assert B % n_microbatches == 0, (B, n_microbatches)
+    x_mb = x.reshape((n_microbatches, B // n_microbatches) + x.shape[1:])
+
+    def shard_fn(params, x_mb):
+        squeezed = jax.tree_util.tree_map(
+            lambda p: jnp.squeeze(p, 0), params)
+        out = gpipe_shard(stage_fn, squeezed, x_mb, axis=axis)
+        return out
+
+    p_specs = jax.tree_util.tree_map(lambda _: P(axis), params)
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(p_specs, P()), out_specs=P(),
+                   check_rep=False)
+    out_mb = fn(params, x_mb)
+    return out_mb.reshape((B,) + out_mb.shape[2:])

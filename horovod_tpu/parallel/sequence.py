@@ -1,0 +1,153 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no sequence parallelism (SURVEY.md §2.6) — its only
+related primitive is `alltoall`.  Long context is first-class here: these
+are the two standard TPU-native schemes, built directly on the ICI
+collectives the mesh exposes:
+
+  - **Ring attention** (blockwise attention + online softmax, K/V blocks
+    rotating around the `sp` axis via `ppermute`): memory per chip is
+    O(T/sp), communication overlaps with the blockwise matmuls.
+  - **Ulysses** (all_to_all re-shard): switch tokens→heads sharding,
+    run dense attention on full sequences for H/sp local heads, switch
+    back.  Cheaper at moderate T, requires H % sp == 0.
+
+Both come as `*_shard` functions (for use *inside* an existing
+`shard_map`, as the transformer does) and as mesh-level wrappers.
+
+Numerics: accumulation in f32 regardless of input dtype; masked logits use
+a large-negative fill (not -inf) so the online-softmax correction terms
+stay finite on fully-masked blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _block_attn_update(q, k, v, o, m, l, q_pos, k_pos, scale, causal):
+    """One online-softmax update of (o, m, l) with a K/V block.
+
+    Shapes: q [B,Tq,H,D], k/v [B,Tk,H,D], o [B,Tq,H,D] f32,
+    m/l [B,H,Tq] f32.  Returns updated (o, m, l).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp of _NEG-filled rows underflows to 0 — no NaN path.
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention_shard(q, k, v, axis: str, causal: bool = True):
+    """Ring attention, called inside shard_map with `axis` in scope.
+
+    Per-shard shapes: q/k/v [B, T_local, H, D] (the global sequence is
+    sharded over `axis`).  Returns [B, T_local, H, D] in q.dtype.
+    """
+    sp = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    B, Tl, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    q_pos = idx * Tl + jnp.arange(Tl)
+
+    o = jnp.zeros((B, Tl, H, D), jnp.float32)
+    m = jnp.full((B, H, Tl), _NEG, jnp.float32)
+    l = jnp.zeros((B, H, Tl), jnp.float32)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def body(step, carry):
+        o, m, l, kb, vb = carry
+        kv_idx = (idx - step) % sp
+        k_pos = kv_idx * Tl + jnp.arange(Tl)
+        o, m, l = _block_attn_update(q, kb, vb, o, m, l, q_pos, k_pos,
+                                     scale, causal)
+        # Rotate K/V around the ring; the last rotation is dead but keeps
+        # the loop body uniform (XLA overlaps it with the epilogue).
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        return o, m, l, kb, vb
+
+    o, m, l, _, _ = lax.fori_loop(0, sp, body, (o, m, l, k, v))
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def full_attention(q, k, v, causal: bool = True, q_offset: int = 0):
+    """Dense reference attention [B,T,H,D] (used by Ulysses locally and by
+    tests as the numerical oracle)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(Tq)
+        mask = q_pos[:, None] >= jnp.arange(Tk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ulysses_attention_shard(q, k, v, axis: str, causal: bool = True):
+    """Ulysses attention inside shard_map: all_to_all tokens→heads, dense
+    attention over the full sequence on H/sp local heads, all_to_all back.
+
+    Per-shard q/k/v: [B, T_local, H, D] with H divisible by the axis size.
+    """
+    sp = lax.psum(1, axis)
+    H = q.shape[2]
+    if H % sp:
+        raise ValueError(f"Ulysses needs heads ({H}) divisible by sp ({sp})")
+
+    def to_heads(x):  # [B,Tl,H,D] -> [B,T,H/sp,D]
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_tokens(x):  # [B,T,H/sp,D] -> [B,Tl,H,D]
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = full_attention(qh, kh, vh, causal=causal)
+    return to_tokens(out)
+
+
+def _mesh_wrap(shard_fn, mesh: Mesh, axis: str, q, k, v, causal: bool):
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        functools.partial(shard_fn, axis=axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                   causal: bool = True):
+    """Mesh-level ring attention: q/k/v [B, T, H, D] with T sharded over
+    `axis`."""
+    return _mesh_wrap(ring_attention_shard, mesh, axis, q, k, v, causal)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                      causal: bool = True):
+    """Mesh-level Ulysses attention: q/k/v [B, T, H, D] with T sharded
+    over `axis`."""
+    return _mesh_wrap(ulysses_attention_shard, mesh, axis, q, k, v, causal)

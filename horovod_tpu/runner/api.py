@@ -10,11 +10,14 @@ through the rendezvous KV store before the server shuts down.
 from __future__ import annotations
 
 import base64
+import logging
 import os
 import pickle
 import sys
 import tempfile
 from typing import Any, Callable, List, Optional
+
+logger = logging.getLogger("horovod_tpu.runner")
 
 from ..common.exceptions import HorovodTpuError
 from . import hosts as hosts_mod
@@ -49,9 +52,20 @@ def run(
     extra_env: Optional[dict] = None,
     start_timeout: float = 120.0,
 ) -> List[Any]:
-    """Run `func(*args, **kwargs)` on `np` workers; return results by rank."""
+    """Run `func(*args, **kwargs)` on `np` workers; return results by rank.
+
+    `start_timeout` bounds elastic host discovery; static worker startup is
+    bounded by the jax.distributed bootstrap's own timeout.  With remote
+    `hosts`, the pickled function file must be visible on every host
+    (shared filesystem), as must the repo itself.
+    """
     host_list = (hosts_mod.parse_hosts(hosts) if hosts
                  else [hosts_mod.HostInfo("localhost", np)])
+    from .exec_run import _is_local
+    if any(not _is_local(h.hostname) for h in host_list):
+        logger.warning(
+            "run() with remote hosts requires the function pickle (tempfile)"
+            " and repo to be on a shared filesystem visible to all hosts")
     slots = hosts_mod.get_host_assignments(host_list, np)
 
     with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as f:
@@ -80,7 +94,7 @@ def run(
 
     def collect(server):
         for r in range(np):
-            val = server.store.get(f"runfunc/result/{r}")
+            val = server.kv().get(f"runfunc/result/{r}")
             if val is None:
                 missing.append(r)
             else:

@@ -1,0 +1,19 @@
+"""Elastic launcher: discovery, registration, driver, worker notification.
+
+Reference parity map (SURVEY.md §2.5 elastic rows, §3.5):
+  - horovod/runner/elastic/discovery.py    → `discovery.py`
+  - horovod/runner/elastic/registration.py → `registration.py`
+  - horovod/runner/elastic/driver.py       → `driver.py`
+  - horovod/runner/elastic/worker.py       → `../elastic_worker.py`
+
+TPU-native redesign: the reference pushes host updates to workers over a
+per-worker HTTP service; here the rendezvous KV store *is* the membership
+authority — the driver publishes numbered generations
+(`elastic/gen/{g}/info`) and bumps `elastic/current_gen`; workers poll the
+counter and re-rendezvous against the published generation.  Elasticity is
+slice-granular: hosts join/leave in whole-worker units and every
+membership change is a new mesh (recompile on first post-reset step).
+"""
+
+from .discovery import FixedHosts, HostDiscovery, HostDiscoveryScript  # noqa: F401
+from .registration import WorkerStateRegistry  # noqa: F401

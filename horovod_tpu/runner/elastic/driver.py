@@ -1,0 +1,271 @@
+"""Elastic driver: membership authority + worker lifecycle manager.
+
+Reference parity: horovod/runner/elastic/driver.py (`ElasticDriver`:
+`wait_for_available_slots`, `_discovery_thread`, host blacklisting, rank
+reassignment, worker restart) and `gloo_run_elastic`.
+
+Protocol over the rendezvous KV store (TPU-native replacement for the
+reference's per-worker notification HTTP services):
+
+    elastic/current_gen                = "g"     (bumped last)
+    elastic/gen/{g}/info               = JSON {size, coordinator,
+                                         assignments: {"host:slot": rank},
+                                         hosts: {host: slots}}
+    elastic/gen/{g}/ready/{rank}       = "1"     (worker rendezvoused)
+
+The driver computes a new generation whenever discovery output or worker
+failures change the usable host set; workers observe `current_gen` (poll
+thread → `HostsUpdatedInterrupt` at the next `state.commit()`), fetch the
+new generation's info, and re-init the mesh.  Hosts whose workers fail are
+blacklisted.  The job succeeds when every worker of the current
+generation exits 0; it aborts when usable slots fall below --min-np or
+the reset count exceeds --reset-limit.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ...common.exceptions import HorovodTpuError
+from .. import safe_exec
+from ..exec_run import (
+    DEFAULT_COORDINATOR_PORT,
+    _free_port,
+    _is_local,
+    _my_addr,
+    build_command,
+    slot_env,
+)
+from ..hosts import HostInfo, SlotInfo, get_host_assignments
+from ..rendezvous import RendezvousServer
+from ..settings import Settings
+from .discovery import HostDiscovery, HostDiscoveryScript
+from .registration import WorkerStateRegistry
+
+logger = logging.getLogger("horovod_tpu.runner.elastic")
+
+DISCOVERY_INTERVAL_S = 1.0
+
+
+class ElasticDriver:
+    def __init__(self, settings: Settings, discovery: HostDiscovery):
+        self.settings = settings
+        self.discovery = discovery
+        self.registry = WorkerStateRegistry()
+        self.server = RendezvousServer(verbose=settings.verbose)
+        self.gen = -1
+        self.reset_count = 0
+        # (host, slot) -> (process handle, assigned rank, generation)
+        self.workers: Dict[Tuple[str, int], Tuple[object, int, int]] = {}
+        self.assignments: Dict[Tuple[str, int], SlotInfo] = {}
+        # Slots whose worker exited 0: their training is complete; they are
+        # never re-assigned (a new worker there would redo finished work).
+        self.finished_slots: set = set()
+        self._last_discovery = 0.0
+        self._active_hosts: Dict[str, int] = {}
+        self.min_np = settings.min_np or settings.num_proc or 1
+        self.max_np = settings.max_np
+
+    # -- membership ------------------------------------------------------
+
+    def _discover(self) -> Dict[str, int]:
+        hosts = self.discovery.find_available_hosts_and_slots()
+        return {h: s for h, s in hosts.items()
+                if not self.registry.is_blacklisted(h)}
+
+    def wait_for_available_slots(self, min_np: int,
+                                 timeout: float) -> Dict[str, int]:
+        """Block until discovery yields >= min_np usable slots (reference:
+        ElasticDriver.wait_for_available_slots)."""
+        deadline = time.time() + timeout
+        while True:
+            hosts = self._discover()
+            if sum(hosts.values()) >= min_np:
+                return hosts
+            if time.time() > deadline:
+                raise HorovodTpuError(
+                    f"Timed out waiting for {min_np} slots; discovered "
+                    f"{hosts} (blacklist: {self.registry.blacklist()})")
+            time.sleep(DISCOVERY_INTERVAL_S)
+
+    def _compute_assignments(
+            self, hosts: Dict[str, int]) -> List[SlotInfo]:
+        host_list = [HostInfo(h, s) for h, s in sorted(hosts.items())]
+        total = sum(hosts.values())
+        np_ = min(total, self.max_np) if self.max_np else total
+        return get_host_assignments(host_list, min(self.min_np, np_), np_)
+
+    # -- generation transitions ------------------------------------------
+
+    def _publish_generation(self, slots: List[SlotInfo]) -> None:
+        self.gen += 1
+        rank0 = slots[0]
+        if _is_local(rank0.hostname):
+            coord = (f"{'127.0.0.1' if self._all_local(slots) else _my_addr(slots)}"
+                     f":{_free_port()}")
+        else:
+            coord = f"{rank0.hostname}:{DEFAULT_COORDINATOR_PORT + (self.gen % 100)}"
+        info = {
+            "size": len(slots),
+            "coordinator": coord,
+            "assignments": {f"{s.hostname}:{s.local_rank}": s.rank
+                            for s in slots},
+            "hosts": {s.hostname: s.local_size for s in slots},
+        }
+        kv = self.server.kv()
+        kv.put(f"elastic/gen/{self.gen}/info", json.dumps(info))
+        kv.put("elastic/current_gen", str(self.gen))
+        self.assignments = {(s.hostname, s.local_rank): s for s in slots}
+        logger.info("generation %d: %d workers on %s", self.gen,
+                    len(slots), sorted(info["hosts"]))
+
+    @staticmethod
+    def _all_local(slots: List[SlotInfo]) -> bool:
+        return all(_is_local(s.hostname) for s in slots)
+
+    def _spawn_missing_workers(self) -> None:
+        for (host, slot_idx), slot in self.assignments.items():
+            if (host, slot_idx) in self.finished_slots:
+                continue  # completed training; never redo finished work
+            live = self.workers.get((host, slot_idx))
+            if live is not None and live[0].poll() is None:
+                continue  # existing worker survives the reset in-process
+            env = slot_env(slot, self.settings, self.server.secret,
+                           coordinator_addr="")  # workers read gen info
+            env.update({
+                "HOROVOD_ELASTIC": "1",
+                "HOROVOD_HOSTNAME": host,
+                "HOROVOD_SLOT": str(slot_idx),
+                "HOROVOD_ELASTIC_GEN": str(self.gen),
+                # Workers spawned into a running job must state.sync()
+                # before their first step.
+                "HOROVOD_ELASTIC_JOINING": "1" if self.gen > 0 else "0",
+            })
+            env.pop("HOROVOD_COORDINATOR_ADDR", None)
+            cmd = build_command(slot, self.settings, env)
+            handle = safe_exec.execute(
+                cmd, env=env, prefix=f"{slot.rank}", background=True)
+            self.workers[(host, slot_idx)] = (handle, slot.rank, self.gen)
+            logger.info("spawned worker %s:%d rank=%d pid=%d",
+                        host, slot_idx, slot.rank, handle.pid)
+
+    def _kill_removed_workers(self) -> None:
+        for key, (handle, rank, _) in list(self.workers.items()):
+            if key not in self.assignments and handle.poll() is None:
+                logger.info("terminating worker %s (no longer assigned)", key)
+                handle.terminate()
+
+    # -- main loop -------------------------------------------------------
+
+    def run(self) -> int:
+        port = self.server.start()
+        self.settings.rendezvous_port = port
+        self.settings.rendezvous_addr = "127.0.0.1"
+
+        hosts = self.wait_for_available_slots(
+            self.min_np, timeout=self.settings.start_timeout)
+        # Multi-host: advertise a routable rendezvous address.
+        if any(not _is_local(h) for h in hosts):
+            slots_probe = self._compute_assignments(hosts)
+            self.settings.rendezvous_addr = _my_addr(slots_probe)
+        self._active_hosts = hosts
+        self._publish_generation(self._compute_assignments(hosts))
+        self._spawn_missing_workers()
+
+        try:
+            return self._monitor_loop()
+        finally:
+            for handle, _, _ in self.workers.values():
+                if handle.poll() is None:
+                    handle.terminate()
+            self.server.stop()
+
+    def _monitor_loop(self) -> int:
+        while True:
+            need_new_gen = False
+
+            # 1. Reap worker exits.
+            for key, (handle, rank, gen) in list(self.workers.items()):
+                rc = handle.poll()
+                if rc is None:
+                    continue
+                host, slot_idx = key
+                del self.workers[key]
+                if key not in self.assignments:
+                    continue  # removed worker exiting, expected
+                if rc == 0:
+                    self.registry.record_success(host, slot_idx)
+                    self.finished_slots.add((host, slot_idx))
+                    logger.info("worker %s:%d (rank %d) finished",
+                                host, slot_idx, rank)
+                else:
+                    logger.warning("worker %s:%d (rank %d) failed rc=%d",
+                                   host, slot_idx, rank, rc)
+                    self.registry.record_failure(host, slot_idx)
+                    need_new_gen = True
+
+            # 2. Every currently-assigned slot finished → job done.  Keyed
+            # on finished_slots (not registry states, which persist across
+            # generations and would mis-declare success for a respawned
+            # slot that merely shares a host with an old SUCCESS record).
+            current = list(self.assignments)
+            if current and all(k in self.finished_slots for k in current):
+                return 0
+
+            # 3. Periodic re-discovery.
+            now = time.time()
+            if now - self._last_discovery > DISCOVERY_INTERVAL_S:
+                self._last_discovery = now
+                try:
+                    hosts = self._discover()
+                except HorovodTpuError as e:
+                    logger.warning("discovery failed: %s", e)
+                    hosts = self._active_hosts
+                if hosts != self._active_hosts:
+                    logger.info("host set changed: %s -> %s",
+                                self._active_hosts, hosts)
+                    need_new_gen = True
+                    self._active_hosts = hosts
+
+            # 4. Generation transition.
+            if need_new_gen:
+                # _active_hosts may predate the failure that triggered this
+                # transition; re-apply the blacklist.  Finished slots stay
+                # in the assignment (their work is done and they are never
+                # respawned) so staggered completion neither churns
+                # generations nor trips the min-np abort.
+                usable = {
+                    h: s for h, s in self._active_hosts.items()
+                    if not self.registry.is_blacklisted(h)
+                }
+                if sum(usable.values()) < self.min_np:
+                    logger.error(
+                        "only %d usable slots < min_np=%d — aborting",
+                        sum(usable.values()), self.min_np)
+                    return 1
+                if (self.settings.reset_limit is not None
+                        and self.reset_count >= self.settings.reset_limit):
+                    logger.error("reset limit %d reached — aborting",
+                                 self.settings.reset_limit)
+                    return 1
+                self.reset_count += 1
+                self._active_hosts = usable
+                self._publish_generation(self._compute_assignments(usable))
+                self._kill_removed_workers()
+                self._spawn_missing_workers()
+
+            time.sleep(0.2)
+
+
+def elastic_run(settings: Settings) -> int:
+    """Entry from launch.py for `--host-discovery-script` runs."""
+    if not settings.host_discovery_script:
+        raise HorovodTpuError("elastic runs require --host-discovery-script")
+    discovery = HostDiscoveryScript(
+        settings.host_discovery_script,
+        default_slots=settings.slots_per_host or 1)
+    return ElasticDriver(settings, discovery).run()

@@ -1,0 +1,66 @@
+"""Worker state registry and host blacklist.
+
+Reference parity: horovod/runner/elastic/registration.py
+(`WorkerStateRegistry`) — records per-worker outcomes, drives the host
+blacklist the driver consults when computing the next generation's
+assignments.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Set, Tuple
+
+logger = logging.getLogger("horovod_tpu.runner.elastic")
+
+READY = "READY"
+SUCCESS = "SUCCESS"
+FAILURE = "FAILURE"
+
+Slot = Tuple[str, int]  # (hostname, slot index)
+
+
+class WorkerStateRegistry:
+    def __init__(self, failure_threshold: int = 1):
+        self._lock = threading.Lock()
+        self._states: Dict[Slot, str] = {}
+        self._host_failures: Dict[str, int] = {}
+        self._blacklist: Set[str] = set()
+        self._failure_threshold = failure_threshold
+
+    def record_ready(self, host: str, slot: int) -> None:
+        with self._lock:
+            self._states[(host, slot)] = READY
+
+    def record_success(self, host: str, slot: int) -> None:
+        with self._lock:
+            self._states[(host, slot)] = SUCCESS
+
+    def record_failure(self, host: str, slot: int) -> None:
+        """Count the failure; blacklist the host at the threshold
+        (reference default: one strike)."""
+        with self._lock:
+            self._states[(host, slot)] = FAILURE
+            self._host_failures[host] = self._host_failures.get(host, 0) + 1
+            if self._host_failures[host] >= self._failure_threshold:
+                if host not in self._blacklist:
+                    logger.warning("blacklisting host %s after %d failure(s)",
+                                   host, self._host_failures[host])
+                self._blacklist.add(host)
+
+    def state(self, host: str, slot: int) -> str:
+        with self._lock:
+            return self._states.get((host, slot), "")
+
+    def is_blacklisted(self, host: str) -> bool:
+        with self._lock:
+            return host in self._blacklist
+
+    def blacklist(self) -> Set[str]:
+        with self._lock:
+            return set(self._blacklist)
+
+    def count(self, state: str) -> int:
+        with self._lock:
+            return sum(1 for s in self._states.values() if s == state)

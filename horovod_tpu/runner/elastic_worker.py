@@ -1,0 +1,124 @@
+"""Worker-side elastic client: membership polling + re-rendezvous.
+
+Reference parity: horovod/runner/elastic/worker.py
+(`WorkerNotificationService/Client/Manager`) — but instead of hosting an
+HTTP endpoint per worker for driver pushes, workers watch the
+`elastic/current_gen` counter on the rendezvous KV store (the driver
+bumps it after publishing each generation) and raise
+`HostsUpdatedInterrupt` through `horovod_tpu.elastic.notify_hosts_updated`
+at the next commit boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..common.exceptions import HorovodTpuError
+from .rendezvous import RendezvousClient
+
+logger = logging.getLogger("horovod_tpu.runner.elastic_worker")
+
+_POLL_INTERVAL_S = 0.5
+_client_thread: Optional[threading.Thread] = None
+_known_gen = -1
+
+
+def _elastic_env() -> bool:
+    return os.environ.get("HOROVOD_ELASTIC") == "1"
+
+
+def client_from_env() -> RendezvousClient:
+    try:
+        return RendezvousClient(
+            os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+            int(os.environ["HOROVOD_RENDEZVOUS_PORT"]),
+            os.environ["HOROVOD_SECRET_KEY"],
+        )
+    except KeyError as e:
+        raise HorovodTpuError(
+            f"elastic worker missing rendezvous env: {e}") from e
+
+
+def current_generation(client: Optional[RendezvousClient] = None) -> int:
+    client = client or client_from_env()
+    val = client.get("elastic/current_gen")
+    return int(val) if val is not None else -1
+
+
+def refresh_from_control_plane(timeout: float = 60.0) -> dict:
+    """Fetch the latest generation's assignment and update this process's
+    env so the next `hvd.init()` builds the new mesh.
+
+    Returns the generation info dict.  If this worker's host:slot is no
+    longer assigned, exits cleanly (the driver is tearing us down).
+    """
+    global _known_gen
+    client = client_from_env()
+    gen = current_generation(client)
+    if gen < 0:
+        raise HorovodTpuError("no generation published yet")
+    info = json.loads(client.wait(f"elastic/gen/{gen}/info", timeout))
+    me = f"{os.environ.get('HOROVOD_HOSTNAME', 'localhost')}:" \
+         f"{os.environ.get('HOROVOD_SLOT', '0')}"
+    if me not in info["assignments"]:
+        logger.info("worker %s not in generation %d — exiting", me, gen)
+        sys.exit(0)
+    rank = info["assignments"][me]
+    size = info["size"]
+    os.environ["HOROVOD_RANK"] = str(rank)
+    os.environ["HOROVOD_SIZE"] = str(size)
+    os.environ["HOROVOD_NUM_PROCESSES"] = str(size)
+    os.environ["HOROVOD_PROCESS_ID"] = str(rank)
+    if size > 1 and os.environ.get("HVD_TPU_MULTIPROCESS_JAX") == "1":
+        os.environ["HOROVOD_COORDINATOR_ADDR"] = info["coordinator"]
+    else:
+        # Single-controller JAX per worker: no cross-process jax.distributed
+        # bootstrap (the control plane still carries membership).
+        os.environ.pop("HOROVOD_COORDINATOR_ADDR", None)
+    _known_gen = gen
+    client.put(f"elastic/gen/{gen}/ready/{rank}", "1")
+    return info
+
+
+def _poll_loop() -> None:
+    from .. import elastic as elastic_mod
+
+    client = client_from_env()
+    while True:
+        try:
+            gen = current_generation(client)
+            if gen > _known_gen >= 0:
+                logger.info("observed generation bump %d -> %d",
+                            _known_gen, gen)
+                elastic_mod.notify_hosts_updated()
+                # Wait until the reset consumes it before renotifying.
+                while current_generation(client) > _known_gen >= 0:
+                    time.sleep(_POLL_INTERVAL_S)
+        except HorovodTpuError:
+            pass  # driver may be mid-restart; keep polling
+        except Exception:
+            logger.exception("elastic poll loop error")
+        time.sleep(_POLL_INTERVAL_S)
+
+
+def maybe_start_notification_client() -> None:
+    """Called from `hvd.elastic.run`'s wrapper (reference:
+    WorkerNotificationManager.init)."""
+    global _client_thread
+    if not _elastic_env() or _client_thread is not None:
+        return
+    refresh_from_control_plane()
+    _client_thread = threading.Thread(target=_poll_loop, daemon=True)
+    _client_thread.start()
+
+
+def is_joining_worker() -> bool:
+    """True when this process was spawned into an already-running job and
+    must sync state from rank 0 before its first step."""
+    return os.environ.get("HOROVOD_ELASTIC_JOINING") == "1"

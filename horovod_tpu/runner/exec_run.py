@@ -37,7 +37,13 @@ DEFAULT_COORDINATOR_PORT = 46327
 
 
 def _is_local(hostname: str) -> bool:
-    return hostname in LOCAL_HOSTNAMES
+    if hostname in LOCAL_HOSTNAMES:
+        return True
+    # Test hook (reference uses the same localhost fake-cluster pattern,
+    # SURVEY.md §4): hostnames listed here exec locally instead of via ssh,
+    # letting elastic integration tests blacklist "hosts" on one machine.
+    fake = os.environ.get("HVD_TPU_FAKE_LOCAL_HOSTS")
+    return bool(fake) and hostname in fake.split(",")
 
 
 def slot_env(

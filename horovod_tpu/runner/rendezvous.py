@@ -118,11 +118,48 @@ class KVStore:
                     return True
                 remaining = deadline - time.time()
                 if remaining <= 0 or not self._cv.wait(remaining):
-                    # Withdraw our arrival so a failed barrier can retry.
+                    # Re-check before withdrawing: the last participant may
+                    # have released the barrier in the same instant our
+                    # deadline expired.
                     g, a = self._barriers.get(name, (0, 0))
+                    if g > my_gen:
+                        return True
                     if g == my_gen and a > 0:
                         self._barriers[name] = (g, a - 1)
                     return False
+
+
+class _LoopbackStore:
+    """KVStore-compatible facade over a RendezvousClient (used when the
+    store lives in the native server)."""
+
+    def __init__(self, client: "RendezvousClient"):
+        self._c = client
+
+    def put(self, key: str, value: str) -> None:
+        self._c.put(key, value)
+
+    def get(self, key: str) -> Optional[str]:
+        return self._c.get(key)
+
+    def wait(self, key: str, timeout: float) -> Optional[str]:
+        try:
+            return self._c.wait(key, timeout)
+        except HorovodTpuError:
+            return None
+
+    def delete(self, key: str) -> bool:
+        return self._c.delete(key)
+
+    def keys(self, prefix: str = "") -> List[str]:
+        return self._c.keys(prefix)
+
+    def barrier(self, name: str, count: int, timeout: float) -> bool:
+        try:
+            self._c.barrier(name, count, timeout)
+            return True
+        except HorovodTpuError:
+            return False
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -224,6 +261,15 @@ class RendezvousServer:
     @property
     def port(self) -> Optional[int]:
         return self._port
+
+    def kv(self) -> "KVStore":
+        """Store accessor valid for either engine: the in-process store
+        for the Python server, a loopback client for the native one
+        (whose store lives in C++)."""
+        if self._native is not None:
+            return _LoopbackStore(
+                RendezvousClient("127.0.0.1", self._port, self.secret))
+        return self.store
 
     def stop(self) -> None:
         if self._native is not None:

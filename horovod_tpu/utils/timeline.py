@@ -89,6 +89,48 @@ class _TimelineWriter:
             self._thread.join(timeout=5)
 
 
+class _NativeWriterAdapter:
+    """Routes records into the C++ buffered writer thread
+    (horovod_tpu/_native: TimelineWriter, reference timeline.cc)."""
+
+    def __init__(self, filename: str):
+        from .._native import load
+        from .._native.control_plane import NativeTimelineWriter
+        # Only accept a prebuilt library here: this runs inside
+        # hvd.init() and must not trigger a synchronous g++ build.
+        if load(build_if_missing=False) is None:
+            raise RuntimeError("native library not prebuilt")
+        self.filename = filename
+        self._w = NativeTimelineWriter(filename)
+
+    def enqueue(self, record: dict) -> None:
+        args = record.get("args")
+        self._w.event(
+            name=str(record.get("name", "")),
+            cat=str(record.get("cat", "")),
+            ph=str(record.get("ph", "i")),
+            ts_us=float(record.get("ts", 0.0)),
+            dur_us=float(record.get("dur", -1.0)),
+            pid=int(record.get("pid", 0)),
+            tid=str(record.get("tid", "")),
+            scope=str(record.get("s", "")),
+            args_json=json.dumps(args, default=str) if args else "",
+        )
+
+    def close(self) -> None:
+        self._w.close()
+
+
+def _make_writer(filename: str):
+    """Prefer the native C++ writer; fall back to the Python thread."""
+    if not util.env_bool("TIMELINE_DISABLE_NATIVE", False):
+        try:
+            return _NativeWriterAdapter(filename)
+        except Exception:
+            pass
+    return _TimelineWriter(filename)
+
+
 class Timeline:
     """Per-process timeline of control-plane activities.
 
@@ -99,7 +141,7 @@ class Timeline:
 
     def __init__(self, filename: str, rank: int = 0,
                  mark_cycles: bool = False):
-        self._writer = _TimelineWriter(filename)
+        self._writer = _make_writer(filename)
         self._rank = rank
         self._mark_cycles = mark_cycles
         # token -> (tensor_name, activity, start_us); tokens are unique per

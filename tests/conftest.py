@@ -29,6 +29,11 @@ import pytest  # noqa: E402
 import horovod_tpu as hvd  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "integration: multi-process integration tests")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def hvd_init():
     hvd.init()

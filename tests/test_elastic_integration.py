@@ -1,0 +1,169 @@
+"""Elastic integration tests: fake cluster on localhost.
+
+Reference pattern (SURVEY.md §4, test/integration/elastic_common.py):
+a real ElasticDriver run with a --host-discovery-script that reads a tmp
+hosts file the test mutates mid-run; workers record JSON histories;
+assertions cover scale-up, scale-down, failure blacklist, and min-np
+abort.  "Hosts" are fake names execed locally via HVD_TPU_FAKE_LOCAL_HOSTS.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER_MAIN = os.path.join(REPO_ROOT, "tests", "data", "elastic_main.py")
+
+
+class ElasticJob:
+    """Drives one `horovodrun_tpu` elastic run against a mutable hosts
+    file (the reference's discovery-script fakery)."""
+
+    def __init__(self, tmp_path: Path, hosts, min_np=1, max_np=None,
+                 num_epochs=6, epoch_time=0.4, extra_env=None):
+        self.tmp = tmp_path
+        self.hosts_file = tmp_path / "hosts.txt"
+        self.set_hosts(hosts)
+        self.log_dir = tmp_path / "logs"
+        self.log_dir.mkdir()
+        script = tmp_path / "discover.sh"
+        script.write_text(f"#!/bin/sh\ncat {self.hosts_file}\n")
+        script.chmod(0o755)
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "HVD_TPU_FAKE_LOCAL_HOSTS": "hostA,hostB,hostC",
+            "TEST_LOG_DIR": str(self.log_dir),
+            "NUM_EPOCHS": str(num_epochs),
+            "EPOCH_TIME": str(epoch_time),
+            "FAIL_MARKER": str(tmp_path / "fail_marker"),
+        })
+        env.update(extra_env or {})
+
+        cmd = [sys.executable, "-m", "horovod_tpu.runner",
+               "--host-discovery-script", str(script),
+               "--min-np", str(min_np)]
+        if max_np:
+            cmd += ["--max-np", str(max_np)]
+        cmd += [sys.executable, WORKER_MAIN]
+        self.proc = subprocess.Popen(
+            cmd, env=env, cwd=REPO_ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    def set_hosts(self, hosts):
+        # Write atomically so discovery never reads a half-written file.
+        tmp = self.hosts_file.with_suffix(".tmp")
+        tmp.write_text("".join(f"{h}:{s}\n" for h, s in hosts))
+        tmp.rename(self.hosts_file)
+
+    def fail_host(self, host):
+        (self.tmp / "fail_marker").write_text(host)
+
+    def wait(self, timeout=120):
+        try:
+            out, _ = self.proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            out, _ = self.proc.communicate()
+            raise AssertionError(f"elastic job hung; output:\n{out}")
+        return self.proc.returncode, out
+
+    def histories(self):
+        hist = {}
+        for f in self.log_dir.glob("worker-*.jsonl"):
+            name = f.stem.replace("worker-", "")
+            hist[name] = [json.loads(line) for line in f.read_text().splitlines()]
+        return hist
+
+    def wait_for_event(self, worker, event, timeout=60, min_epoch=0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for rec in self.histories().get(worker, []):
+                if rec["event"] == event and rec["epoch"] >= min_epoch:
+                    return rec
+            if self.proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        out = self.proc.stdout.read() if self.proc.poll() is not None else ""
+        raise AssertionError(
+            f"worker {worker} never reached {event} (epoch>={min_epoch}); "
+            f"histories={self.histories()}; driver out:\n{out}")
+
+
+@pytest.mark.integration
+class TestElastic:
+    def test_static_completion(self, tmp_path):
+        """One host, no membership changes: clean completion."""
+        job = ElasticJob(tmp_path, [("hostA", 1)], num_epochs=3,
+                        epoch_time=0.1)
+        rc, out = job.wait()
+        assert rc == 0, out
+        hist = job.histories()["hostA-0"]
+        assert [r["event"] for r in hist][-2:] == ["done", "exit"]
+        assert max(r["epoch"] for r in hist) == 3
+
+    def test_scale_up(self, tmp_path):
+        """Add a host mid-run: existing worker resets, new worker joins
+        with the committed epoch, both finish."""
+        job = ElasticJob(tmp_path, [("hostA", 1)], num_epochs=8,
+                        epoch_time=0.4)
+        job.wait_for_event("hostA-0", "commit", min_epoch=1)
+        job.set_hosts([("hostA", 1), ("hostB", 1)])
+        rc, out = job.wait()
+        assert rc == 0, out
+        hist = job.histories()
+        a = hist["hostA-0"]
+        b = hist.get("hostB-0", [])
+        assert a[-1]["event"] == "exit"
+        assert b and b[-1]["event"] == "exit"
+        # After the bump both workers report size 2.
+        assert a[-1]["size"] == 2 and b[-1]["size"] == 2
+        # The joiner started from a synced (non-zero-restarted) job and
+        # saw a later generation.
+        assert b[0]["gen"] >= 1
+
+    def test_scale_down_graceful(self, tmp_path):
+        """Remove a host mid-run: its worker is terminated, survivor
+        finishes at size 1."""
+        job = ElasticJob(tmp_path, [("hostA", 1), ("hostB", 1)],
+                        num_epochs=8, epoch_time=0.4)
+        job.wait_for_event("hostB-0", "commit", min_epoch=1)
+        job.set_hosts([("hostA", 1)])
+        rc, out = job.wait()
+        assert rc == 0, out
+        a = job.histories()["hostA-0"]
+        assert a[-1]["event"] == "exit" and a[-1]["size"] == 1
+
+    def test_failure_blacklists_and_continues(self, tmp_path):
+        """Worker dies: host blacklisted, survivor resumes from last
+        commit and completes."""
+        job = ElasticJob(tmp_path, [("hostA", 1), ("hostB", 1)],
+                        num_epochs=8, epoch_time=0.4)
+        job.wait_for_event("hostB-0", "commit", min_epoch=1)
+        job.fail_host("hostB")
+        rc, out = job.wait()
+        assert rc == 0, out
+        hist = job.histories()
+        assert any(r["event"] == "failing" for r in hist["hostB-0"])
+        a = hist["hostA-0"]
+        assert a[-1]["event"] == "exit" and a[-1]["size"] == 1
+        # Survivor kept its committed progress (epochs monotone per gen,
+        # never restarted from 0 after its first commit).
+        commits = [r["epoch"] for r in a if r["event"] == "commit"]
+        assert commits == sorted(commits)
+
+    def test_min_np_abort(self, tmp_path):
+        """All hosts fail below --min-np: the driver aborts nonzero."""
+        job = ElasticJob(tmp_path, [("hostA", 1), ("hostB", 1)],
+                        min_np=2, num_epochs=50, epoch_time=0.4)
+        job.wait_for_event("hostA-0", "commit", min_epoch=1)
+        job.fail_host("hostA")
+        rc, out = job.wait()
+        assert rc != 0

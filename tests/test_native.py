@@ -1,0 +1,106 @@
+"""Native (C++) control-plane and timeline-writer tests.
+
+The rendezvous wire-protocol tests in test_runner.py already run
+parametrized over both engines; these cover the native-only surfaces:
+builds/loads, timeline output validity, and concurrent server load.
+"""
+
+import json
+import threading
+
+import pytest
+
+from horovod_tpu._native import load
+
+
+pytestmark = pytest.mark.skipif(load() is None,
+                                reason="native library unavailable")
+
+
+class TestNativeTimeline:
+    def test_writer_strict_json(self, tmp_path):
+        from horovod_tpu._native.control_plane import NativeTimelineWriter
+        path = tmp_path / "trace.json"
+        w = NativeTimelineWriter(str(path))
+        w.event("ALLREDUCE", "collective", "X", ts_us=10.0, dur_us=5.5,
+                pid=3, tid="grad/dense0")
+        w.event("CYCLE_1", "cycle", "i", ts_us=20.0, scope="p")
+        w.event("with args", "event", "i", ts_us=30.0,
+                args_json='{"k": "v"}')
+        w.close()
+        events = json.loads(path.read_text())
+        assert len(events) == 3
+        assert events[0] == {"name": "ALLREDUCE", "cat": "collective",
+                             "ph": "X", "ts": 10.0, "dur": 5.5, "pid": 3,
+                             "tid": "grad/dense0"}
+        assert events[1]["s"] == "p"
+        assert events[2]["args"] == {"k": "v"}
+
+    def test_escaping(self, tmp_path):
+        from horovod_tpu._native.control_plane import NativeTimelineWriter
+        path = tmp_path / "trace.json"
+        w = NativeTimelineWriter(str(path))
+        w.event('quote"back\\slash\nnewline', "c", "i", ts_us=1.0,
+                tid="tab\there")
+        w.close()
+        events = json.loads(path.read_text())
+        assert events[0]["name"] == 'quote"back\\slash\nnewline'
+        assert events[0]["tid"] == "tab\there"
+
+    def test_timeline_class_uses_native(self, tmp_path):
+        from horovod_tpu.utils.timeline import Timeline, _NativeWriterAdapter
+        path = tmp_path / "t.json"
+        tl = Timeline(str(path), rank=1)
+        assert isinstance(tl._writer, _NativeWriterAdapter)
+        tok = tl.activity_start("tensor.a", "ALLREDUCE")
+        tl.activity_end(tok)
+        tl.instant("note", args={"x": 1})
+        tl.close()
+        events = json.loads(path.read_text())
+        assert [e["name"] for e in events] == ["ALLREDUCE", "note"]
+        assert events[0]["pid"] == 1
+
+
+class TestNativeServerLoad:
+    def test_many_concurrent_clients(self):
+        from horovod_tpu.runner.rendezvous import (
+            RendezvousClient,
+            RendezvousServer,
+        )
+        srv = RendezvousServer(prefer_native=True)
+        port = srv.start()
+        assert srv._native is not None
+        n = 16
+        errors = []
+
+        def worker(i):
+            try:
+                c = RendezvousClient("127.0.0.1", port, srv.secret)
+                for j in range(20):
+                    c.put(f"k/{i}/{j}", f"v{i * 100 + j}")
+                c.barrier("load", n, timeout=30)
+                # Every client sees every key after the barrier.
+                assert len(c.keys("k/")) == n * 20
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        srv.stop()
+        assert not errors, errors
+
+    def test_kv_facade(self):
+        from horovod_tpu.runner.rendezvous import RendezvousServer
+        srv = RendezvousServer(prefer_native=True)
+        srv.start()
+        kv = srv.kv()
+        kv.put("a", "1")
+        assert kv.get("a") == "1"
+        assert kv.wait("a", timeout=1) == "1"
+        assert kv.wait("missing", timeout=0.2) is None
+        assert kv.delete("a") and not kv.delete("a")
+        srv.stop()

@@ -142,10 +142,16 @@ class TestParseArgs:
 # ---------------------------------------------------------------------------
 
 class TestRendezvous:
-    @pytest.fixture()
-    def server(self):
-        srv = RendezvousServer(prefer_native=False)
+    @pytest.fixture(params=["python", "native"])
+    def server(self, request):
+        if request.param == "native":
+            from horovod_tpu._native import load
+            if load() is None:
+                pytest.skip("native control plane not available")
+        srv = RendezvousServer(prefer_native=(request.param == "native"))
         port = srv.start()
+        if request.param == "native":
+            assert srv._native is not None, "native engine did not engage"
         yield srv, port
         srv.stop()
 
