@@ -1,0 +1,81 @@
+"""Elastic ResNet training (BASELINE config 5).
+
+Mirrors the reference's `examples/elastic/pytorch/pytorch_resnet_elastic
+.py`: state commit/restore/sync around a training loop that survives
+worker join/leave.
+
+Run under the elastic launcher:
+    horovodrun_tpu --host-discovery-script ./discover.sh --min-np 1 \\
+        python examples/elastic_resnet.py
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import resnet_apply, resnet_init
+
+
+def main():
+    hvd.init()
+    v = resnet_init(jax.random.PRNGKey(0), 18, num_classes=10)
+    cfg = v["config"]
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01 * hvd.size(),
+                                             momentum=0.9))
+
+    state = hvd.elastic.TpuState(
+        params={"params": v["params"], "batch_stats": v["batch_stats"]},
+        opt_state=opt.init(v["params"]),
+        epoch=0, batch_idx=0)
+
+    x = jnp.asarray(np.random.rand(
+        16 * hvd.local_size(), 32, 32, 3).astype(np.float32))
+    y = jnp.asarray(np.random.randint(0, 10, size=16 * hvd.local_size()))
+
+    @hvd.data_parallel
+    def train_step(model, opt_state, batch):
+        xb, yb = batch
+
+        def loss_fn(p):
+            logits, ns = resnet_apply(
+                {"params": p, "batch_stats": model["batch_stats"],
+                 "config": cfg},
+                xb, train=True, axis_name=hvd.GLOBAL_AXIS)
+            onehot = jax.nn.one_hot(yb, 10)
+            return -jnp.mean(
+                jnp.sum(jax.nn.log_softmax(logits) * onehot, -1)), ns
+
+        (loss, ns), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(model["params"])
+        updates, opt_state2 = opt.update(grads, opt_state, model["params"])
+        params = optax.apply_updates(model["params"], updates)
+        return {"params": params, "batch_stats": ns}, opt_state2, loss
+
+    @hvd.elastic.run
+    def train(state):
+        batches_per_epoch = 8
+        while state.epoch < 4:
+            while state.batch_idx < batches_per_epoch:
+                batch = hvd.shard_batch((x, y))
+                state.params, state.opt_state, loss = train_step(
+                    state.params, state.opt_state, batch)
+                state.batch_idx += 1
+                if state.batch_idx % 4 == 0:
+                    state.commit()   # snapshot + host-update check
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss={float(loss):.4f} "
+                      f"size={hvd.size()}", flush=True)
+            state.epoch += 1
+            state.batch_idx = 0
+            state.commit()
+
+    train(state)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

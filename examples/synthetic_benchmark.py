@@ -1,0 +1,118 @@
+"""ResNet synthetic benchmark (BASELINE config 2; config 4 via --use-adasum).
+
+Mirrors the reference's `examples/pytorch/pytorch_synthetic_benchmark.py`:
+synthetic ImageNet-shaped data, SGD, timed iterations, img/sec with
+stddev, total img/sec across ranks — the headline Horovod number.
+
+Run:  python examples/synthetic_benchmark.py --model resnet50 --num-iters 5
+      python examples/synthetic_benchmark.py --use-adasum
+"""
+
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import resnet_apply, resnet_init
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=[f"resnet{d}" for d in (18, 34, 50, 101, 152)])
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-warmup-batches", type=int, default=2)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=3)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--use-adasum", action="store_true",
+                   help="Adasum gradient aggregation (reference "
+                        "--use-adasum)")
+    p.add_argument("--fp16-allreduce", action="store_true",
+                   help="fp16 wire compression (reference --fp16-allreduce)")
+    args = p.parse_args()
+
+    hvd.init()
+    depth = int(args.model.replace("resnet", ""))
+    v = resnet_init(jax.random.PRNGKey(0), depth, num_classes=1000)
+    cfg = v["config"]
+    state = {"params": v["params"], "batch_stats": v["batch_stats"]}
+
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    op = hvd.Adasum if args.use_adasum else hvd.Average
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.01 * (1 if args.use_adasum else hvd.size()),
+                  momentum=0.9),
+        op=op, compression=compression)
+    opt_state = opt.init(state["params"])
+    state["params"] = hvd.broadcast_parameters(state["params"], root_rank=0)
+
+    x = jnp.asarray(np.random.rand(
+        args.batch_size * hvd.local_size(), args.image_size,
+        args.image_size, 3).astype(np.float32))
+    y = jnp.asarray(np.random.randint(
+        0, 1000, size=args.batch_size * hvd.local_size()))
+
+    @hvd.data_parallel
+    def step(state, opt_state, batch):
+        xb, yb = batch
+
+        def loss_fn(p):
+            logits, ns = resnet_apply(
+                {"params": p, "batch_stats": state["batch_stats"],
+                 "config": cfg},
+                xb, train=True, compute_dtype=jnp.bfloat16,
+                axis_name=hvd.GLOBAL_AXIS)
+            onehot = jax.nn.one_hot(yb, 1000)
+            loss = -jnp.mean(
+                jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+            return loss, ns
+
+        (loss, ns), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"])
+        updates, opt_state2 = opt.update(grads, opt_state, state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "batch_stats": ns}, opt_state2, loss
+
+    batch = hvd.shard_batch((x, y))
+
+    def run_batches(n):
+        nonlocal state, opt_state
+        for _ in range(n):
+            state, opt_state, loss = step(state, opt_state, batch)
+        jax.block_until_ready(loss)
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, batch {args.batch_size}/rank, "
+              f"{hvd.size()} rank(s)", flush=True)
+    run_batches(args.num_warmup_batches)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.time()
+        run_batches(args.num_batches_per_iter)
+        dt = time.time() - t0
+        img_sec = args.batch_size * args.num_batches_per_iter * \
+            hvd.local_size() / dt
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {img_sec:.1f} img/sec per process",
+                  flush=True)
+        img_secs.append(img_sec)
+
+    if hvd.rank() == 0:
+        mean, std = np.mean(img_secs), np.std(img_secs)
+        print(f"Img/sec per process: {mean:.1f} +- {1.96 * std:.1f}")
+        print(f"Total img/sec on {hvd.size()} rank(s): "
+              f"{mean * hvd.num_processes():.1f} +- "
+              f"{1.96 * std * hvd.num_processes():.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""PyTorch-frontend MNIST (the reference's pytorch_mnist.py, verbatim
+flow, through `horovod_tpu.torch`).
+
+The model/backward run in CPU PyTorch; gradient allreduce and parameter
+broadcast run through the XLA collective core.
+
+Run:  python examples/torch_mnist.py [--epochs 1]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+from examples.mnist import synthetic_mnist
+
+
+class Net(torch.nn.Module):
+    """The reference example's conv net (pytorch_mnist.py `Net`)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = torch.nn.Conv2d(10, 20, kernel_size=5)
+        self.conv2_drop = torch.nn.Dropout2d()
+        self.fc1 = torch.nn.Linear(320, 50)
+        self.fc2 = torch.nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2_drop(self.conv2(x)), 2))
+        x = x.view(-1, 320)
+        x = F.relu(self.fc1(x))
+        x = F.dropout(x, training=self.training)
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42 + hvd.rank())
+
+    images, labels = synthetic_mnist(2048)
+    x = torch.from_numpy(images.transpose(0, 3, 1, 2).copy())
+    y = torch.from_numpy(labels)
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size(), momentum=0.5)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    model.train()
+    n = len(x) // args.batch_size
+    for epoch in range(args.epochs):
+        for i in range(n):
+            s = slice(i * args.batch_size, (i + 1) * args.batch_size)
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(x[s]), y[s])
+            loss.backward()
+            optimizer.step()
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(loss.detach()):.4f}",
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

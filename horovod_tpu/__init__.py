@@ -106,4 +106,20 @@ from .utils.timeline import (  # noqa: F401
     stop_timeline,
 )
 
+from .utils.autotune import (  # noqa: F401
+    ParameterManager,
+    get_manager as autotune_manager,
+)
+
+
+def autotune_record_step(items: float = 1.0) -> None:
+    """Feed the autotuner one training step of `items` samples/tokens
+    (no-op unless HOROVOD_AUTOTUNE=1).  Reference: parameter_manager.cc
+    Update() driven by the background loop's tensor throughput."""
+    from .utils import autotune as _at
+    mgr = _at.get_manager()
+    if mgr is not None:
+        mgr.record_step(items)
+
+from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401

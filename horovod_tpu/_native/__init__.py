@@ -30,19 +30,29 @@ _lib_lock = threading.Lock()
 
 
 def _build() -> bool:
+    # Compile to a process-unique temp path and os.rename into place:
+    # rename is atomic, so concurrent builders from separate processes
+    # can never publish a truncated .so.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        "-o", _LIB, _SRC,
+        "-o", tmp, _SRC,
     ]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if out.returncode != 0:
+            logger.warning("native build failed:\n%s", out.stderr)
+            return False
+        os.replace(tmp, _LIB)
+        return True
     except (OSError, subprocess.TimeoutExpired) as e:
         logger.debug("native build failed to run: %s", e)
         return False
-    if out.returncode != 0:
-        logger.warning("native build failed:\n%s", out.stderr)
-        return False
-    return True
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def load(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:

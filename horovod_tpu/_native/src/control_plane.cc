@@ -28,6 +28,7 @@
 #include <cstdio>
 #include <cstring>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <set>
 #include <string>
@@ -371,6 +372,13 @@ std::string json_escape(const std::string& s) {
 
 class KVStore {
  public:
+  // Wakes every blocked wait/barrier immediately (server shutdown).
+  void shutdown() {
+    std::lock_guard<std::mutex> g(mu_);
+    shutdown_ = true;
+    cv_.notify_all();
+  }
+
   void put(const std::string& k, const std::string& v) {
     std::lock_guard<std::mutex> g(mu_);
     data_[k] = v;
@@ -390,6 +398,7 @@ class KVStore {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::duration<double>(timeout_s);
     while (data_.find(k) == data_.end()) {
+      if (shutdown_) return false;
       if (cv_.wait_until(g, deadline) == std::cv_status::timeout &&
           data_.find(k) == data_.end())
         return false;
@@ -426,8 +435,10 @@ class KVStore {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::duration<double>(timeout_s);
     while (barriers_[name].first == my_gen) {
-      if (cv_.wait_until(g, deadline) == std::cv_status::timeout &&
-          barriers_[name].first == my_gen) {
+      bool timed_out =
+          shutdown_ ||
+          cv_.wait_until(g, deadline) == std::cv_status::timeout;
+      if (timed_out && barriers_[name].first == my_gen) {
         auto& cur = barriers_[name];
         if (cur.first == my_gen && cur.second > 0) cur.second--;
         return false;
@@ -441,6 +452,7 @@ class KVStore {
   std::condition_variable cv_;
   std::map<std::string, std::string> data_;
   std::map<std::string, std::pair<int, int>> barriers_;
+  bool shutdown_ = false;
 };
 
 // ---------------------------------------------------------------------------
@@ -476,20 +488,43 @@ class ControlPlaneServer {
     return bound_port_;
   }
 
-  void stop() {
+  // Signal shutdown without joining (safe to call from a handler thread
+  // servicing the SHUTDOWN op): stops accepting, wakes every blocked
+  // wait/barrier, and half-closes live connections so their recv returns.
+  void request_stop() {
     if (!running_.exchange(false)) return;
     shutdown(listen_fd_, SHUT_RDWR);
-    close(listen_fd_);
+    store_.shutdown();
+    std::lock_guard<std::mutex> g(reg_->mu);
+    for (int fd : reg_->fds) shutdown(fd, SHUT_RDWR);
+  }
+
+  // Full teardown (owner thread only): request stop, then wait for the
+  // accept loop and every handler thread to drain.  Once reg_->fds is
+  // empty every handler has returned from handle_conn (no further access
+  // to `this`); their final registry touch is safe because reg_ is a
+  // shared_ptr each handler co-owns.  Returns false if handlers failed
+  // to drain — the caller must then leak the object rather than free it
+  // under a live thread.
+  bool stop() {
+    request_stop();
     if (accept_thread_.joinable()) accept_thread_.join();
-    std::lock_guard<std::mutex> g(conn_mu_);
-    for (auto& t : conn_threads_)
-      if (t.joinable()) t.join();
-    conn_threads_.clear();
+    std::unique_lock<std::mutex> g(reg_->mu);
+    return reg_->cv.wait_for(g, std::chrono::seconds(10),
+                             [this] { return reg_->fds.empty(); });
   }
 
   ~ControlPlaneServer() { stop(); }
 
  private:
+  // Liveness record for detached handler threads; shared so handlers can
+  // outlive the server object during teardown.
+  struct ConnRegistry {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::set<int> fds;
+  };
+
   void accept_loop() {
     while (running_) {
       int fd = accept(listen_fd_, nullptr, nullptr);
@@ -497,9 +532,31 @@ class ControlPlaneServer {
         if (!running_) break;
         continue;
       }
-      std::lock_guard<std::mutex> g(conn_mu_);
-      conn_threads_.emplace_back([this, fd] { handle_conn(fd); });
+      {
+        std::lock_guard<std::mutex> g(reg_->mu);
+        if (!running_) {  // raced with request_stop
+          close(fd);
+          continue;
+        }
+        reg_->fds.insert(fd);
+      }
+      // Handlers detach; the fd registry (not thread handles) is the
+      // liveness record, so long-lived servers never accumulate
+      // joinable-thread stacks.  Erase BEFORE close: the kernel can
+      // recycle the fd number the instant it is closed, and a stale
+      // registry entry would alias the new connection.
+      auto reg = reg_;
+      std::thread([this, reg, fd] {
+        handle_conn(fd);
+        {
+          std::lock_guard<std::mutex> g(reg->mu);
+          reg->fds.erase(fd);
+        }
+        close(fd);
+        reg->cv.notify_all();
+      }).detach();
     }
+    close(listen_fd_);
   }
 
   bool read_line(int fd, std::string* line) {
@@ -593,13 +650,14 @@ class ControlPlaneServer {
         send_obj(fd, "{\"ok\":true,\"value\":\"pong\"}");
       } else if (op == "SHUTDOWN") {
         send_obj(fd, "{\"ok\":true}");
-        std::thread([this] { stop(); }).detach();
+        // Signal-only from a handler thread; the owner's stop() joins.
+        request_stop();
         break;
       } else {
         send_obj(fd, "{\"ok\":false,\"error\":\"unknown op\"}");
       }
     }
-    close(fd);
+    // fd is closed by the accept-loop wrapper after deregistration.
   }
 
   std::string secret_;
@@ -608,8 +666,7 @@ class ControlPlaneServer {
   int bound_port_ = 0;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
-  std::mutex conn_mu_;
-  std::vector<std::thread> conn_threads_;
+  std::shared_ptr<ConnRegistry> reg_ = std::make_shared<ConnRegistry>();
 };
 
 // ---------------------------------------------------------------------------
@@ -627,6 +684,8 @@ class TimelineWriter {
       thread_ = std::thread([this] { run(); });
     }
   }
+
+  bool ok() const { return f_ != nullptr; }
 
   // Field conventions match the Python writer (timeline.py): pid = rank,
   // tid = tensor/activity name (string), dur_us < 0 omitted, scope "" or
@@ -728,12 +787,26 @@ void* hvdtpu_cp_start(const char* secret, int port, int* bound_port) {
 
 void hvdtpu_cp_stop(void* handle) {
   auto* s = static_cast<ControlPlaneServer*>(handle);
-  s->stop();
-  delete s;
+  if (s->stop()) {
+    delete s;
+  } else {
+    // Handlers failed to drain within the grace period; deleting would
+    // free memory a live thread still uses.  Leak deliberately (rare:
+    // request_stop half-closes every registered socket, so handlers
+    // normally exit promptly).
+    fprintf(stderr,
+            "[horovod_tpu native] control-plane handlers did not drain; "
+            "leaking server object\n");
+  }
 }
 
 void* hvdtpu_tl_open(const char* path, int pid) {
-  return new TimelineWriter(path, pid);
+  auto* w = new TimelineWriter(path, pid);
+  if (!w->ok()) {  // unwritable path: report failure so callers can
+    delete w;      // fall back to the Python writer
+    return nullptr;
+  }
+  return w;
 }
 
 void hvdtpu_tl_event(void* h, const char* name, const char* cat,

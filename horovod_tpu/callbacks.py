@@ -1,0 +1,104 @@
+"""Training-loop callbacks (reference: horovod/_keras/callbacks.py,
+horovod/tensorflow/keras/callbacks.py).
+
+The reference ships four standard Keras callbacks; these are their
+framework-neutral equivalents for JAX training loops (and the torch shim).
+A loop drives them explicitly:
+
+    cbs = [hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+           hvd.callbacks.MetricAverageCallback(),
+           hvd.callbacks.LearningRateWarmupCallback(5, 1e-3)]
+    state = cb.on_train_begin(state) ...   # see each class
+
+Each callback is a small object with explicit hooks instead of a Keras
+binding, because there is no global model object to mutate in JAX —
+state goes in, state comes out.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from .ops import collectives as C
+from .ops import functions as F
+
+logger = logging.getLogger("horovod_tpu.callbacks")
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast initial state from `root_rank` to every rank before
+    training (reference: BroadcastGlobalVariablesCallback — run once on
+    train begin so all ranks start identical)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_train_begin(self, state: Any) -> Any:
+        if self._done:
+            return state
+        self._done = True
+        return F.broadcast_parameters(state, root_rank=self.root_rank)
+
+
+class MetricAverageCallback:
+    """Average metrics across ranks at epoch end (reference:
+    MetricAverageCallback)."""
+
+    def on_epoch_end(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            k: C.allreduce(v, op=C.Average, name=f"metric.{k}")
+            for k, v in metrics.items()
+        }
+
+
+class LearningRateWarmupCallback:
+    """Linear LR warmup from `initial_lr/size` to `initial_lr` over
+    `warmup_epochs` (reference: LearningRateWarmupCallback — the
+    "facebook 1-hour" warmup for large effective batches).
+
+    Use `lr(epoch, batches_per_epoch, batch)` inside an optax schedule or
+    loop; after warmup it returns `initial_lr` unchanged.
+    """
+
+    def __init__(self, warmup_epochs: int, initial_lr: float,
+                 verbose: bool = False):
+        from .common import basics
+        self.warmup_epochs = warmup_epochs
+        self.initial_lr = initial_lr
+        self.size = basics.size() if basics.is_initialized() else 1
+        self.verbose = verbose
+
+    def lr(self, epoch: int, batches_per_epoch: int = 1,
+           batch: int = 0) -> float:
+        if epoch >= self.warmup_epochs:
+            return self.initial_lr
+        progress = (epoch * batches_per_epoch + batch) / max(
+            1, self.warmup_epochs * batches_per_epoch)
+        start = self.initial_lr / self.size
+        lr = start + (self.initial_lr - start) * progress
+        if self.verbose and batch == 0:
+            logger.info("warmup epoch %d: lr=%.6f", epoch, lr)
+        return lr
+
+
+class LearningRateScheduleCallback:
+    """Piecewise LR multipliers by epoch range (reference:
+    LearningRateScheduleCallback; the resnet example's staircase decay).
+
+    schedule: list of dicts {"start_epoch": s, "end_epoch": e,
+    "multiplier": m} — first matching row wins; multiplier may be a
+    callable epoch -> float.
+    """
+
+    def __init__(self, schedule, initial_lr: float):
+        self.schedule = schedule
+        self.initial_lr = initial_lr
+
+    def lr(self, epoch: int) -> float:
+        for row in self.schedule:
+            if row["start_epoch"] <= epoch < row.get("end_epoch", 1 << 31):
+                m = row["multiplier"]
+                return self.initial_lr * (m(epoch) if callable(m) else m)
+        return self.initial_lr

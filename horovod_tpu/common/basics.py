@@ -209,11 +209,13 @@ def init(
         # HOROVOD_TIMELINE / HOROVOD_STALL_CHECK_TIME_SECONDS.  Their
         # single source of truth is the module-level handle in each module
         # (timeline.get_timeline() / stall_inspector.get_inspector()).
+        from ..utils import autotune as _at_mod
         from ..utils import stall_inspector as _stall_mod
         from ..utils import timeline as _tl_mod
 
         _tl_mod.init_from_env(rank())
         _stall_mod.init_from_env()
+        _at_mod.init_from_env()
 
         logger.info(
             "horovod_tpu initialized: size=%d local_size=%d process=%d/%d "
@@ -239,12 +241,14 @@ def shutdown() -> None:
             return
         # Clear cached compiled collectives — they bake in the old mesh.
         from ..ops import collectives as _coll  # local import: avoid cycle
+        from ..utils import autotune as _at_mod
         from ..utils import stall_inspector as _stall_mod
         from ..utils import timeline as _tl_mod
 
         _coll.clear_caches()
         _tl_mod.stop_timeline()
         _stall_mod.shutdown_inspector()
+        _at_mod.shutdown_manager()
         _global_state = None
 
 

@@ -17,3 +17,11 @@ from .mnist import (  # noqa: F401
     mnist_cnn_apply,
     nll_loss,
 )
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    make_train_step,
+    stack_for_pipeline,
+    transformer_init,
+    transformer_pspecs,
+    transformer_ref_apply,
+)

@@ -1,0 +1,426 @@
+"""Flagship decoder-only transformer LM, sharded over dp/tp/pp/ep/sp.
+
+Beyond-parity model (the reference is DP-only, SURVEY.md §2.6): this LM
+exercises the whole parallelism substrate — tensor-parallel attention/MLP
+(Megatron-style column/row splits with psum over `tp`), ring-attention or
+Ulysses sequence parallelism over `sp`, Switch-MoE expert parallelism
+over `ep`, GPipe pipeline over `pp`, and data parallelism over `dp` with
+gradient reduction fused into the backward pass by shard_map's transpose
+(replicated in_spec → psum), the SPMD analog of
+hvd.DistributedOptimizer's allreduce.
+
+Design: ONE shard_map over the full mesh; every collective is explicit
+(`psum`/`ppermute`/`all_to_all` on named axes riding ICI).  bf16 compute,
+f32 params/accumulation.  `*_ref` functions are the single-device oracle
+the tests compare against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel import moe as moe_mod
+from ..parallel import sequence as seq_mod
+from . import layers as L
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    d_head: int = 64
+    d_ff: int = 2048
+    n_layers: int = 8
+    moe_every: int = 0          # 0 = dense; k = every k-th layer is MoE
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    rope_theta: float = 10000.0
+    compute_dtype: Any = jnp.bfloat16
+    attn_impl: str = "ring"     # "ring" | "ulysses" (used when sp > 1)
+    aux_loss_weight: float = 0.01
+
+
+# ---------------------------------------------------------------------------
+# Init — layer-stacked params [L, ...] (scan- and pipeline-friendly)
+# ---------------------------------------------------------------------------
+
+def transformer_init(key, cfg: TransformerConfig) -> Dict:
+    keys = jax.random.split(key, 8)
+    D, H, Dh, F, Lr = (cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff,
+                       cfg.n_layers)
+    s_d = 1.0 / math.sqrt(D)
+    s_f = 1.0 / math.sqrt(F)
+    s_hd = 1.0 / math.sqrt(H * Dh)
+
+    def norm(k, shape, scale):
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    params = {
+        "embed": norm(keys[0], (cfg.vocab_size, D), s_d),
+        "final_norm": {"scale": jnp.ones((D,), jnp.float32)},
+        "blocks": {
+            "ln1": {"scale": jnp.ones((Lr, D), jnp.float32)},
+            "ln2": {"scale": jnp.ones((Lr, D), jnp.float32)},
+            "wq": norm(keys[1], (Lr, D, H, Dh), s_d),
+            "wk": norm(keys[2], (Lr, D, H, Dh), s_d),
+            "wv": norm(keys[3], (Lr, D, H, Dh), s_d),
+            "wo": norm(keys[4], (Lr, H, Dh, D), s_hd),
+            "wi": norm(keys[5], (Lr, D, F), s_d),
+            "wg": norm(keys[6], (Lr, D, F), s_d),
+            "wd": norm(keys[7], (Lr, F, D), s_f),
+        },
+    }
+    if cfg.moe_every:
+        n_moe = sum(1 for i in range(Lr) if (i + 1) % cfg.moe_every == 0)
+        mkeys = jax.random.split(jax.random.fold_in(key, 99), n_moe)
+        params["moe"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[moe_mod.moe_init(mkeys[i], cfg.n_experts, D, F)
+              for i in range(n_moe)])
+    return params
+
+
+def _is_moe_layer(cfg: TransformerConfig, i: int) -> bool:
+    return bool(cfg.moe_every) and (i + 1) % cfg.moe_every == 0
+
+
+# ---------------------------------------------------------------------------
+# Shared layer math (full-array; works on local shards too)
+# ---------------------------------------------------------------------------
+
+def _rope(x, positions, theta: float):
+    """Rotary embedding: x [B, T, H, Dh], positions [T]."""
+    Dh = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, Dh, 2, dtype=jnp.float32) / Dh)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)           # [T, Dh/2]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape)
+
+
+def _rmsnorm(scale, x):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)
+            * scale).astype(x.dtype)
+
+
+def _attention_block(lp, x, positions, cfg, tp_axis, sp_axis):
+    """Pre-norm attention with RoPE.  lp: this layer's params (unstacked).
+    Inside shard_map: heads sharded over tp, sequence over sp."""
+    dt = cfg.compute_dtype
+    h = _rmsnorm(lp["ln1"]["scale"], x)
+    q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dt))
+    k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dt))
+    v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dt))
+    q = _rope(q, positions, cfg.rope_theta).astype(dt)
+    k = _rope(k, positions, cfg.rope_theta).astype(dt)
+    if sp_axis is not None:
+        if cfg.attn_impl == "ulysses":
+            o = seq_mod.ulysses_attention_shard(q, k, v, sp_axis)
+        else:
+            o = seq_mod.ring_attention_shard(q, k, v, sp_axis)
+    else:
+        o = seq_mod.full_attention(q, k, v, causal=True)
+    out = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(dt))
+    if tp_axis is not None:
+        out = lax.psum(out, tp_axis)   # row-parallel wo
+    return x + out.astype(x.dtype)
+
+
+def _mlp_block(lp, x, cfg, tp_axis):
+    """Pre-norm SwiGLU MLP; d_ff sharded over tp (column wi/wg, row wd)."""
+    dt = cfg.compute_dtype
+    h = _rmsnorm(lp["ln2"]["scale"], x)
+    up = jnp.einsum("btd,df->btf", h, lp["wi"].astype(dt))
+    gate = jax.nn.silu(jnp.einsum("btd,df->btf", h, lp["wg"].astype(dt)))
+    out = jnp.einsum("btf,fd->btd", up * gate, lp["wd"].astype(dt))
+    if tp_axis is not None:
+        out = lax.psum(out, tp_axis)
+    return x + out.astype(x.dtype)
+
+
+def _moe_block(mp, scale, x, cfg, ep_axis):
+    """MoE layer replacing the MLP; reuses the layer's ln2 scale."""
+    h = _rmsnorm(scale, x)
+    if ep_axis is not None:
+        out, aux = moe_mod.moe_apply_shard(
+            mp, h, axis=ep_axis, capacity_factor=cfg.capacity_factor,
+            compute_dtype=cfg.compute_dtype)
+    else:
+        out, aux = moe_mod.moe_apply_dense(
+            mp, h, capacity_factor=cfg.capacity_factor,
+            compute_dtype=cfg.compute_dtype)
+    return x + out.astype(x.dtype), aux["aux_loss"]
+
+
+# ---------------------------------------------------------------------------
+# Reference (single-device) forward — the numerical oracle
+# ---------------------------------------------------------------------------
+
+def transformer_ref_apply(params: Dict, tokens, cfg: TransformerConfig):
+    """tokens [B, T] → logits [B, T, V]; returns (logits, aux_loss)."""
+    x = params["embed"][tokens].astype(cfg.compute_dtype)
+    positions = jnp.arange(tokens.shape[1])
+    aux_total = jnp.zeros((), jnp.float32)
+    moe_idx = 0
+    for i in range(cfg.n_layers):
+        lp = jax.tree_util.tree_map(lambda p: p[i], params["blocks"])
+        x = _attention_block(lp, x, positions, cfg, None, None)
+        if _is_moe_layer(cfg, i):
+            mp = jax.tree_util.tree_map(lambda p: p[moe_idx], params["moe"])
+            x, aux = _moe_block(mp, lp["ln2"]["scale"], x, cfg, None)
+            aux_total += aux
+            moe_idx += 1
+        else:
+            x = _mlp_block(lp, x, cfg, None)
+    x = _rmsnorm(params["final_norm"]["scale"], x)
+    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits, aux_total
+
+
+# ---------------------------------------------------------------------------
+# Sharded forward (inside ONE shard_map over the full mesh)
+# ---------------------------------------------------------------------------
+
+def _layer_seq(block_params, moe_params, x, positions, cfg,
+               layer_offset: int, n_layers: int,
+               tp_axis, sp_axis, ep_axis):
+    """Apply `n_layers` consecutive layers starting at global index
+    `layer_offset`.  Params carry a leading [n_layers] (and [n_moe]) dim."""
+    aux_total = jnp.zeros((), jnp.float32)
+    moe_idx = 0
+    for j in range(n_layers):
+        lp = jax.tree_util.tree_map(lambda p: p[j], block_params)
+        x = _attention_block(lp, x, positions, cfg, tp_axis, sp_axis)
+        if _is_moe_layer(cfg, layer_offset + j):
+            mp = jax.tree_util.tree_map(lambda p: p[moe_idx], moe_params)
+            x, aux = _moe_block(mp, lp["ln2"]["scale"], x, cfg, ep_axis)
+            aux_total += aux
+            moe_idx += 1
+        else:
+            x = _mlp_block(lp, x, cfg, tp_axis)
+    return x, aux_total
+
+
+def _forward_shard(params, tokens, cfg: TransformerConfig,
+                   axes: Dict[str, bool], n_microbatches: int):
+    """Per-shard forward.  tokens [B_local, T_local].  Returns
+    (x_final [B_local, T_local, D], aux_loss)."""
+    tp_axis = "tp" if axes.get("tp") else None
+    sp_axis = "sp" if axes.get("sp") else None
+    ep_axis = "ep" if axes.get("ep") else None
+    pp = axes.get("pp")
+
+    Tl = tokens.shape[1]
+    sp_off = (lax.axis_index(sp_axis) * Tl) if sp_axis else 0
+    positions = sp_off + jnp.arange(Tl)
+    x = params["embed"][tokens].astype(cfg.compute_dtype)
+
+    if not pp:
+        x, aux = _layer_seq(
+            params["blocks"], params.get("moe"), x, positions, cfg,
+            0, cfg.n_layers, tp_axis, sp_axis, ep_axis)
+        return x, aux
+
+    # Pipeline: blocks leaves arrive as [1, L/pp, ...] (pp-sharded);
+    # aux (MoE balance) loss is not threaded through the pipeline carry —
+    # with pp>1 it is omitted (documented limitation).
+    from ..parallel.pipeline import gpipe_shard
+
+    blocks = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0),
+                                    params["blocks"])
+    moe = (jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0),
+                                  params["moe"])
+           if "moe" in params else None)
+    l_per_stage = blocks["wq"].shape[0]
+    # The layer pattern must be stage-periodic so every stage runs the
+    # same program (checked at trace time by transformer_pspecs).
+
+    def stage_fn(sp_params, h):
+        h, _ = _layer_seq(
+            sp_params["blocks"], sp_params.get("moe"), h, positions, cfg,
+            0, l_per_stage, tp_axis, sp_axis, ep_axis)
+        return h
+
+    B = x.shape[0]
+    M = n_microbatches
+    assert B % M == 0, f"local batch {B} not divisible by {M} microbatches"
+    x_mb = x.reshape((M, B // M) + x.shape[1:])
+    sp_params = {"blocks": blocks}
+    if moe is not None:
+        sp_params["moe"] = moe
+    out = gpipe_shard(stage_fn, sp_params, x_mb, axis="pp")
+    x = out.reshape((B,) + out.shape[2:])
+    return x, jnp.zeros((), jnp.float32)
+
+
+def _loss_shard(params, tokens, targets, cfg: TransformerConfig,
+                axes: Dict[str, bool], n_microbatches: int):
+    """Per-shard scalar loss, replicated via psum over every present
+    axis.  With pp, only the last stage's head-path contributes (masking
+    prevents the pp-fold gradient overcount through the tied embedding)."""
+    x, aux = _forward_shard(params, tokens, cfg, axes, n_microbatches)
+    x = _rmsnorm(params["final_norm"]["scale"], x)
+    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+    batch_axes = [a for a in ("dp", "ep", "sp", "pp") if axes.get(a)]
+    local_sum = jnp.sum(ce)
+    local_cnt = jnp.asarray(ce.size, jnp.float32)
+    if axes.get("pp"):
+        pp_size = lax.psum(1, "pp")
+        is_last = (lax.axis_index("pp") == pp_size - 1).astype(jnp.float32)
+        local_sum = local_sum * is_last
+        local_cnt = local_cnt * is_last
+    if batch_axes:
+        total = lax.psum(local_sum, tuple(batch_axes))
+        count = lax.psum(local_cnt, tuple(batch_axes))
+    else:
+        total, count = local_sum, local_cnt
+    loss = total / count
+    if cfg.moe_every and not axes.get("pp"):
+        # pmean over every batch-ish axis: aux differs per dp/ep/sp shard
+        # (local tokens), and the loss must be replicated so the transpose
+        # doesn't overcount the balance gradient.
+        aux_axes = tuple(a for a in ("dp", "ep", "sp") if axes.get(a))
+        aux_mean = lax.pmean(aux, aux_axes) if aux_axes else aux
+        loss = loss + cfg.aux_loss_weight * aux_mean
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules + train-step builder
+# ---------------------------------------------------------------------------
+
+def stack_for_pipeline(params: Dict, pp: int, cfg: TransformerConfig) -> Dict:
+    """Reshape layer-stacked [L, ...] leaves to [pp, L/pp, ...] (and MoE
+    [Lm, ...] to [pp, Lm/pp, ...]) for pp-sharded in_specs."""
+    if pp <= 1:
+        return params
+    L = cfg.n_layers
+    if L % pp:
+        raise ValueError(f"n_layers {L} not divisible by pp {pp}")
+    if cfg.moe_every and (L // pp) % cfg.moe_every:
+        raise ValueError(
+            f"layers-per-stage {L // pp} must be a multiple of "
+            f"moe_every {cfg.moe_every} so stages are uniform")
+    out = dict(params)
+    out["blocks"] = jax.tree_util.tree_map(
+        lambda p: p.reshape((pp, L // pp) + p.shape[1:]), params["blocks"])
+    if "moe" in params:
+        Lm = jax.tree_util.tree_leaves(params["moe"])[0].shape[0]
+        out["moe"] = jax.tree_util.tree_map(
+            lambda p: p.reshape((pp, Lm // pp) + p.shape[1:]),
+            params["moe"])
+    return out
+
+
+def transformer_pspecs(cfg: TransformerConfig, pp: int = 1) -> Dict:
+    """PartitionSpec tree matching `transformer_init` output (after
+    `stack_for_pipeline` when pp > 1)."""
+    from jax.sharding import PartitionSpec as P
+
+    lead = ("pp",) if pp > 1 else ()
+
+    def bspec(*rest):
+        return P(*lead, None, *rest)   # [pp?, L(/pp), ...]
+
+    specs = {
+        "embed": P(),
+        "final_norm": {"scale": P()},
+        "blocks": {
+            "ln1": {"scale": bspec(None)},
+            "ln2": {"scale": bspec(None)},
+            "wq": bspec(None, "tp", None),
+            "wk": bspec(None, "tp", None),
+            "wv": bspec(None, "tp", None),
+            "wo": bspec("tp", None, None),
+            "wi": bspec(None, "tp"),
+            "wg": bspec(None, "tp"),
+            "wd": bspec("tp", None),
+        },
+    }
+    if cfg.moe_every:
+        specs["moe"] = {
+            "gate": {"kernel": bspec(None, None)},
+            "wi": bspec("ep", None, None),
+            "wo": bspec("ep", None, None),
+        }
+    return specs
+
+
+def make_train_step(mesh, cfg: TransformerConfig, optimizer,
+                    n_microbatches: Optional[int] = None):
+    """Build (init_sharded_state, jitted train_step) for the mesh.
+
+    train_step(params, opt_state, (tokens, targets)) →
+    (params, opt_state, loss).  Gradient reduction over dp is the
+    shard_map transpose of the replicated param specs — the compiled
+    analog of hvd.DistributedOptimizer.
+    """
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axes = {a: mesh.shape.get(a, 1) > 1 for a in mesh.axis_names}
+    pp = mesh.shape.get("pp", 1)
+    M = n_microbatches or max(1, pp)
+    pspecs = transformer_pspecs(cfg, pp)
+    data_spec = P(tuple(a for a in ("dp", "ep") if axes.get(a)) or None,
+                  "sp" if axes.get("sp") else None)
+
+    def loss_fn(params, tokens, targets):
+        body = lambda p, t, y: _loss_shard(p, t, y, cfg, axes, M)
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(pspecs, data_spec, data_spec),
+            out_specs=P(), check_vma=False,
+        )(params, tokens, targets)
+
+    def train_step(params, opt_state, batch):
+        tokens, targets = batch
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    def shard_state(params, opt_state):
+        """Place params/opt_state on the mesh per the sharding rules."""
+        params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            params, pspecs)
+        # Optimizer state: momentum-like leaves mirror the param tree; any
+        # leaf whose shape matches a param leaf inherits its spec, scalars
+        # replicate.
+        flat_params, _ = jax.tree_util.tree_flatten(params)
+        flat_specs = jax.tree_util.tree_leaves(pspecs)
+        shape_to_spec = {}
+        for p, s in zip(flat_params, flat_specs):
+            shape_to_spec.setdefault(p.shape, s)
+
+        def place_opt(leaf):
+            spec = shape_to_spec.get(getattr(leaf, "shape", None), P())
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+        opt_state = jax.tree_util.tree_map(place_opt, opt_state)
+        return params, opt_state
+
+    def shard_lm_batch(batch):
+        tokens, targets = batch
+        sh = NamedSharding(mesh, data_spec)
+        return (jax.device_put(tokens, sh), jax.device_put(targets, sh))
+
+    return jax.jit(train_step, donate_argnums=(0, 1)), shard_state, \
+        shard_lm_batch

@@ -48,12 +48,21 @@ def allreduce_gradients(
     compression=Compression.none,
     axis_name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
-    fusion_threshold_bytes: int = 64 * 1024 * 1024,
+    fusion_threshold_bytes: Optional[int] = None,
 ) -> Any:
     """Average a gradient pytree across ranks with wire compression and
     fusion-buffer-style bucketing (reference: FusionBufferManager — here
     bucketing is concatenation in the traced graph; multiple buckets let
-    XLA overlap collectives with remaining backward compute)."""
+    XLA overlap collectives with remaining backward compute).
+
+    `fusion_threshold_bytes` defaults to HOROVOD_FUSION_THRESHOLD (64 MB,
+    the reference default), overridden live by the autotuner when
+    HOROVOD_AUTOTUNE=1."""
+    if fusion_threshold_bytes is None:
+        from ..utils import autotune as _at
+        from ..common import util as _util
+        fusion_threshold_bytes = _at.tuned_fusion_threshold(
+            _util.env_int("FUSION_THRESHOLD", 64 * 1024 * 1024))
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads

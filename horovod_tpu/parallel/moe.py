@@ -21,8 +21,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..models import layers as L
-
 
 def moe_init(key, n_experts: int, d_model: int, d_ff: int,
              dtype=jnp.float32) -> Dict:
@@ -61,18 +59,23 @@ def _gating(logits, n_experts: int, capacity: int):
 def moe_apply_shard(params: Dict, x, axis: str = "ep",
                     capacity_factor: float = 1.25,
                     compute_dtype=None) -> Tuple[jnp.ndarray, Dict]:
-    """Switch MoE inside shard_map: tokens sharded over `axis`, experts
-    sharded over `axis` (E % ep_size == 0).
+    """Switch MoE inside shard_map: tokens sharded over `axis`, expert
+    weights *pre-sharded* over `axis` — `params["wi"]/["wo"]` carry only
+    this shard's `e_local = E/ep` experts (in_specs P('ep', ...)), which
+    is the point of expert parallelism: no replicated expert memory.
+    The gate kernel [D, E] is replicated.
 
     x: [B, T_local, D] per shard.  Returns (output [B, T_local, D],
     aux dict with load-balancing loss).
     """
     ep = lax.psum(1, axis)
     B, Tl, D = x.shape
-    E = params["wi"].shape[0]          # global expert count
-    if E % ep:
-        raise ValueError(f"experts ({E}) must divide over ep ({ep})")
-    e_local = E // ep
+    e_local = params["wi"].shape[0]
+    E = e_local * ep                   # global expert count
+    if params["gate"]["kernel"].shape[-1] != E:
+        raise ValueError(
+            f"gate kernel expects {params['gate']['kernel'].shape[-1]} "
+            f"experts, but sharded weights imply {E}")
     tokens = x.reshape(B * Tl, D)
     dtype = compute_dtype or x.dtype
 
@@ -89,30 +92,24 @@ def moe_apply_shard(params: Dict, x, axis: str = "ep",
     aux_loss = E * jnp.sum(frac_tokens * frac_probs)
 
     # Dispatch: [T, E, C] x [T, D] -> [E, C, D]; route expert shards to
-    # their owners over the ep axis.
+    # their owners over the ep axis.  Tiled all_to_all: expert dim splits
+    # into ep groups of e_local, each peer's group concatenates along the
+    # queue dim -> [e_local, ep*C, D] (peer-major queue order); the return
+    # trip is the exact inverse, restoring (ep, e_local)-major expert
+    # order, which matches the gate's global expert indexing.
     expert_inputs = jnp.einsum("tec,td->ecd",
                                dispatch.astype(dtype), tokens.astype(dtype))
-    # [E, C, D] -> all_to_all -> [e_local, ep*C, D]: each shard keeps its
-    # local experts' queues from every peer.
     expert_inputs = lax.all_to_all(
-        expert_inputs.reshape(ep, e_local, capacity, D),
-        axis, split_axis=0, concat_axis=2, tiled=False,
-    ).reshape(e_local, ep * capacity, D)
+        expert_inputs, axis, split_axis=0, concat_axis=1, tiled=True)
 
     # Expert FFN (relu MLP) — one batched MXU matmul per projection.
-    wi = lax.dynamic_slice_in_dim(
-        params["wi"], lax.axis_index(axis) * e_local, e_local, 0)
-    wo = lax.dynamic_slice_in_dim(
-        params["wo"], lax.axis_index(axis) * e_local, e_local, 0)
     h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_inputs,
-                               wi.astype(dtype)))
-    expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(dtype))
+                               params["wi"].astype(dtype)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["wo"].astype(dtype))
 
-    # Route back and combine.
+    # Route back (inverse all_to_all) and combine.
     expert_out = lax.all_to_all(
-        expert_out.reshape(e_local, ep, capacity, D),
-        axis, split_axis=1, concat_axis=0, tiled=False,
-    ).reshape(E, capacity, D)
+        expert_out, axis, split_axis=1, concat_axis=0, tiled=True)
     out = jnp.einsum("tec,ecd->td", combine.astype(dtype), expert_out)
     return out.reshape(B, Tl, D).astype(x.dtype), {"aux_loss": aux_loss}
 

@@ -42,7 +42,7 @@ def DistributedGradientTransformation(
     average_aggregated_gradients: bool = True,
     axis_name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
-    fusion_threshold_bytes: int = 64 * 1024 * 1024,
+    fusion_threshold_bytes: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap `optimizer` so updates are computed from cross-rank-reduced
     gradients.  See module docstring for the reference mapping."""

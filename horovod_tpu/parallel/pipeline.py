@@ -23,7 +23,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -93,6 +93,6 @@ def gpipe(mesh: Mesh, stage_fn: Callable, params: Any, x,
     p_specs = jax.tree_util.tree_map(lambda _: P(axis), params)
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(p_specs, P()), out_specs=P(),
-                   check_rep=False)
+                   check_vma=False)
     out_mb = fn(params, x_mb)
     return out_mb.reshape((B,) + out_mb.shape[2:])

@@ -28,7 +28,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 _NEG = -1e30
@@ -135,7 +135,7 @@ def _mesh_wrap(shard_fn, mesh: Mesh, axis: str, q, k, v, causal: bool):
     fn = shard_map(
         functools.partial(shard_fn, axis=axis, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)
 
 

@@ -103,6 +103,11 @@ class ElasticDriver:
 
     def _publish_generation(self, slots: List[SlotInfo]) -> None:
         self.gen += 1
+        # A remote host may join a job that started all-local; loopback
+        # rendezvous would point new remote workers at themselves.
+        if (not self._all_local(slots)
+                and self.settings.rendezvous_addr in (None, "127.0.0.1")):
+            self.settings.rendezvous_addr = _my_addr(slots)
         rank0 = slots[0]
         if _is_local(rank0.hostname):
             coord = (f"{'127.0.0.1' if self._all_local(slots) else _my_addr(slots)}"
@@ -162,6 +167,18 @@ class ElasticDriver:
     # -- main loop -------------------------------------------------------
 
     def run(self) -> int:
+        # Ensure workers are torn down even when the driver is SIGTERMed
+        # (tests and schedulers kill the driver; workers live in their own
+        # process groups and would otherwise leak).
+        import signal
+
+        def _terminate(_sig, _frm):
+            raise KeyboardInterrupt
+
+        try:
+            signal.signal(signal.SIGTERM, _terminate)
+        except ValueError:
+            pass  # not the main thread (embedded use)
         port = self.server.start()
         self.settings.rendezvous_port = port
         self.settings.rendezvous_addr = "127.0.0.1"

@@ -1,0 +1,258 @@
+"""`horovod_tpu.torch` — PyTorch frontend shim over the XLA collective
+core.
+
+Reference parity: `import horovod.torch as hvd` (horovod/torch/__init__.py,
+mpi_ops.py, optimizer.py).  PyTorch in this image is CPU-only; tensors
+bridge zero-copy to numpy, run through the compiled XLA collectives, and
+come back as torch tensors.  The async API returns integer handles through
+the same HandleManager the JAX path uses (reference: handle_manager.h).
+
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(opt, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Tuple
+
+import numpy as np
+
+try:
+    import torch
+except ImportError as e:  # pragma: no cover
+    raise ImportError(
+        "horovod_tpu.torch requires PyTorch (CPU build is sufficient)"
+    ) from e
+
+# Re-export the core surface (reference: horovod.torch re-exports basics).
+from ..common.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    size,
+    rank,
+    local_size,
+    local_rank,
+    cross_size,
+    cross_rank,
+    tpu_built,
+    xla_built,
+    mpi_built,
+    nccl_built,
+    gloo_built,
+    mpi_threads_supported,
+    add_process_set,
+    remove_process_set,
+    ProcessSet,
+)
+from ..common.exceptions import HorovodInternalError  # noqa: F401
+from ..ops import collectives as C
+from ..ops.collectives import (  # noqa: F401
+    Average,
+    Sum,
+    Adasum,
+    HandleManager,
+    barrier,
+    join,
+    poll,
+    synchronize as _synchronize_handle,
+)
+from ..ops.compression import Compression  # noqa: F401
+from .. import elastic  # noqa: F401
+
+
+def _to_np(t: "torch.Tensor") -> np.ndarray:
+    if t.device.type != "cpu":
+        t = t.cpu()
+    return t.detach().numpy()
+
+
+def _to_torch(a, like: "torch.Tensor") -> "torch.Tensor":
+    # Copy: jax arrays expose read-only buffers and torch tensors must be
+    # writable (in-place variants mutate them).
+    return torch.from_numpy(np.array(a, copy=True)).to(dtype=like.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Collective ops on torch tensors (reference: horovod/torch/mpi_ops.py)
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor: "torch.Tensor", op=Average, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> "torch.Tensor":
+    out = C.allreduce(_to_np(tensor), op=op, name=name,
+                      process_set=process_set)
+    return _to_torch(out, tensor)
+
+
+def allreduce_(tensor: "torch.Tensor", **kw) -> "torch.Tensor":
+    tensor.copy_(allreduce(tensor, **kw))
+    return tensor
+
+
+def allreduce_async(tensor, op=Average, name=None) -> int:
+    return HandleManager.global_instance().allocate(
+        allreduce(tensor, op=op, name=name))
+
+
+def allgather(tensor: "torch.Tensor", name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> "torch.Tensor":
+    out = C.allgather(_to_np(tensor), name=name, process_set=process_set)
+    return _to_torch(out, tensor)
+
+
+def broadcast(tensor: "torch.Tensor", root_rank: int = 0,
+              name: Optional[str] = None) -> "torch.Tensor":
+    out = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name)
+    return _to_torch(out, tensor)
+
+
+def broadcast_(tensor: "torch.Tensor", root_rank: int = 0, **kw):
+    tensor.copy_(broadcast(tensor, root_rank=root_rank, **kw))
+    return tensor
+
+
+def alltoall(tensor: "torch.Tensor", splits=None,
+             name: Optional[str] = None) -> "torch.Tensor":
+    out = C.alltoall(_to_np(tensor), splits=splits, name=name)
+    if isinstance(out, tuple):
+        out = out[0]
+    return _to_torch(out, tensor)
+
+
+def grouped_allreduce(tensors, op=Average, name=None):
+    outs = C.grouped_allreduce([_to_np(t) for t in tensors], op=op)
+    return [_to_torch(o, t) for o, t in zip(outs, tensors)]
+
+
+def synchronize(handle: int):
+    return _synchronize_handle(handle)
+
+
+# ---------------------------------------------------------------------------
+# Parameter/optimizer-state broadcast (reference: horovod/torch/functions.py)
+# ---------------------------------------------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a state_dict or named_parameters iterable."""
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    for _, p in items:
+        if isinstance(p, torch.Tensor):
+            broadcast_(p, root_rank=root_rank)
+
+
+def broadcast_optimizer_state(optimizer: "torch.optim.Optimizer",
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer state tensors + hyperparameters from root
+    (reference: broadcast_optimizer_state's state_dict walk)."""
+    from ..ops.functions import broadcast_object
+    sd = optimizer.state_dict()
+    for group_state in sd.get("state", {}).values():
+        for k, v in group_state.items():
+            if isinstance(v, torch.Tensor):
+                broadcast_(v, root_rank=root_rank)
+    hyper = [{k: v for k, v in g.items() if k != "params"}
+             for g in sd.get("param_groups", [])]
+    synced = broadcast_object(hyper, root_rank=root_rank)
+    for g, h in zip(optimizer.param_groups, synced):
+        g.update(h)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+    from ..ops.functions import broadcast_object as _bo
+    return _bo(obj, root_rank=root_rank)
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer (reference: horovod/torch/optimizer.py)
+# ---------------------------------------------------------------------------
+
+class _DistributedOptimizer:
+    """Wraps a torch.optim.Optimizer: gradients are allreduced before
+    each step.  Like the reference, hooks fire as gradients finalize
+    (post-accumulate-grad hooks, torch>=2.1) so communication starts
+    during backward; `backward_passes_per_step` accumulates locally and
+    reduces every Nth pass.
+    """
+
+    def __init__(self, optimizer: "torch.optim.Optimizer",
+                 named_parameters: Optional[Iterable[Tuple[str, Any]]] = None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 op=Average):
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self._bpps = max(1, backward_passes_per_step)
+        self._pass_count = 0
+        self._names = {}
+        if named_parameters is not None:
+            self._names = {id(p): n for n, p in named_parameters}
+        self._params = [p for g in optimizer.param_groups
+                        for p in g["params"]]
+        dup = len(self._names) != len(set(self._names.values()))
+        if dup:
+            raise ValueError("Duplicate parameter names "
+                             "(reference: duplicated-name error)")
+        self._hooks = []
+        self._pending = {}
+        if hasattr(torch.Tensor, "register_post_accumulate_grad_hook"):
+            for p in self._params:
+                if p.requires_grad:
+                    self._hooks.append(
+                        p.register_post_accumulate_grad_hook(self._hook))
+        self._synchronized = False
+
+    # -- hook path -------------------------------------------------------
+    def _hook(self, p: "torch.Tensor") -> None:
+        if self._pass_count % self._bpps != self._bpps - 1:
+            return
+        name = self._names.get(id(p), f"param.{id(p)}")
+        self._pending[id(p)] = allreduce_async(
+            p.grad, op=self._op, name=f"allreduce.{name}.grad")
+
+    def synchronize(self) -> None:
+        for p in self._params:
+            h = self._pending.pop(id(p), None)
+            if h is not None:
+                p.grad.copy_(synchronize(h))
+        self._synchronized = True
+
+    # -- optimizer protocol ---------------------------------------------
+    def step(self, closure=None):
+        self._pass_count += 1
+        if self._pass_count % self._bpps != 0:
+            return None  # accumulation pass: no sync, no step
+        if not self._synchronized:
+            # Hooks may be unavailable (old torch) or grads produced
+            # outside autograd — reduce everything now.
+            for p in self._params:
+                if p.grad is not None and id(p) not in self._pending:
+                    allreduce_(p.grad, op=self._op)
+            self.synchronize()
+        self._synchronized = False
+        if self._bpps > 1:
+            for p in self._params:
+                if p.grad is not None:
+                    p.grad.div_(self._bpps)
+        return self._opt.step(closure)
+
+    def zero_grad(self, *a, **kw):
+        return self._opt.zero_grad(*a, **kw)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op=Average) -> _DistributedOptimizer:
+    return _DistributedOptimizer(
+        optimizer, named_parameters=named_parameters,
+        compression=compression,
+        backward_passes_per_step=backward_passes_per_step, op=op)

@@ -1,0 +1,341 @@
+"""Autotuner: online Bayesian optimization of runtime tunables.
+
+Reference parity (SURVEY.md §2.1):
+  - horovod/common/parameter_manager.cc `ParameterManager::Update/Tune`
+      → `ParameterManager`
+  - horovod/common/optim/gaussian_process.cc  → `GaussianProcess`
+  - horovod/common/optim/bayesian_optimization.cc
+    `BayesianOptimization::NextSample`        → `BayesianOptimizer`
+
+What is tuned on TPU: the reference tunes fusion-buffer threshold and
+background-cycle time.  Under SPMD the analogs are the gradient-bucket
+size for fused allreduces (`fusion_threshold_bytes` in
+`allreduce_gradients`) and the number of microbatches for pipelined
+steps.  The manager is generic: register any bounded scalar knob, feed it
+throughput samples (img/sec or tokens/sec), and it proposes the next
+configuration by GP + expected improvement, with warmup-sample discard
+exactly like the reference.
+
+Enabled by HOROVOD_AUTOTUNE=1; progress appended to HOROVOD_AUTOTUNE_LOG
+as CSV (reference: the same env contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import util
+
+logger = logging.getLogger("horovod_tpu.autotune")
+
+
+class GaussianProcess:
+    """GP regression with an RBF kernel (reference: gaussian_process.cc).
+
+    Inputs are normalized to [0, 1]^d by the caller; outputs are
+    z-scored internally for conditioning.
+    """
+
+    def __init__(self, length_scale: float = 0.2, noise: float = 1e-4):
+        self.length_scale = length_scale
+        self.noise = noise
+        self._x: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+        self._chol: Optional[np.ndarray] = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self.length_scale ** 2))
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        y = np.asarray(y, np.float64)
+        self._y_mean = float(y.mean())
+        self._y_std = float(y.std()) or 1.0
+        yz = (y - self._y_mean) / self._y_std
+        k = self._kernel(x, x) + self.noise * np.eye(len(x))
+        self._chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._chol.T, np.linalg.solve(self._chol, yz))
+        self._x = x
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (mean, std) in original y units."""
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        if self._x is None:
+            return (np.full(len(x), self._y_mean),
+                    np.full(len(x), self._y_std))
+        ks = self._kernel(x, self._x)
+        mu = ks @ self._alpha
+        v = np.linalg.solve(self._chol, ks.T)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return (mu * self._y_std + self._y_mean,
+                np.sqrt(var) * self._y_std)
+
+
+def _norm_cdf(z):
+    return 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2.0)))
+
+
+def _norm_pdf(z):
+    return np.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
+
+
+class BayesianOptimizer:
+    """Expected-improvement search over [0,1]^d (reference:
+    bayesian_optimization.cc `NextSample`: fit GP, sample candidates,
+    return the EI argmax)."""
+
+    def __init__(self, dims: int, seed: int = 0, xi: float = 0.01,
+                 n_candidates: int = 256):
+        self.dims = dims
+        self.xi = xi
+        self.n_candidates = n_candidates
+        self._rng = np.random.RandomState(seed)
+        self._gp = GaussianProcess()
+        self._xs: List[np.ndarray] = []
+        self._ys: List[float] = []
+
+    def observe(self, x: Sequence[float], y: float) -> None:
+        self._xs.append(np.asarray(x, np.float64))
+        self._ys.append(float(y))
+
+    def next_sample(self) -> np.ndarray:
+        if len(self._xs) < 2:
+            return self._rng.uniform(size=self.dims)
+        self._gp.fit(np.stack(self._xs), np.asarray(self._ys))
+        cand = self._rng.uniform(size=(self.n_candidates, self.dims))
+        mu, sigma = self._gp.predict(cand)
+        best = max(self._ys)
+        z = (mu - best - self.xi) / sigma
+        ei = (mu - best - self.xi) * _norm_cdf(z) + sigma * _norm_pdf(z)
+        return cand[int(np.argmax(ei))]
+
+    @property
+    def best(self) -> Tuple[Optional[np.ndarray], float]:
+        if not self._ys:
+            return None, float("-inf")
+        i = int(np.argmax(self._ys))
+        return self._xs[i], self._ys[i]
+
+
+@dataclasses.dataclass
+class _Tunable:
+    name: str
+    low: float
+    high: float
+    log_scale: bool = False
+    integer: bool = False
+    current: float = 0.0
+
+    def denorm(self, u: float) -> float:
+        u = min(max(float(u), 0.0), 1.0)
+        if self.log_scale:
+            val = math.exp(math.log(self.low)
+                           + u * (math.log(self.high) - math.log(self.low)))
+        else:
+            val = self.low + u * (self.high - self.low)
+        return round(val) if self.integer else val
+
+    def norm(self, val: float) -> float:
+        if self.log_scale:
+            return ((math.log(val) - math.log(self.low))
+                    / (math.log(self.high) - math.log(self.low)))
+        return (val - self.low) / (self.high - self.low)
+
+
+class ParameterManager:
+    """Online tuner driving registered knobs from throughput samples
+    (reference: parameter_manager.cc).
+
+    Usage:
+        pm = ParameterManager()
+        pm.register("fusion_threshold", 1<<20, 256<<20, log_scale=True,
+                    integer=True, initial=64<<20)
+        ...each step: pm.record_step(n_samples)  # or record_sample(rate)
+        current = pm.value("fusion_threshold")
+
+    Every `steps_per_sample` steps the observed rate closes out one
+    sample; the first `warmup_samples` are discarded (compilation,
+    cache warming — reference discards warmups identically), then the
+    Bayesian optimizer proposes the next configuration.  After
+    `max_samples` samples tuning freezes at the best seen.
+
+    jit caveat: knob changes invalidate this framework's cached
+    collective programs (on_change hook), but a train step the *user*
+    jitted bakes the value read at trace time — rebuild such steps after
+    the tuner freezes (pm.frozen) to pick up the tuned value.
+    """
+
+    def __init__(self, warmup_samples: int = 3, steps_per_sample: int = 10,
+                 max_samples: int = 40, log_file: Optional[str] = None,
+                 seed: int = 0,
+                 on_change: Optional[Callable[[Dict[str, float]], None]] = None):
+        self._tunables: Dict[str, _Tunable] = {}
+        self._order: List[str] = []
+        self._bo: Optional[BayesianOptimizer] = None
+        self._warmup = warmup_samples
+        self._steps_per_sample = steps_per_sample
+        self._max_samples = max_samples
+        self._samples = 0
+        self._log_file = log_file
+        self._on_change = on_change
+        self._seed = seed
+        self._lock = threading.Lock()
+        self._frozen = False
+        # step accumulation
+        self._step_count = 0
+        self._item_count = 0.0
+        self._t0: Optional[float] = None
+
+    # -- setup -----------------------------------------------------------
+    def register(self, name: str, low: float, high: float,
+                 log_scale: bool = False, integer: bool = False,
+                 initial: Optional[float] = None) -> None:
+        t = _Tunable(name, low, high, log_scale, integer)
+        t.current = initial if initial is not None else t.denorm(0.5)
+        self._tunables[name] = t
+        self._order.append(name)
+        self._bo = BayesianOptimizer(len(self._order), seed=self._seed)
+
+    def value(self, name: str) -> float:
+        t = self._tunables[name]
+        return int(t.current) if t.integer else t.current
+
+    def values(self) -> Dict[str, float]:
+        return {n: self.value(n) for n in self._order}
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
+
+    # -- sampling --------------------------------------------------------
+    def record_step(self, items: float = 1.0,
+                    now: Optional[float] = None) -> None:
+        """Count one training step of `items` samples/tokens; closes out
+        a throughput sample every `steps_per_sample` steps."""
+        with self._lock:
+            now = now if now is not None else time.perf_counter()
+            if self._t0 is None:
+                self._t0 = now
+                return
+            self._step_count += 1
+            self._item_count += items
+            if self._step_count < self._steps_per_sample:
+                return
+            elapsed = now - self._t0
+            rate = self._item_count / elapsed if elapsed > 0 else 0.0
+            self._step_count = 0
+            self._item_count = 0.0
+            self._t0 = now
+            self._record_sample_locked(rate)
+
+    def record_sample(self, rate: float) -> None:
+        """Directly report a throughput measurement for the current
+        configuration."""
+        with self._lock:
+            self._record_sample_locked(rate)
+
+    def _record_sample_locked(self, rate: float) -> None:
+        if self._frozen or self._bo is None:
+            return
+        self._samples += 1
+        if self._samples <= self._warmup:
+            self._log("warmup", rate)
+            return
+        x = [self._tunables[n].norm(self._tunables[n].current)
+             for n in self._order]
+        self._bo.observe(x, rate)
+        self._log("sample", rate)
+        if self._samples - self._warmup >= self._max_samples:
+            bx, brate = self._bo.best
+            if bx is not None:
+                self._apply(bx)
+            self._frozen = True
+            self._log("frozen", brate)
+            logger.info("autotune frozen at %s (%.1f items/sec)",
+                        self.values(), brate)
+            return
+        self._apply(self._bo.next_sample())
+
+    def _apply(self, xnorm: np.ndarray) -> None:
+        for n, u in zip(self._order, xnorm):
+            t = self._tunables[n]
+            t.current = t.denorm(float(u))
+        if self._on_change:
+            self._on_change(self.values())
+
+    def _log(self, kind: str, rate: float) -> None:
+        if not self._log_file:
+            return
+        try:
+            with open(self._log_file, "a") as f:
+                vals = ",".join(f"{self.value(n)}" for n in self._order)
+                f.write(f"{time.time():.3f},{kind},{rate:.3f},{vals}\n")
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Module-level instance wired by init() when HOROVOD_AUTOTUNE=1
+# ---------------------------------------------------------------------------
+
+_manager: Optional[ParameterManager] = None
+
+
+def get_manager() -> Optional[ParameterManager]:
+    return _manager
+
+
+def init_from_env() -> Optional[ParameterManager]:
+    """Reference env contract: HOROVOD_AUTOTUNE=1 enables,
+    HOROVOD_AUTOTUNE_LOG names the CSV log; the default knob is the
+    gradient-fusion threshold (HOROVOD_FUSION_THRESHOLD seeds it)."""
+    global _manager
+    if not util.env_bool("AUTOTUNE", False):
+        return None
+    if _manager is not None:
+        return _manager
+    def _invalidate(_values):
+        # A new threshold changes bucketing, so cached collective
+        # programs must rebuild (eager paths re-bucket per call; programs
+        # the *user* jitted themselves bake the old value until they
+        # rebuild — documented in ParameterManager).
+        from ..ops import collectives as _coll
+        _coll.clear_caches()
+
+    pm = ParameterManager(
+        warmup_samples=util.env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
+        steps_per_sample=util.env_int("AUTOTUNE_STEPS_PER_SAMPLE", 10),
+        max_samples=util.env_int("AUTOTUNE_MAX_SAMPLES", 40),
+        log_file=util.getenv("AUTOTUNE_LOG"),
+        on_change=_invalidate,
+    )
+    pm.register("fusion_threshold", 1 << 20, 256 << 20, log_scale=True,
+                integer=True,
+                initial=util.env_int("FUSION_THRESHOLD", 64 << 20))
+    _manager = pm
+    logger.info("autotune enabled: %s", pm.values())
+    return pm
+
+
+def shutdown_manager() -> None:
+    global _manager
+    _manager = None
+
+
+def tuned_fusion_threshold(default: int) -> int:
+    """Fusion threshold honoring the autotuner when active (used by
+    allreduce_gradients)."""
+    if _manager is not None and "fusion_threshold" in _manager._tunables:
+        return int(_manager.value("fusion_threshold"))
+    return default

@@ -8,8 +8,16 @@ import os
 import sys
 import time
 
-import horovod_tpu as hvd
-from horovod_tpu.runner import elastic_worker
+# Force the CPU backend BEFORE any backend initialization: the axon
+# sitecustomize pins jax_platforms to the real TPU regardless of env, and
+# a single shared chip must not be claimed by control-plane test workers
+# (claims from killed workers wedge the tunnel for every later test).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.runner import elastic_worker  # noqa: E402
 
 LOG_PATH = os.path.join(
     os.environ["TEST_LOG_DIR"],

@@ -1,0 +1,112 @@
+"""Autotuner tests (reference behavior: parameter_manager.cc + optim/)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from horovod_tpu.utils.autotune import (
+    BayesianOptimizer,
+    GaussianProcess,
+    ParameterManager,
+)
+
+
+class TestGaussianProcess:
+    def test_interpolates_training_points(self):
+        gp = GaussianProcess(noise=1e-8)
+        x = np.array([[0.0], [0.5], [1.0]])
+        y = np.array([1.0, 3.0, 2.0])
+        gp.fit(x, y)
+        mu, sigma = gp.predict(x)
+        np.testing.assert_allclose(mu, y, atol=1e-3)
+        assert (sigma < 0.05).all()
+
+    def test_uncertainty_grows_away_from_data(self):
+        gp = GaussianProcess()
+        gp.fit(np.array([[0.0], [0.1]]), np.array([1.0, 1.1]))
+        _, s_near = gp.predict(np.array([[0.05]]))
+        _, s_far = gp.predict(np.array([[0.9]]))
+        assert s_far[0] > s_near[0] * 2
+
+
+class TestBayesianOptimizer:
+    def test_finds_peak_of_smooth_function(self):
+        # Maximize f(u) = -(u - 0.7)^2: optimum at 0.7.
+        bo = BayesianOptimizer(dims=1, seed=0)
+        x = np.array([0.5])
+        for _ in range(25):
+            y = -float((x[0] - 0.7) ** 2)
+            bo.observe(x, y)
+            x = bo.next_sample()
+        best_x, _ = bo.best
+        assert abs(best_x[0] - 0.7) < 0.15
+
+    def test_random_before_enough_data(self):
+        bo = BayesianOptimizer(dims=2, seed=1)
+        s = bo.next_sample()
+        assert s.shape == (2,) and (0 <= s).all() and (s <= 1).all()
+
+
+class TestParameterManager:
+    def _drive(self, pm, rate_fn, n):
+        for _ in range(n):
+            pm.record_sample(rate_fn(pm.value("bucket")))
+
+    def test_warmup_discard(self):
+        pm = ParameterManager(warmup_samples=3, max_samples=10)
+        pm.register("bucket", 1, 100, initial=50)
+        # Warmup samples must not move the knob.
+        for _ in range(3):
+            pm.record_sample(100.0)
+        assert pm.value("bucket") == 50
+
+    def test_converges_and_freezes(self):
+        pm = ParameterManager(warmup_samples=2, max_samples=25, seed=3)
+        pm.register("bucket", 1, 100, initial=50)
+
+        def rate(bucket):  # throughput peaks at bucket=30
+            return 1000.0 - (bucket - 30.0) ** 2
+
+        self._drive(pm, rate, 40)
+        assert pm.frozen
+        assert abs(pm.value("bucket") - 30) < 20
+
+    def test_record_step_accumulates(self):
+        pm = ParameterManager(warmup_samples=0, steps_per_sample=5,
+                              max_samples=100)
+        pm.register("bucket", 1, 100, initial=50)
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.1
+            return t[0]
+
+        for _ in range(11):
+            pm.record_step(items=32, now=clock())
+        # After 1 baseline + 2*5 steps, two samples closed out.
+        assert pm._samples == 2
+
+    def test_log_file(self, tmp_path):
+        log = tmp_path / "at.csv"
+        pm = ParameterManager(warmup_samples=1, max_samples=5,
+                              log_file=str(log))
+        pm.register("bucket", 1, 100, initial=50)
+        for _ in range(8):
+            pm.record_sample(123.0)
+        lines = log.read_text().strip().splitlines()
+        assert any(",warmup," in ln for ln in lines)
+        assert any(",sample," in ln for ln in lines)
+        assert any(",frozen," in ln for ln in lines)
+
+    def test_env_gating(self, monkeypatch):
+        from horovod_tpu.utils import autotune as at
+        monkeypatch.delenv("HOROVOD_AUTOTUNE", raising=False)
+        at.shutdown_manager()
+        assert at.init_from_env() is None
+        monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+        mgr = at.init_from_env()
+        assert mgr is not None
+        assert at.tuned_fusion_threshold(1) == 64 << 20
+        at.shutdown_manager()
+        assert at.tuned_fusion_threshold(7) == 7

@@ -70,8 +70,14 @@ class ElasticJob:
         try:
             out, _ = self.proc.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
-            self.proc.kill()
-            out, _ = self.proc.communicate()
+            # SIGTERM first: the driver's handler tears down its workers
+            # (a bare kill() would leak them in their own process groups).
+            self.proc.terminate()
+            try:
+                out, _ = self.proc.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                out, _ = self.proc.communicate()
             raise AssertionError(f"elastic job hung; output:\n{out}")
         return self.proc.returncode, out
 

@@ -1,0 +1,158 @@
+"""Torch frontend shim tests (reference: test/parallel/test_torch.py's
+API surface, adapted to the one-process sim).
+
+On the 8-rank CPU mesh a plain tensor means "every rank contributes this
+value", so Average round-trips values exactly — the assertions mirror the
+reference's self-consistency checks plus optimizer/broadcast mechanics.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.torch as hvd_torch  # noqa: E402
+
+
+class TestTorchOps:
+    def test_allreduce_roundtrip(self):
+        t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+        out = hvd_torch.allreduce(t)
+        assert isinstance(out, torch.Tensor)
+        assert out.dtype == t.dtype
+        torch.testing.assert_close(out, t)
+
+    def test_allreduce_sum_scales_by_size(self):
+        t = torch.ones(5)
+        out = hvd_torch.allreduce(t, op=hvd_torch.Sum)
+        torch.testing.assert_close(out, t * hvd_torch.size())
+
+    def test_allreduce_inplace(self):
+        t = torch.ones(3)
+        ret = hvd_torch.allreduce_(t, op=hvd_torch.Sum)
+        assert ret is t
+        torch.testing.assert_close(t, torch.full((3,),
+                                                 float(hvd_torch.size())))
+
+    def test_allgather_concats(self):
+        t = torch.ones(2, 3)
+        out = hvd_torch.allgather(t)
+        assert out.shape == (2 * hvd_torch.size(), 3)
+
+    def test_broadcast(self):
+        t = torch.randn(4)
+        out = hvd_torch.broadcast(t, root_rank=0)
+        torch.testing.assert_close(out, t)
+
+    def test_async_handle(self):
+        t = torch.ones(3)
+        h = hvd_torch.allreduce_async(t, op=hvd_torch.Sum)
+        assert hvd_torch.poll(h)
+        out = hvd_torch.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full(3, hvd_torch.size()))
+
+    def test_grouped_allreduce(self):
+        ts = [torch.ones(2), torch.full((3,), 2.0)]
+        outs = hvd_torch.grouped_allreduce(ts)
+        torch.testing.assert_close(outs[0], ts[0])
+        torch.testing.assert_close(outs[1], ts[1])
+
+
+class TestTorchBroadcastState:
+    def test_broadcast_parameters_state_dict(self):
+        model = torch.nn.Linear(4, 2)
+        hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    def test_broadcast_optimizer_state(self):
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        loss = model(torch.randn(8, 4)).sum()
+        loss.backward()
+        opt.step()
+        hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
+        assert opt.param_groups[0]["lr"] == pytest.approx(0.1)
+
+    def test_broadcast_object(self):
+        obj = {"epoch": 3, "arr": [1, 2, 3]}
+        assert hvd_torch.broadcast_object(obj, root_rank=0) == obj
+
+
+class TestTorchDistributedOptimizer:
+    def _train_once(self, bpps=1):
+        torch.manual_seed(0)
+        model = torch.nn.Sequential(
+            torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        opt = hvd_torch.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters(),
+            backward_passes_per_step=bpps)
+        x = torch.randn(16, 4)
+        y = x.sum(dim=1, keepdim=True)
+        losses = []
+        for i in range(10 * bpps):
+            if i % bpps == 0:
+                opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss.detach()))
+        return losses
+
+    def test_training_reduces_loss(self):
+        losses = self._train_once()
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_backward_passes_per_step(self):
+        losses = self._train_once(bpps=2)
+        assert losses[-1] < losses[0] * 0.9, losses
+
+    def test_duplicate_names_rejected(self):
+        model = torch.nn.Linear(2, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        dup = [("same", p) for p in model.parameters()]
+        with pytest.raises(ValueError):
+            hvd_torch.DistributedOptimizer(opt, named_parameters=dup)
+
+    def test_passthrough_attrs(self):
+        model = torch.nn.Linear(2, 2)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1))
+        assert opt.param_groups[0]["lr"] == pytest.approx(0.1)
+
+
+class TestCallbacks:
+    def test_metric_average(self):
+        from horovod_tpu import callbacks
+        out = callbacks.MetricAverageCallback().on_epoch_end(
+            {"acc": np.float32(0.5)})
+        assert float(out["acc"]) == pytest.approx(0.5)
+
+    def test_broadcast_globals_once(self):
+        from horovod_tpu import callbacks
+        import jax.numpy as jnp
+        cb = callbacks.BroadcastGlobalVariablesCallback(0)
+        state = {"w": jnp.ones((3,))}
+        out1 = cb.on_train_begin(state)
+        out2 = cb.on_train_begin(out1)
+        assert out2 is out1  # second call is a no-op
+        np.testing.assert_allclose(np.asarray(out1["w"]), 1.0)
+
+    def test_warmup_lr(self):
+        from horovod_tpu import callbacks
+        cb = callbacks.LearningRateWarmupCallback(5, 0.8)
+        assert cb.lr(0, 10, 0) == pytest.approx(0.8 / cb.size)
+        assert cb.lr(5) == pytest.approx(0.8)
+        mid = cb.lr(2, 10, 5)
+        assert 0.8 / cb.size < mid < 0.8
+
+    def test_schedule_lr(self):
+        from horovod_tpu import callbacks
+        cb = callbacks.LearningRateScheduleCallback(
+            [dict(start_epoch=0, end_epoch=2, multiplier=1.0),
+             dict(start_epoch=2, end_epoch=4, multiplier=0.1),
+             dict(start_epoch=4, multiplier=lambda e: 0.01)],
+            initial_lr=1.0)
+        assert cb.lr(1) == 1.0
+        assert cb.lr(3) == pytest.approx(0.1)
+        assert cb.lr(10) == pytest.approx(0.01)
