@@ -1,0 +1,77 @@
+"""TF2 Keras MNIST (the reference's tensorflow2_keras_mnist.py, verbatim
+flow, through `horovod_tpu.tensorflow.keras`) — BASELINE.md config 3.
+
+The model runs in CPU TensorFlow; gradient allreduce and variable
+broadcast run through the XLA collective core.
+
+Run:  python examples/keras_mnist.py [--epochs 1]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow.keras as hvd
+from examples.mnist import synthetic_mnist
+
+
+def build_model():
+    """The reference example's conv net (tensorflow2_keras_mnist.py)."""
+    return tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(28, 28, 1)),
+        tf.keras.layers.Conv2D(32, [3, 3], activation="relu"),
+        tf.keras.layers.MaxPooling2D(pool_size=(2, 2)),
+        tf.keras.layers.Conv2D(64, [3, 3], activation="relu"),
+        tf.keras.layers.MaxPooling2D(pool_size=(2, 2)),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dropout(0.25),
+        tf.keras.layers.Dense(10, activation="softmax"),
+    ])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--n", type=int, default=512, help="synthetic samples")
+    p.add_argument("--base-lr", type=float, default=0.001)
+    args = p.parse_args()
+
+    hvd.init()
+
+    x, y = synthetic_mnist(args.n, seed=hvd.rank())
+    x = x.reshape(-1, 28, 28, 1).astype(np.float32)
+    y = y.astype(np.int32)
+
+    model = build_model()
+    # Reference recipe: scale LR by size, wrap the optimizer, broadcast
+    # initial state, average logged metrics.
+    scaled_lr = args.base_lr * hvd.size()
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.Adam(learning_rate=scaled_lr))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(),
+        metrics=["accuracy"],
+    )
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=scaled_lr, warmup_epochs=1),
+    ]
+    hist = model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+                     callbacks=callbacks, verbose=2 if hvd.rank() == 0 else 0)
+    if hvd.rank() == 0:
+        print(f"final loss: {hist.history['loss'][-1]:.4f}")
+    return hist.history["loss"]
+
+
+if __name__ == "__main__":
+    main()

@@ -77,6 +77,8 @@ from .ops.collectives import (  # noqa: F401
     grouped_reducescatter,
     barrier,
     join,
+    join_mode,
+    joined_ranks,
     poll,
     synchronize,
 )

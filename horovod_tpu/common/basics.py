@@ -145,6 +145,10 @@ class _GlobalState:
 
 _global_state: Optional[_GlobalState] = None
 _init_lock = threading.Lock()
+# True while this process holds a live jax.distributed client (multi-host
+# bootstrap); shutdown() must release it or an elastic re-init raises
+# "already initialized" (reference: the shutdown/init reset cycle, §3.5).
+_jax_distributed_active = False
 
 
 def _state() -> _GlobalState:
@@ -191,11 +195,13 @@ def init(
                 if process_id is not None
                 else util.env_int("PROCESS_ID", 0)
             )
+            global _jax_distributed_active
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
             )
+            _jax_distributed_active = True
 
         devs = list(devices) if devices is not None else list(jax.devices())
         mesh = Mesh(np.asarray(devs), (GLOBAL_AXIS,))
@@ -235,7 +241,7 @@ def shutdown() -> None:
     clear collective caches so a subsequent `init()` (elastic re-init) sees
     fresh topology.
     """
-    global _global_state
+    global _global_state, _jax_distributed_active
     with _init_lock:
         if _global_state is None:
             return
@@ -250,6 +256,29 @@ def shutdown() -> None:
         _stall_mod.shutdown_inspector()
         _at_mod.shutdown_manager()
         _global_state = None
+        # Elastic multi-process mode must also drop the live backends:
+        # jax.distributed.initialize refuses to run once backends exist,
+        # and the NEXT generation may need a distributed bootstrap even if
+        # this one was single-process (scale-up from np=1).
+        multiproc_elastic = (
+            os.environ.get("HOROVOD_ELASTIC") == "1"
+            and os.environ.get("HVD_TPU_MULTIPROCESS_JAX") == "1")
+        if _jax_distributed_active:
+            # Release the distributed client so the next init() (elastic
+            # reset with a new coordinator/world size) can bootstrap a
+            # fresh distributed runtime (verified: 2-process teardown →
+            # re-init on a new coordinator works).
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # noqa: BLE001 — teardown best effort
+                logger.warning("jax.distributed.shutdown failed: %s", e)
+        if _jax_distributed_active or multiproc_elastic:
+            try:
+                import jax.extend as _jex
+                _jex.backend.clear_backends()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("clear_backends failed: %s", e)
+        _jax_distributed_active = False
 
 
 atexit.register(shutdown)
@@ -333,7 +362,15 @@ def global_devices() -> List[jax.Device]:
 # ---------------------------------------------------------------------------
 
 def tpu_built() -> bool:
-    return any(d.platform == "tpu" for d in jax.devices())
+    """True when a TPU is attached and responsive.
+
+    Never calls `jax.devices()` directly: a wedged PJRT plugin hangs there,
+    and this is on the `--check-build` path which must always terminate.
+    """
+    if _global_state is not None:
+        return any(d.platform == "tpu" for d in _global_state.devices)
+    devs = util.probe_devices()
+    return bool(devs) and any(d.platform == "tpu" for d in devs)
 
 
 def xla_built() -> bool:

@@ -50,3 +50,73 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def force_cpu_platform(n_devices: Optional[int] = None) -> None:
+    """Pin JAX to the CPU host platform (optionally with n virtual
+    devices) BEFORE any backend initialization.
+
+    Env alone is not enough: the axon sitecustomize pins jax_platforms to
+    the TPU plugin at interpreter start regardless of JAX_PLATFORMS, and
+    backend setup against an absent/wedged TPU hangs — so callers that
+    must never touch the accelerator (multichip dry runs, simulated
+    scaling benches, test workers) call this first.
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def probe_devices(timeout: Optional[float] = None):
+    """`jax.devices()` guarded against a wedged backend.
+
+    The PJRT plugin can *hang* (not just error) during backend setup when
+    the accelerator is unreachable; any code path that must never block —
+    `horovodrun_tpu --check-build`, build-info queries — goes through this
+    probe instead of calling `jax.devices()` directly.  Runs the call on a
+    daemon thread and gives up after `timeout` seconds (default from
+    HOROVOD_BACKEND_PROBE_TIMEOUT, 20s).  Returns the device list, or None
+    on timeout/error.
+
+    Reference contract: `horovodrun --check-build` (runner/launch.py) must
+    always terminate regardless of accelerator health.
+    """
+    import queue
+    import threading
+
+    if timeout is None:
+        timeout = env_float("BACKEND_PROBE_TIMEOUT", 20.0)
+
+    # The axon sitecustomize pins jax_platforms to the TPU plugin at
+    # interpreter start regardless of env; honor an explicit JAX_PLATFORMS
+    # request here so `JAX_PLATFORMS=cpu horovodrun_tpu --check-build`
+    # probes the platform the caller asked for.
+    env_plat = os.environ.get("JAX_PLATFORMS")
+    if env_plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", env_plat)
+        except Exception:
+            pass
+
+    q: "queue.Queue" = queue.Queue()
+
+    def _probe():
+        try:
+            import jax
+            q.put(("ok", jax.devices()))
+        except BaseException as e:  # noqa: BLE001 — report, never raise
+            q.put(("err", e))
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    try:
+        kind, payload = q.get(timeout=timeout)
+    except queue.Empty:
+        return None
+    return payload if kind == "ok" else None
+
+

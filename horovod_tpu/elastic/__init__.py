@@ -171,6 +171,13 @@ class TpuState(ObjectState):
             if k not in ("params", "opt_state"):
                 setattr(self, k, copy.deepcopy(self._saved[k]))
 
+    def on_hosts_updated(self) -> None:
+        # A membership change keeps the CURRENT (post-commit) values, but
+        # the reset may tear down the whole backend (multi-process mode);
+        # move live device arrays to host before they become invalid.
+        self.params = self._to_host(self.params)
+        self.opt_state = self._to_host(self.opt_state)
+
     def sync(self) -> None:
         # Broadcast arrays (fused) from the new rank 0, scalars via object
         # broadcast.

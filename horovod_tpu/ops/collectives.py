@@ -51,6 +51,58 @@ from ..common.basics import GLOBAL_AXIS, ProcessSet
 from ..common.exceptions import HorovodTpuError
 from ..utils import stall_inspector as _stall
 from ..utils import timeline as _tl
+from . import join as _join
+
+# Join-mode signature publishing must happen once per OUTERMOST eager
+# collective (grouped_allreduce/barrier/allgather fan out into inner
+# program calls that a joined rank mirrors implicitly by calling the same
+# outer API — see ops/join.py).
+_join_tls = threading.local()
+
+
+class _joinable:
+    """Bracket for the outermost eager collective: when join mode is
+    armed, publish this op's signature so joined processes can mirror it
+    (reference: the controller telling joined ranks what to execute)."""
+
+    __slots__ = ("_outer",)
+
+    def __init__(self, kind: str, tensors: Sequence[Any] = (),
+                 op: Optional["ReduceOp"] = None,
+                 root_rank: Optional[int] = None,
+                 process_set: Optional[ProcessSet] = None,
+                 prescale: float = 1.0, postscale: float = 1.0):
+        self._outer = not getattr(_join_tls, "nested", False)
+        if self._outer and _join.armed():
+            shapes, dtypes = [], []
+            for t in tensors:
+                if isinstance(t, PerRank):
+                    t = t.values[0]
+                t = jnp.asarray(t) if not hasattr(t, "shape") else t
+                shapes.append(list(t.shape))
+                dtypes.append(str(t.dtype))
+            sig = {"kind": kind, "shapes": shapes, "dtypes": dtypes}
+            if op is not None:
+                sig["op"] = op.name
+            if root_rank is not None:
+                sig["root_rank"] = root_rank
+            if process_set is not None and process_set.process_set_id:
+                sig["ps"] = process_set.process_set_id
+            if prescale != 1.0:
+                sig["pre"] = float(prescale)
+            if postscale != 1.0:
+                sig["post"] = float(postscale)
+            _join.publish_signature(sig)
+
+    def __enter__(self):
+        if self._outer:
+            _join_tls.nested = True
+        return self
+
+    def __exit__(self, *exc):
+        if self._outer:
+            _join_tls.nested = False
+        return False
 
 
 class _traced:
@@ -111,7 +163,7 @@ __all__ = [
     "broadcast", "broadcast_async",
     "alltoall", "alltoall_async",
     "reducescatter", "grouped_reducescatter",
-    "barrier", "join",
+    "barrier", "join", "join_mode", "joined_ranks",
     "poll", "synchronize",
     "clear_caches",
 ]
@@ -170,6 +222,7 @@ def clear_caches() -> None:
     with _cache_lock:
         _program_cache.clear()
     HandleManager.global_instance().clear()
+    _join.reset()
 
 
 def _cached_program(key: Tuple, builder: Callable[[], Callable]) -> Callable:
@@ -289,6 +342,30 @@ def _allreduce_program(ps: ProcessSet, op: ReduceOp) -> Callable:
     return _cached_program(("allreduce", ps.process_set_id, op.name), build)
 
 
+def _masked_allreduce_program(ps: ProcessSet, op: ReduceOp) -> Callable:
+    """Join-mode variant: an in-band per-rank activity mask travels with
+    the data; Average divides by the active count (reference: JoinOp zero
+    contributions + controller joined_size scaling)."""
+
+    def build():
+        n = ps.size()
+
+        def fn(xs, mask, prescale, postscale):
+            x = xs * prescale.astype(xs.dtype)
+            out = _join.masked_reduce_in_graph(x, mask, op, n)
+            return out * postscale.astype(out.dtype)
+
+        return jax.jit(
+            fn,
+            in_shardings=(_rank_sharded(ps), _rank_sharded(ps),
+                          _replicated(ps), _replicated(ps)),
+            out_shardings=_replicated(ps),
+        )
+
+    return _cached_program(
+        ("masked_allreduce", ps.process_set_id, op.name), build)
+
+
 def allreduce(
     tensor,
     average: Optional[bool] = None,
@@ -374,11 +451,18 @@ def allreduce(
         return out
 
     ps = _resolve_set(process_set)
-    with _traced("ALLREDUCE", name) as tr:
+    with _joinable("allreduce", [tensor], op=op, process_set=ps,
+                   prescale=prescale_factor, postscale=postscale_factor), \
+            _traced("ALLREDUCE", name) as tr:
         xs, dtype = _make_global(tensor, ps)
-        program = _allreduce_program(ps, op)
         pre = jnp.asarray(prescale_factor, jnp.float32)
         post = jnp.asarray(postscale_factor, jnp.float32)
+        if _join.armed():
+            mask, _ = _make_global(
+                PerRank(_join.active_mask_contrib(ps)), ps)
+            program = _masked_allreduce_program(ps, op)
+            return tr.track(program(xs, mask, pre, post))
+        program = _allreduce_program(ps, op)
         return tr.track(program(xs, pre, post))
 
 
@@ -428,33 +512,34 @@ def grouped_allreduce(
         return out
 
     ps = _resolve_set(process_set)
-    results = []
-    # Eager path: fuse same-dtype tensors into one flat program call.
-    contribs = [_local_contributions(t, ps) for t in tensors]
-    n_local = len(contribs[0])
-    by_dtype: Dict[Any, List[int]] = {}
-    for i, c in enumerate(contribs):
-        by_dtype.setdefault(c[0].dtype, []).append(i)
-    out: List[Any] = [None] * len(tensors)
-    for dt, idxs in by_dtype.items():
-        shapes = [contribs[i][0].shape for i in idxs]
-        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
-        fused_per_rank = [
-            jnp.concatenate(
-                [jnp.ravel(contribs[i][r]) for i in idxs]
+    with _joinable("grouped_allreduce", tensors, op=op, process_set=ps,
+                   prescale=prescale_factor, postscale=postscale_factor):
+        # Eager path: fuse same-dtype tensors into one flat program call.
+        contribs = [_local_contributions(t, ps) for t in tensors]
+        n_local = len(contribs[0])
+        by_dtype: Dict[Any, List[int]] = {}
+        for i, c in enumerate(contribs):
+            by_dtype.setdefault(c[0].dtype, []).append(i)
+        out: List[Any] = [None] * len(tensors)
+        for dt, idxs in by_dtype.items():
+            shapes = [contribs[i][0].shape for i in idxs]
+            sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+            fused_per_rank = [
+                jnp.concatenate(
+                    [jnp.ravel(contribs[i][r]) for i in idxs]
+                )
+                for r in range(n_local)
+            ]
+            red = allreduce(
+                PerRank(fused_per_rank), op=op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor, process_set=ps,
             )
-            for r in range(n_local)
-        ]
-        red = allreduce(
-            PerRank(fused_per_rank), op=op,
-            prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor, process_set=ps,
-        )
-        offset = 0
-        for i, sz, shp in zip(idxs, sizes, shapes):
-            out[i] = red[offset: offset + sz].reshape(shp)
-            offset += sz
-    return out
+            offset = 0
+            for i, sz, shp in zip(idxs, sizes, shapes):
+                out[i] = red[offset: offset + sz].reshape(shp)
+                offset += sz
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -477,46 +562,47 @@ def allgather(
         return lax.all_gather(tensor, ax, tiled=True)
 
     ps = _resolve_set(process_set)
-    contribs = _local_contributions(tensor, ps)
-    # Ragged first dim: find per-rank dim0 via a small fixed-shape allgather.
-    dim0_local = [c.shape[0] if c.ndim else 1 for c in contribs]
-    if isinstance(tensor, PerRank) or basics.num_processes() > 1:
-        sizes = allgather_sizes(dim0_local, ps)
-    else:
-        sizes = [dim0_local[0]] * ps.size()
-    max0 = max(sizes) if sizes else 0
-    padded = []
-    for c in contribs:
-        if c.ndim == 0:
-            c = c[None]
-        pad = max0 - c.shape[0]
-        if pad > 0:
-            padding = [(0, pad)] + [(0, 0)] * (c.ndim - 1)
-            c = jnp.pad(c, padding)
-        padded.append(c)
-    xs, _ = _make_global(PerRank(padded), ps)
+    with _joinable("allgather", [tensor], process_set=ps):
+        contribs = _local_contributions(tensor, ps)
+        # Ragged first dim: per-rank dim0 via a small fixed-shape allgather.
+        dim0_local = [c.shape[0] if c.ndim else 1 for c in contribs]
+        if isinstance(tensor, PerRank) or basics.num_processes() > 1:
+            sizes = allgather_sizes(dim0_local, ps)
+        else:
+            sizes = [dim0_local[0]] * ps.size()
+        max0 = max(sizes) if sizes else 0
+        padded = []
+        for c in contribs:
+            if c.ndim == 0:
+                c = c[None]
+            pad = max0 - c.shape[0]
+            if pad > 0:
+                padding = [(0, pad)] + [(0, 0)] * (c.ndim - 1)
+                c = jnp.pad(c, padding)
+            padded.append(c)
+        xs, _ = _make_global(PerRank(padded), ps)
 
-    def build():
-        def fn(x):
-            n = ps.size()
-            return x.reshape((n * x.shape[1],) + x.shape[2:])
+        def build():
+            def fn(x):
+                n = ps.size()
+                return x.reshape((n * x.shape[1],) + x.shape[2:])
 
-        return jax.jit(
-            fn,
-            in_shardings=(_rank_sharded(ps),),
-            out_shardings=_replicated(ps),
-        )
+            return jax.jit(
+                fn,
+                in_shardings=(_rank_sharded(ps),),
+                out_shardings=_replicated(ps),
+            )
 
-    program = _cached_program(("allgather", ps.process_set_id), build)
-    with _traced("ALLGATHER", name) as tr:
-        gathered = tr.track(program(xs))
-    if all(s == max0 for s in sizes):
-        return gathered
-    # Slice out the padding (host-side, sizes are concrete).
-    pieces = []
-    for r, s in enumerate(sizes):
-        pieces.append(gathered[r * max0: r * max0 + s])
-    return jnp.concatenate(pieces, axis=0)
+        program = _cached_program(("allgather", ps.process_set_id), build)
+        with _traced("ALLGATHER", name) as tr:
+            gathered = tr.track(program(xs))
+        if all(s == max0 for s in sizes):
+            return gathered
+        # Slice out the padding (host-side, sizes are concrete).
+        pieces = []
+        for r, s in enumerate(sizes):
+            pieces.append(gathered[r * max0: r * max0 + s])
+        return jnp.concatenate(pieces, axis=0)
 
 
 def allgather_sizes(local_dim0: Sequence[int], ps: ProcessSet) -> List[int]:
@@ -575,21 +661,23 @@ def broadcast(
         raise HorovodTpuError(
             f"root_rank {root_rank} out of range for set of size {ps.size()}"
         )
-    xs, _ = _make_global(tensor, ps)
+    with _joinable("broadcast", [tensor], root_rank=root_rank,
+                   process_set=ps):
+        xs, _ = _make_global(tensor, ps)
 
-    def build():
-        def fn(x, root):
-            return jnp.take(x, root, axis=0)
+        def build():
+            def fn(x, root):
+                return jnp.take(x, root, axis=0)
 
-        return jax.jit(
-            fn,
-            in_shardings=(_rank_sharded(ps), _replicated(ps)),
-            out_shardings=_replicated(ps),
-        )
+            return jax.jit(
+                fn,
+                in_shardings=(_rank_sharded(ps), _replicated(ps)),
+                out_shardings=_replicated(ps),
+            )
 
-    program = _cached_program(("broadcast", ps.process_set_id), build)
-    with _traced("BROADCAST", name) as tr:
-        return tr.track(program(xs, jnp.asarray(root_rank, jnp.int32)))
+        program = _cached_program(("broadcast", ps.process_set_id), build)
+        with _traced("BROADCAST", name) as tr:
+            return tr.track(program(xs, jnp.asarray(root_rank, jnp.int32)))
 
 
 # ---------------------------------------------------------------------------
@@ -799,29 +887,32 @@ def grouped_reducescatter(tensors, op: ReduceOp = Average, **kw):
 def barrier(process_set: Optional[ProcessSet] = None) -> None:
     """Block until every rank reaches the barrier (reference: BarrierOp).
     Implemented as a 1-element allreduce + block_until_ready."""
-    with _traced("BARRIER", None):
+    with _joinable("barrier", process_set=_resolve_set(process_set)), \
+            _traced("BARRIER", None):
         out = allreduce(jnp.zeros((1,), jnp.int32), op=Sum,
                         process_set=process_set)
         jax.block_until_ready(out)
 
 
 def join(process_set: Optional[ProcessSet] = None) -> int:
-    """Uneven-data join (reference: EnqueueJoin / JoinOp).
+    """True uneven-data join (reference: EnqueueJoin / JoinOp) — see
+    ops/join.py for the full design.  The joining rank contributes zeros
+    to every subsequent collective (masked in-band; Average divides by
+    the active count) until all ranks join; returns the last joining
+    rank.  Multi-process liveness rides the control-plane KV (signature
+    mirroring)."""
+    return _join.join(process_set)
 
-    Under SPMD a compiled step cannot run with absent ranks, so join's
-    contract degrades gracefully to its observable behavior: a barrier that
-    returns the last rank to join.  Rank order of arrival is not observable
-    without a control plane, so we return the max rank present, matching
-    Horovod's return of the last joining rank in the common all-join case.
-    """
-    ps = process_set or basics.global_process_set()
-    local = [r for r in basics.local_device_ranks() if r in ps.ranks]
-    out = allreduce(
-        PerRank([jnp.asarray([r], jnp.int32) for r in local]),
-        op=Max, process_set=ps,
-    )
-    jax.block_until_ready(out)
-    return int(np.asarray(out)[0])
+
+def join_mode(enabled: bool = True) -> None:
+    """Arm join-aware (masked) collectives.  Required before training
+    with uneven data in multi-process mode; the single-process sim arms
+    automatically on the first `join()`."""
+    _join.join_mode(enabled)
+
+
+def joined_ranks() -> List[int]:
+    return _join.joined_ranks()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,333 @@
+"""True join / uneven-data handling (reference: EnqueueJoin + JoinOp,
+operations.cc / controller.cc).
+
+Horovod's contract: a rank that exhausts its data calls `hvd.join()`; from
+then on it participates in every collective with **zero contributions**
+(serviced by its background thread) until all ranks have joined; averages
+are taken over the ranks still contributing (controller.cc tracks
+`joined_size` and scales by the active count); `join()` returns the last
+rank to join.
+
+TPU-native redesign — no background thread, two layers:
+
+1. **Masked collectives (the numerics).**  When join mode is armed, every
+   eager allreduce carries an in-band `active` flag per rank alongside the
+   data: contributions are `x * active`, and Average divides by
+   `sum(active)` instead of the world size.  The mask travels inside the
+   same compiled XLA program (one extra tiny reduce, fused), so no
+   negotiation is needed — the SPMD analog of JoinOp's zero-tensor
+   participation.
+
+2. **Signature mirroring (the liveness).**  A compiled SPMD collective
+   cannot run with an absent process, so a joined process must keep
+   participating.  In multi-process mode, active ranks publish each
+   collective's signature (kind/shape/dtype/op, sequence-numbered) on the
+   control-plane KV before executing it; `join()` loops: fetch signature
+   for the next sequence number → participate with zero contribution →
+   repeat, until every rank has joined.  This is the one place the
+   reference's negotiation genuinely cannot be compiled away — and it
+   rides the existing rendezvous KV rather than a dedicated thread.
+
+Join mode arms automatically the moment a local rank joins
+(single-process sim) or globally via HOROVOD_JOIN_MODE=1 / `join_mode()`
+(multi-process: every process must run the same masked programs, so the
+mode must be declared before training starts — the price of having no
+per-cycle negotiation).
+
+In-jit collectives (`axis_name` paths) are unaffected: like the
+reference, join applies to the eager op path that frameworks drive.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import basics, util
+from ..common.basics import ProcessSet
+from ..common.exceptions import HorovodTpuError
+
+logger = logging.getLogger("horovod_tpu.join")
+
+_lock = threading.Lock()
+# Global ranks (this process's virtual ranks in the sim) that have joined.
+_joined_local: set = set()
+# Eager-collective sequence counter (multi-process signature mirroring).
+_seq = 0
+# Completed join cycles.  After every rank joins, the joined state clears
+# (Horovod's contract: the job continues normally — e.g. a final metric
+# allreduce or the next epoch) and the KV namespace moves to the next
+# round, so stale joined/op keys can never satisfy a later join().
+_round = 0
+_mode_forced: Optional[bool] = None
+_kv_client = None
+
+_JOIN_NS = "join"
+_POLL_S = 0.05
+_JOIN_TIMEOUT_S = 120.0
+
+
+def reset() -> None:
+    """Called from collectives.clear_caches() on shutdown/re-init."""
+    global _joined_local, _seq, _round, _mode_forced, _kv_client
+    with _lock:
+        _joined_local = set()
+        _seq = 0
+        _round = 0
+        _mode_forced = None
+        _kv_client = None
+
+
+def join_mode(enabled: bool = True) -> None:
+    """Globally arm masked collectives (required before multi-process
+    uneven-data training; the sim arms automatically on first join)."""
+    global _mode_forced
+    _mode_forced = enabled
+
+
+def armed() -> bool:
+    if _mode_forced is not None:
+        return _mode_forced
+    if util.env_bool("JOIN_MODE"):
+        return True
+    return bool(_joined_local)
+
+
+def joined_ranks() -> List[int]:
+    return sorted(_joined_local)
+
+
+def _mark_joined(ranks: Sequence[int]) -> None:
+    """Test/sim hook: mark individual virtual ranks joined (the
+    one-process harness drives all ranks, so partial-join numerics are
+    exercised by marking a subset)."""
+    with _lock:
+        _joined_local.update(int(r) for r in ranks)
+
+
+def _kv():
+    """Control-plane KV client from the launcher env (multi-process)."""
+    global _kv_client
+    if _kv_client is None:
+        from ..runner.elastic_worker import client_from_env
+        _kv_client = client_from_env()
+    return _kv_client
+
+
+def _multiproc() -> bool:
+    return basics.num_processes() > 1
+
+
+def _ns() -> str:
+    # Namespace by elastic generation, world size, and join round: a fresh
+    # rendezvous server scopes each job, the generation scopes elastic
+    # resets (same size can recur), and the round scopes repeated join
+    # cycles within one run.
+    gen = util.getenv("ELASTIC_GEN", "0")
+    return f"{_JOIN_NS}/{gen}/{basics.size()}/{_round}"
+
+
+def next_seq() -> int:
+    global _seq
+    with _lock:
+        s = _seq
+        _seq += 1
+        return s
+
+
+def publish_signature(sig: Dict[str, Any]) -> int:
+    """Active ranks: record this collective's signature so joined
+    processes can mirror it.  Every active rank publishes the same
+    deterministic value — last write wins harmlessly.
+
+    Published UNCONDITIONALLY while join mode is armed: gating on "has
+    anyone joined yet" races with a peer joining between the check and
+    the collective (verified deadlock), and one KV put per eager
+    collective is no more than the reference's per-cycle negotiation
+    traffic."""
+    s = next_seq()
+    if _multiproc():
+        _kv().put(f"{_ns()}/op/{s}", json.dumps(sig, sort_keys=True))
+    return s
+
+
+def active_mask_contrib(ps: ProcessSet) -> List[jnp.ndarray]:
+    """Per-local-rank activity flags ((1,) float32 each) for the in-band
+    mask of a masked collective."""
+    local = [r for r in basics.local_device_ranks() if r in ps.ranks]
+    return [jnp.asarray([0.0 if r in _joined_local else 1.0], jnp.float32)
+            for r in local]
+
+
+# ---------------------------------------------------------------------------
+# join() — the public op
+# ---------------------------------------------------------------------------
+
+def join(process_set: Optional[ProcessSet] = None) -> int:
+    """Join this process's ranks: contribute zeros to every subsequent
+    collective until all ranks have joined; return the last joining rank
+    (reference: hvd.join())."""
+    ps = process_set or basics.global_process_set()
+    if _multiproc() and not armed():
+        # Masked programs must be identical on EVERY process; a lone
+        # process switching programs mid-run would deadlock the others.
+        raise HorovodTpuError(
+            "join() in multi-process mode requires join mode to be armed "
+            "on every process before training: call hvd.join_mode() "
+            "after init, or set HOROVOD_JOIN_MODE=1")
+    local = [r for r in basics.local_device_ranks() if r in ps.ranks]
+    if not _multiproc():
+        # Sim: all ranks live in this process, so everyone has now joined
+        # — the cycle completes immediately and the joined state clears
+        # (Horovod's contract: the job continues normally afterwards,
+        # e.g. a final metric allreduce or the next epoch).
+        _complete_round()
+        return max(local) if local else -1
+
+    with _lock:
+        if all(r in _joined_local for r in local):
+            return max(local) if local else -1
+        _joined_local.update(local)
+    return _join_service_loop(ps, local)
+
+
+def _complete_round() -> None:
+    """All ranks joined: clear the joined set and advance the KV
+    namespace so later collectives run unmasked and a later join() can
+    never be satisfied by this round's keys."""
+    global _joined_local, _round
+    with _lock:
+        _joined_local = set()
+        _round += 1
+
+
+def _join_service_loop(ps: ProcessSet, local: List[int]) -> int:
+    """Multi-process: mirror the active ranks' collectives with zero
+    contributions until everyone has joined (the reference's background-
+    thread JoinOp servicing, done inline since join() blocks anyway)."""
+    from . import collectives as C
+
+    kv = _kv()
+    my_seq = _seq  # next signature we must mirror
+    for r in local:
+        kv.put(f"{_ns()}/joined/{r}", str(my_seq))
+    kv.put(f"{_ns()}/any_joined", "1")
+
+    n = ps.size()
+    deadline = time.monotonic() + _JOIN_TIMEOUT_S
+    while True:
+        joined = kv.keys(f"{_ns()}/joined/")
+        if len(joined) >= n:
+            break
+        sig_raw = kv.get(f"{_ns()}/op/{my_seq}")
+        if sig_raw is None:
+            if time.monotonic() > deadline:
+                raise HorovodTpuError(
+                    f"join(): no collective signature for seq {my_seq} "
+                    f"within {_JOIN_TIMEOUT_S}s and not all ranks joined")
+            time.sleep(_POLL_S)
+            continue
+        deadline = time.monotonic() + _JOIN_TIMEOUT_S
+        _mirror_collective(json.loads(sig_raw), C)
+        my_seq = _seq  # collectives bump the counter themselves
+
+    # Last joining rank = max seq recorded; ties broken by rank.
+    best_rank, best_seq = -1, -1
+    for key in kv.keys(f"{_ns()}/joined/"):
+        r = int(key.rsplit("/", 1)[1])
+        s = int(kv.get(key) or 0)
+        if (s, r) > (best_seq, best_rank):
+            best_seq, best_rank = s, r
+    _complete_round()
+    return best_rank
+
+
+def _mirror_collective(sig: Dict[str, Any], C) -> bool:
+    """Participate in one collective with zero contribution.  Returns
+    False when this process is outside the op's process set (it must not
+    participate, only keep its sequence number aligned)."""
+    ps = basics.get_process_set(sig.get("ps", 0))
+    if not any(r in ps.ranks for r in basics.local_device_ranks()):
+        next_seq()  # stay aligned with the active ranks' numbering
+        return False
+    kind = sig["kind"]
+    pre = sig.get("pre", 1.0)
+    post = sig.get("post", 1.0)
+    if kind in ("allreduce", "grouped_allreduce"):
+        shapes = sig["shapes"]
+        dtypes = sig["dtypes"]
+        zeros = [jnp.zeros(tuple(sh), jnp.dtype(dt))
+                 for sh, dt in zip(shapes, dtypes)]
+        op = _op_by_name(C, sig["op"])
+        if kind == "allreduce":
+            out = C.allreduce(zeros[0], op=op, process_set=ps,
+                              prescale_factor=pre, postscale_factor=post)
+        else:
+            out = C.grouped_allreduce(zeros, op=op, process_set=ps,
+                                      prescale_factor=pre,
+                                      postscale_factor=post)
+        jax.block_until_ready(out)
+    elif kind == "allgather":
+        shape = list(sig["shapes"][0])
+        shape[0] = 0  # no data from a joined rank
+        out = C.allgather(
+            jnp.zeros(tuple(shape), jnp.dtype(sig["dtypes"][0])),
+            process_set=ps)
+        jax.block_until_ready(out)
+    elif kind == "broadcast":
+        out = C.broadcast(
+            jnp.zeros(tuple(sig["shapes"][0]), jnp.dtype(sig["dtypes"][0])),
+            root_rank=sig["root_rank"], process_set=ps)
+        jax.block_until_ready(out)
+    elif kind == "barrier":
+        C.barrier(process_set=ps)
+    else:
+        raise HorovodTpuError(f"join(): cannot mirror collective {kind!r}")
+    return True
+
+
+def _op_by_name(C, name: str):
+    return {"Average": C.Average, "Sum": C.Sum, "Min": C.Min,
+            "Max": C.Max, "Product": C.Product}[name]
+
+
+# ---------------------------------------------------------------------------
+# Masked reduction math (used by collectives.allreduce when armed)
+# ---------------------------------------------------------------------------
+
+def masked_reduce_in_graph(xs, mask, op, n: int):
+    """Reduce (n, *s) over axis 0 honoring per-rank activity flags.
+
+    mask: (n, 1) float32, 1.0 for active ranks.  Average divides by the
+    active count (reference: controller.cc joined_size scaling); Sum/Min/
+    Max/Product neutralize joined ranks' contributions with the op's
+    identity element.
+    """
+    m = mask.reshape((n,) + (1,) * (xs.ndim - 1))
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    if op.name == "Average":
+        s = jnp.sum(xs * m.astype(xs.dtype), axis=0)
+        return (s.astype(jnp.float32) / count).astype(xs.dtype)
+    if op.name == "Sum":
+        return jnp.sum(xs * m.astype(xs.dtype), axis=0)
+    if op.name == "Min":
+        big = jnp.asarray(
+            jnp.finfo(xs.dtype).max if jnp.issubdtype(xs.dtype, jnp.floating)
+            else jnp.iinfo(xs.dtype).max, xs.dtype)
+        return jnp.min(jnp.where(m.astype(bool), xs, big), axis=0)
+    if op.name == "Max":
+        small = jnp.asarray(
+            jnp.finfo(xs.dtype).min if jnp.issubdtype(xs.dtype, jnp.floating)
+            else jnp.iinfo(xs.dtype).min, xs.dtype)
+        return jnp.max(jnp.where(m.astype(bool), xs, small), axis=0)
+    if op.name == "Product":
+        one = jnp.asarray(1, xs.dtype)
+        return jnp.prod(jnp.where(m.astype(bool), xs, one), axis=0)
+    raise HorovodTpuError(f"Unsupported masked reduce op {op}")

@@ -165,17 +165,70 @@ def data_parallel(
     """
     mesh = mesh or basics.global_mesh()
 
-    def wrapper(*args):
+    if static_args:
+        # Static args preclude per-arg in_shardings; legacy wrapper path.
+        def wrapper(*args):
+            n_args = len(args)
+            in_specs = tuple(
+                P(axis_name) if i in batch_args else P()
+                for i in range(n_args)
+            )
+            sm = shard_map(
+                step_fn, mesh=mesh, in_specs=in_specs,
+                out_specs=P(), check_vma=False,
+            )
+            return sm(*args)
+
+        return jax.jit(wrapper, donate_argnums=tuple(donate_args),
+                       static_argnums=tuple(static_args))
+
+    # Explicit in_shardings so the FIRST compile is already steady-state.
+    # Without them, jit infers input layouts from whatever the caller
+    # passes (host-committed arrays), while the step's outputs come back
+    # as NamedSharding over the mesh — the next call would then see
+    # different input shardings and silently recompile the whole program
+    # (observed: an extra full ResNet-50 compile inside the timed loop).
+    compiled_cache = {}
+
+    def _coerce(x, sharding):
+        # jit with explicit in_shardings REJECTS committed arrays whose
+        # sharding differs (rather than resharding); accept them the way
+        # plain jit would, with an explicit reshard.  Steady state (the
+        # training loop feeding outputs back in) matches and pays only a
+        # per-leaf comparison.
+        if isinstance(x, jax.Array) and not x.is_deleted() \
+                and not x.sharding.is_equivalent_to(sharding, x.ndim):
+            return jax.device_put(x, sharding)
+        return x
+
+    def call(*args):
         n_args = len(args)
-        in_specs = tuple(
-            P(axis_name) if i in batch_args else P() for i in range(n_args)
+        entry = compiled_cache.get(n_args)
+        if entry is None:
+            in_specs = tuple(
+                P(axis_name) if i in batch_args else P()
+                for i in range(n_args)
+            )
+            sm = shard_map(
+                step_fn, mesh=mesh, in_specs=in_specs,
+                out_specs=P(), check_vma=False,
+            )
+            in_shardings = tuple(
+                NamedSharding(mesh, P(axis_name)) if i in batch_args
+                else NamedSharding(mesh, P())
+                for i in range(n_args)
+            )
+            fn = jax.jit(
+                sm, in_shardings=in_shardings,
+                donate_argnums=tuple(d for d in donate_args if d < n_args),
+            )
+            entry = (fn, in_shardings)
+            compiled_cache[n_args] = entry
+        fn, in_shardings = entry
+        args = tuple(
+            jax.tree_util.tree_map(lambda x, s=s: _coerce(x, s), a)
+            for a, s in zip(args, in_shardings)
         )
+        return fn(*args)
 
-        sm = shard_map(
-            step_fn, mesh=mesh, in_specs=in_specs,
-            out_specs=P(), check_vma=False,
-        )
-        return sm(*args)
-
-    return jax.jit(wrapper, donate_argnums=tuple(donate_args),
-                   static_argnums=tuple(static_args))
+    return call

@@ -24,6 +24,7 @@ the reset count exceeds --reset-limit.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -40,7 +41,7 @@ from ..exec_run import (
     build_command,
     slot_env,
 )
-from ..hosts import HostInfo, SlotInfo, get_host_assignments
+from ..hosts import HostInfo, SlotInfo, annotate_slots, get_host_assignments
 from ..rendezvous import RendezvousServer
 from ..settings import Settings
 from .discovery import HostDiscovery, HostDiscoveryScript
@@ -102,6 +103,26 @@ class ElasticDriver:
     # -- generation transitions ------------------------------------------
 
     def _publish_generation(self, slots: List[SlotInfo]) -> None:
+        # Finished slots stay out of the published membership: their worker
+        # exited 0 and will never be respawned, so a generation that counts
+        # them would make survivors wait on a rank that never connects
+        # (fatal under HVD_TPU_MULTIPROCESS_JAX=1, where every published
+        # rank must reach jax.distributed.initialize).  They remain in the
+        # driver's completion bookkeeping only.
+        live = [s for s in slots
+                if (s.hostname, s.local_rank) not in self.finished_slots]
+        if not live:
+            # Every assigned worker already finished; the monitor loop's
+            # completion check will end the job — nothing to publish.
+            logger.info("all assigned workers finished; skipping generation")
+            return
+        live.sort(key=lambda s: s.rank)
+        for i, s in enumerate(live):  # contiguous ranks over live workers
+            s.rank = i
+        # Re-derive size/local_size/cross_* over the live set so the env a
+        # respawned worker receives is self-consistent (no phantom peers).
+        annotate_slots(live)
+        slots = live
         self.gen += 1
         # A remote host may join a job that started all-local; loopback
         # rendezvous would point new remote workers at themselves.
@@ -113,7 +134,7 @@ class ElasticDriver:
             coord = (f"{'127.0.0.1' if self._all_local(slots) else _my_addr(slots)}"
                      f":{_free_port()}")
         else:
-            coord = f"{rank0.hostname}:{DEFAULT_COORDINATOR_PORT + (self.gen % 100)}"
+            coord = f"{rank0.hostname}:{self._coordinator_port()}"
         info = {
             "size": len(slots),
             "coordinator": coord,
@@ -131,6 +152,25 @@ class ElasticDriver:
     @staticmethod
     def _all_local(slots: List[SlotInfo]) -> bool:
         return all(_is_local(s.hostname) for s in slots)
+
+    def _coordinator_port(self) -> int:
+        """Remote rank-0 coordinator port for this job + generation.
+
+        Offset by a hash of the job's rendezvous secret so two concurrent
+        jobs sharing a host don't collide on a fixed base, and spread
+        generations over a window wide enough that a lingering listener
+        from gen N (TIME_WAIT / late shutdown) can't collide with gen
+        N+100.  For guaranteed isolation pass an explicit base via
+        HOROVOD_COORDINATOR_BASE_PORT.
+        """
+        env_base = os.environ.get("HOROVOD_COORDINATOR_BASE_PORT")
+        if env_base:
+            base = int(env_base)
+        else:
+            job_off = int(hashlib.sha256(
+                self.server.secret.encode()).hexdigest(), 16) % 2000
+            base = DEFAULT_COORDINATOR_PORT + job_off
+        return base + (self.gen % 500)
 
     def _spawn_missing_workers(self) -> None:
         for (host, slot_idx), slot in self.assignments.items():
@@ -159,10 +199,15 @@ class ElasticDriver:
                         host, slot_idx, slot.rank, handle.pid)
 
     def _kill_removed_workers(self) -> None:
+        doomed = []
         for key, (handle, rank, _) in list(self.workers.items()):
             if key not in self.assignments and handle.poll() is None:
                 logger.info("terminating worker %s (no longer assigned)", key)
-                handle.terminate()
+                doomed.append(handle.pid)
+        if doomed:
+            # One shared grace deadline for the whole group — serial
+            # terminate() would stall the monitor loop N*5s.
+            safe_exec.terminate_trees(doomed)
 
     # -- main loop -------------------------------------------------------
 
@@ -196,9 +241,9 @@ class ElasticDriver:
         try:
             return self._monitor_loop()
         finally:
-            for handle, _, _ in self.workers.values():
-                if handle.poll() is None:
-                    handle.terminate()
+            safe_exec.terminate_trees([
+                h.pid for h, _, _ in self.workers.values()
+                if h.poll() is None])
             self.server.stop()
 
     def _monitor_loop(self) -> int:

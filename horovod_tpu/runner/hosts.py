@@ -119,8 +119,14 @@ def get_host_assignments(
             ))
             rank += 1
 
-    # Fill in sizes: local_size per host, cross_rank/size per local_rank
-    # column — identical math to the reference.
+    annotate_slots(slots)
+    return slots
+
+
+def annotate_slots(slots: List[SlotInfo]) -> None:
+    """Fill in size/local_size/cross_rank/cross_size for an assignment —
+    identical math to the reference.  Also used to re-annotate a filtered
+    slot list (elastic generations exclude finished slots)."""
     by_host: dict = {}
     by_column: dict = {}
     for s in slots:
@@ -132,4 +138,3 @@ def get_host_assignments(
         column = by_column[s.local_rank]
         s.cross_rank = column.index(s)
         s.cross_size = len(column)
-    return slots

@@ -55,9 +55,10 @@ def _decode(secret: str, line: bytes) -> dict:
     try:
         sig, b64 = line.strip().split(b" ", 1)
         payload = base64.b64decode(b64)
+        sig_text = sig.decode()  # non-UTF-8 bytes are "malformed", not fatal
     except Exception as e:
         raise HorovodTpuError(f"Malformed rendezvous message: {e}") from e
-    if not hmac.compare_digest(sig.decode(), _sign(secret, payload)):
+    if not hmac.compare_digest(sig_text, _sign(secret, payload)):
         raise HorovodTpuError("Rendezvous message failed HMAC verification")
     return json.loads(payload)
 
@@ -82,10 +83,12 @@ class KVStore:
             return self._data.get(key)
 
     def wait(self, key: str, timeout: float) -> Optional[str]:
-        deadline = time.time() + timeout
+        # monotonic, not wall clock: an NTP step must not fire timeouts
+        # early or extend them (the C++ engine uses steady_clock).
+        deadline = time.monotonic() + timeout
         with self._cv:
             while key not in self._data:
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cv.wait(remaining):
                     return None
             return self._data[key]
@@ -102,7 +105,7 @@ class KVStore:
         """Block until `count` callers reach barrier `name`.  Generation
         counter makes the barrier reusable (successive barriers with the
         same name don't bleed into each other)."""
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         with self._cv:
             gen, arrived = self._barriers.get(name, (0, 0))
             arrived += 1
@@ -116,7 +119,7 @@ class KVStore:
                 cur_gen, _ = self._barriers.get(name, (0, 0))
                 if cur_gen > my_gen:
                     return True
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cv.wait(remaining):
                     # Re-check before withdrawing: the last participant may
                     # have released the barrier in the same instant our

@@ -37,25 +37,43 @@ def _forward_stream(stream, sink, prefix: str, index_prefix: bool) -> None:
 def terminate_tree(pid: int, timeout: float = GRACEFUL_TERMINATION_TIME_S):
     """SIGTERM the process group, then SIGKILL survivors (reference:
     safe_shell_exec's _exec_middleman cleanup)."""
-    try:
-        pgid = os.getpgid(pid)
-    except ProcessLookupError:
-        return
-    try:
-        os.killpg(pgid, signal.SIGTERM)
-    except ProcessLookupError:
-        return
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    terminate_trees([pid], timeout)
+
+
+def terminate_trees(pids: List[int],
+                    timeout: float = GRACEFUL_TERMINATION_TIME_S):
+    """Terminate many process groups under ONE shared grace deadline:
+    SIGTERM every group first, then sweep until all are gone or the
+    deadline passes, then SIGKILL survivors.  Keeps teardown of N workers
+    O(timeout) instead of O(N*timeout)."""
+    pgids = []
+    for pid in pids:
         try:
-            os.killpg(pgid, 0)
+            pgid = os.getpgid(pid)
         except ProcessLookupError:
-            return  # all gone
-        time.sleep(0.1)
-    try:
-        os.killpg(pgid, signal.SIGKILL)
-    except ProcessLookupError:
-        pass
+            continue
+        try:
+            os.killpg(pgid, signal.SIGTERM)
+            pgids.append(pgid)
+        except ProcessLookupError:
+            continue
+    deadline = time.monotonic() + timeout
+    while pgids and time.monotonic() < deadline:
+        alive = []
+        for pgid in pgids:
+            try:
+                os.killpg(pgid, 0)
+                alive.append(pgid)
+            except ProcessLookupError:
+                pass
+        pgids = alive
+        if pgids:
+            time.sleep(0.1)
+    for pgid in pgids:
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
 
 
 class ExecutedProcess:

@@ -1,0 +1,436 @@
+"""`horovod_tpu.tensorflow` — TensorFlow 2 frontend shim over the XLA
+collective core.
+
+Reference parity: `import horovod.tensorflow as hvd`
+(horovod/tensorflow/__init__.py, mpi_ops.py): collectives on tf.Tensors,
+`DistributedGradientTape` (wraps `tf.GradientTape`, allreduces each
+gradient in `gradient()` via `_allreduce_grads`), `broadcast_variables`,
+`Compression.fp16`, IndexedSlices handling (sparse-as-dense), `join`.
+
+TPU-native redesign: the reference registers custom TF ops
+(HorovodAllreduceOp, tensorflow/mpi_ops.cc) that enqueue into the C++
+background runtime.  Here tf.Tensors bridge to numpy, run through the same
+cached compiled XLA collective programs every frontend shares
+(ops/collectives.py), and come back as tf.Tensors.  Eager execution is the
+native mode (TF2 default); inside a `tf.function` the collective runs
+through `tf.py_function`, preserving semantics at graph-build time the way
+the reference's custom-op kernels do at session-run time.
+
+    import horovod_tpu.tensorflow as hvd
+    hvd.init()
+    tape = hvd.DistributedGradientTape(tape)
+    grads = tape.gradient(loss, model.trainable_variables)
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+try:
+    import tensorflow as tf
+except ImportError as e:  # pragma: no cover
+    raise ImportError(
+        "horovod_tpu.tensorflow requires TensorFlow 2.x") from e
+
+# Re-export the core surface (reference: horovod.tensorflow re-exports
+# basics + mpi_ops).
+from ..common.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    size,
+    rank,
+    local_size,
+    local_rank,
+    cross_size,
+    cross_rank,
+    tpu_built,
+    xla_built,
+    mpi_built,
+    nccl_built,
+    gloo_built,
+    mpi_threads_supported,
+    add_process_set,
+    remove_process_set,
+    ProcessSet,
+)
+from ..common.exceptions import HorovodInternalError  # noqa: F401
+from ..ops import collectives as C
+from ..ops.collectives import (  # noqa: F401
+    Average,
+    Sum,
+    Adasum,
+    Min,
+    Max,
+    HandleManager,
+    barrier,
+    join,
+    poll,
+)
+from ..ops.compression import Compression  # noqa: F401
+from .. import elastic  # noqa: F401
+
+
+def _to_np(t) -> np.ndarray:
+    """tf.Tensor / tf.Variable / tf.IndexedSlices → numpy.
+
+    IndexedSlices (sparse gradients from embedding lookups) densify first
+    — the reference's `sparse_as_dense` path (tensorflow/__init__.py
+    `_allreduce_cond`/convert_to_tensor on IndexedSlices).
+    """
+    if isinstance(t, tf.IndexedSlices):
+        t = tf.convert_to_tensor(t)
+    if isinstance(t, tf.Variable):
+        t = t.value()
+    return t.numpy() if hasattr(t, "numpy") else np.asarray(t)
+
+
+def _to_tf(a, like=None):
+    arr = np.asarray(a)
+    if like is not None and hasattr(like, "dtype"):
+        dtype = like.dtype
+        if isinstance(like, tf.IndexedSlices):
+            dtype = like.values.dtype
+        return tf.convert_to_tensor(arr, dtype=dtype)
+    return tf.convert_to_tensor(arr)
+
+
+def _eager_or_py_function(fn, tensors: Sequence, name: str,
+                          out_shape_fn=None) -> List:
+    """Run `fn(list_of_np) -> list_of_np` on tf tensors, bridging through
+    `tf.py_function` when inside a tf.function graph (the reference's
+    custom-op kernels serve the same role at graph execution time).
+
+    `out_shape_fn(input_shape) -> output_shape` sets the static shape of
+    each graph-mode output (identity when omitted); return None entries
+    for outputs whose shape is data-dependent (e.g. variable-dim0
+    allgather)."""
+    if tf.executing_eagerly():
+        outs = fn([_to_np(t) for t in tensors])
+        return [_to_tf(o, like=t) for o, t in zip(outs, tensors)]
+
+    dense = [tf.convert_to_tensor(t) if isinstance(t, tf.IndexedSlices)
+             else t for t in tensors]
+
+    def _bridge(*eager_tensors):
+        outs = fn([t.numpy() for t in eager_tensors])
+        return [tf.convert_to_tensor(np.asarray(o)) for o in outs]
+
+    outs = tf.py_function(
+        func=_bridge, inp=list(dense),
+        Tout=[t.dtype for t in dense], name=name)
+    for o, t in zip(outs, dense):
+        shape = out_shape_fn(t.shape) if out_shape_fn else t.shape
+        if shape is not None:
+            o.set_shape(shape)
+    return list(outs)
+
+
+# ---------------------------------------------------------------------------
+# Collective ops on tf tensors (reference: horovod/tensorflow/mpi_ops.py)
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              compression=Compression.none,
+              process_set: Optional[ProcessSet] = None):
+    if op is None:
+        op = Sum if average is False else Average
+
+    def _fn(nps):
+        x = nps[0]
+        c, ctx = compression.compress(x)
+        out = C.allreduce(np.asarray(c), op=op, name=name,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=process_set)
+        return [np.asarray(compression.decompress(out, ctx))]
+
+    return _eager_or_py_function(_fn, [tensor], "HorovodAllreduce")[0]
+
+
+def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
+                      name: Optional[str] = None, op=None,
+                      compression=Compression.none,
+                      process_set: Optional[ProcessSet] = None) -> List:
+    if op is None:
+        op = Sum if average is False else Average
+
+    def _fn(nps):
+        comp, ctxs = [], []
+        for x in nps:
+            c, ctx = compression.compress(x)
+            comp.append(np.asarray(c))
+            ctxs.append(ctx)
+        outs = C.grouped_allreduce(comp, op=op, process_set=process_set)
+        return [np.asarray(compression.decompress(o, ctx))
+                for o, ctx in zip(outs, ctxs)]
+
+    return _eager_or_py_function(_fn, list(tensors),
+                                 "HorovodGroupedAllreduce")
+
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    """First-dim concatenation across ranks (variable dim0 supported, like
+    the reference's allgather with displacements)."""
+
+    def _fn(nps):
+        return [np.asarray(C.allgather(nps[0], name=name,
+                                       process_set=process_set))]
+
+    def _out_shape(shape):
+        # dim0 is the sum of per-rank dim0s — data-dependent in general.
+        return tf.TensorShape([None]).concatenate(shape[1:]) \
+            if shape.rank else None
+
+    return _eager_or_py_function(_fn, [tensor], "HorovodAllgather",
+                                 out_shape_fn=_out_shape)[0]
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    def _fn(nps):
+        return [np.asarray(C.broadcast(nps[0], root_rank=root_rank,
+                                       name=name, process_set=process_set))]
+
+    return _eager_or_py_function(_fn, [tensor], "HorovodBroadcast")[0]
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None):
+    def _out_shape(shape):
+        return tf.TensorShape([None]).concatenate(shape[1:]) \
+            if shape.rank else None
+
+    if splits is None:
+        def _fn(nps):
+            return [np.asarray(C.alltoall(nps[0], name=name,
+                                          process_set=process_set))]
+
+        return _eager_or_py_function(_fn, [tensor], "HorovodAlltoall",
+                                     out_shape_fn=_out_shape)[0]
+
+    # With splits the reference returns (received, received_splits); the
+    # splits tensor rides the same bridge so graph mode works.
+    def _fn2(nps):
+        recv, recv_splits = C.alltoall(
+            nps[0], splits=nps[1].astype(np.int32), name=name,
+            process_set=process_set)
+        return [np.asarray(recv), np.asarray(recv_splits, np.int32)]
+
+    splits_t = tf.convert_to_tensor(splits, dtype=tf.int32)
+    out, recv_splits = _eager_or_py_function(
+        _fn2, [tensor, splits_t], "HorovodAlltoall",
+        out_shape_fn=_out_shape)
+    return out, recv_splits
+
+
+def reducescatter(tensor, op=Average, name: Optional[str] = None,
+                  process_set: Optional[ProcessSet] = None):
+    def _fn(nps):
+        return [np.asarray(C.reducescatter(nps[0], op=op, name=name,
+                                           process_set=process_set))]
+
+    def _out_shape(shape):
+        return tf.TensorShape([None]).concatenate(shape[1:]) \
+            if shape.rank else None
+
+    return _eager_or_py_function(_fn, [tensor], "HorovodReducescatter",
+                                 out_shape_fn=_out_shape)[0]
+
+
+# -- async variants (reference: *_async in mpi_ops.py) ----------------------
+
+def allreduce_async(tensor, **kw) -> int:
+    return HandleManager.global_instance().allocate(allreduce(tensor, **kw))
+
+
+def allgather_async(tensor, **kw) -> int:
+    return HandleManager.global_instance().allocate(allgather(tensor, **kw))
+
+
+def broadcast_async(tensor, root_rank: int = 0, **kw) -> int:
+    return HandleManager.global_instance().allocate(
+        broadcast(tensor, root_rank=root_rank, **kw))
+
+
+def synchronize(handle: int):
+    return C.synchronize(handle)
+
+
+# ---------------------------------------------------------------------------
+# Variable broadcast (reference: horovod/tensorflow/functions.py
+# broadcast_variables, broadcast_object)
+# ---------------------------------------------------------------------------
+
+def broadcast_variables(variables: Sequence["tf.Variable"],
+                        root_rank: int = 0,
+                        process_set: Optional[ProcessSet] = None) -> None:
+    """Assign every variable its root-rank value (reference:
+    broadcast_variables — run once after init so all ranks start
+    identical)."""
+    for v in variables:
+        v.assign(_to_tf(
+            C.broadcast(_to_np(v), root_rank=root_rank,
+                        process_set=process_set),
+            like=v))
+
+
+def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+    from ..ops.functions import broadcast_object as _bo
+    return _bo(obj, root_rank=root_rank)
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """TF1-compat API: broadcast every global variable (reference:
+    broadcast_global_variables)."""
+    try:
+        gvars = tf.compat.v1.global_variables()
+    except Exception:
+        gvars = []
+    broadcast_variables(gvars, root_rank=root_rank)
+
+
+# ---------------------------------------------------------------------------
+# DistributedGradientTape (reference: horovod/tensorflow/__init__.py)
+# ---------------------------------------------------------------------------
+
+def _allreduce_grads(grads: Sequence, op, compression,
+                     process_set: Optional[ProcessSet],
+                     sparse_as_dense: bool) -> List:
+    """The reference's `_allreduce_grads`: fused (grouped) allreduce of all
+    non-None gradients, None passed through at its position."""
+    idx = [i for i, g in enumerate(grads) if g is not None]
+    if not idx:
+        return list(grads)
+    dense = []
+    for i in idx:
+        g = grads[i]
+        if isinstance(g, tf.IndexedSlices):
+            # sparse_as_dense=False in the reference routes IndexedSlices
+            # through allgather; the dense path is both simpler and faster
+            # over ICI (no variable-size negotiation), so densify always.
+            g = tf.convert_to_tensor(g)
+        dense.append(g)
+    reduced = grouped_allreduce(dense, op=op, compression=compression,
+                                process_set=process_set)
+    out = list(grads)
+    for i, r in zip(idx, reduced):
+        out[i] = r
+    return out
+
+
+class _DistributedGradientTape:
+    """Wraps a `tf.GradientTape`: `gradient()` returns allreduced grads
+    (reference: DistributedGradientTape / _make_gradient_tape)."""
+
+    def __init__(self, tape: "tf.GradientTape", op=Average,
+                 compression=Compression.none,
+                 sparse_as_dense: bool = True,
+                 process_set: Optional[ProcessSet] = None):
+        self._tape = tape
+        self._op = op
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+        self._process_set = process_set
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        flat = tf.nest.flatten(grads)
+        reduced = _allreduce_grads(
+            flat, self._op, self._compression, self._process_set,
+            self._sparse_as_dense)
+        return tf.nest.pack_sequence_as(grads, reduced)
+
+    # Context-manager & watch API pass through to the underlying tape.
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+
+def DistributedGradientTape(gradtape: "tf.GradientTape", op=Average,
+                            compression=Compression.none,
+                            sparse_as_dense: bool = True,
+                            process_set: Optional[ProcessSet] = None):
+    return _DistributedGradientTape(
+        gradtape, op=op, compression=compression,
+        sparse_as_dense=sparse_as_dense, process_set=process_set)
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer for raw-TF training loops (reference:
+# hvd.DistributedOptimizer in horovod/tensorflow/__init__.py)
+# ---------------------------------------------------------------------------
+
+class _DistributedOptimizer:
+    """Wraps a Keras-3-style optimizer: gradients are allreduced in
+    `apply_gradients`/`apply` before the update."""
+
+    def __init__(self, optimizer, op=Average,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 process_set: Optional[ProcessSet] = None):
+        self._opt = optimizer
+        self._op = op
+        self._compression = compression
+        self._process_set = process_set
+        self._bpps = max(1, backward_passes_per_step)
+        self._pass = 0
+        self._acc: Optional[List[np.ndarray]] = None
+
+    def _reduce(self, grads: Sequence) -> List:
+        return _allreduce_grads(list(grads), self._op, self._compression,
+                                self._process_set, True)
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        gv = list(grads_and_vars)
+        grads = [g for g, _ in gv]
+        tvars = [v for _, v in gv]
+        if self._bpps > 1:
+            # Local accumulation (reference: backward_passes_per_step /
+            # LocalGradientAggregationHelper) — eager-mode only.
+            nps = [None if g is None else _to_np(g) for g in grads]
+            if self._acc is None:
+                self._acc = nps
+            else:
+                self._acc = [a if n is None else
+                             (n if a is None else a + n)
+                             for a, n in zip(self._acc, nps)]
+            self._pass += 1
+            if self._pass % self._bpps != 0:
+                return None
+            grads = [None if a is None else
+                     tf.convert_to_tensor(a / self._bpps)
+                     for a in self._acc]
+            self._acc = None
+        reduced = self._reduce(grads)
+        return self._opt.apply_gradients(zip(reduced, tvars), **kwargs)
+
+    def apply(self, grads, trainable_variables=None, **kwargs):
+        if trainable_variables is None:
+            return self.apply_gradients(grads, **kwargs)
+        return self.apply_gradients(zip(grads, trainable_variables),
+                                    **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+def DistributedOptimizer(optimizer, op=Average,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         process_set: Optional[ProcessSet] = None):
+    return _DistributedOptimizer(
+        optimizer, op=op, compression=compression,
+        backward_passes_per_step=backward_passes_per_step,
+        process_set=process_set)

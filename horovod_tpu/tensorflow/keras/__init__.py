@@ -1,0 +1,83 @@
+"""`horovod_tpu.tensorflow.keras` — Keras frontend (reference:
+horovod/tensorflow/keras/__init__.py + shared impl horovod/_keras/).
+
+`DistributedOptimizer` returns a dynamic subclass of the wrapped
+optimizer's own class (the reference's pattern from
+horovod/_keras/__init__.py `create_distributed_optimizer`) so Keras
+serialization, `model.compile`, and isinstance checks keep working; the
+subclass allreduces gradients in `apply_gradients` before the update.
+Under `model.fit` the train step is a tf.function — the collective bridges
+through `tf.py_function` (see horovod_tpu.tensorflow).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import tensorflow as tf
+
+from .. import (  # noqa: F401
+    init, shutdown, is_initialized, size, rank, local_size, local_rank,
+    cross_size, cross_rank, tpu_built, xla_built, mpi_built, nccl_built,
+    gloo_built, add_process_set, remove_process_set, ProcessSet,
+    allreduce, allgather, broadcast, alltoall, grouped_allreduce,
+    broadcast_variables, broadcast_object, join, barrier,
+    Average, Sum, Adasum, Compression,
+    _allreduce_grads,
+)
+from . import callbacks  # noqa: F401
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         op=Average, compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         process_set: Optional[ProcessSet] = None):
+    """Wrap a Keras optimizer so every `apply_gradients` first averages
+    gradients across ranks (reference: create_distributed_optimizer)."""
+    cls = optimizer.__class__
+
+    class _DistributedKerasOptimizer(cls):
+        _hvd_op = op
+        _hvd_compression = compression
+        _hvd_process_set = process_set
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            grads = [g for g, _ in gv]
+            tvars = [v for _, v in gv]
+            reduced = _allreduce_grads(
+                grads, self._hvd_op, self._hvd_compression,
+                self._hvd_process_set, True)
+            self._hvd_inner = True
+            try:
+                return super().apply_gradients(
+                    zip(reduced, tvars), *args, **kwargs)
+            finally:
+                self._hvd_inner = False
+
+        def apply(self, grads, trainable_variables=None, **kwargs):
+            if getattr(self, "_hvd_inner", False):
+                return super().apply(grads, trainable_variables, **kwargs)
+            reduced = _allreduce_grads(
+                list(grads), self._hvd_op, self._hvd_compression,
+                self._hvd_process_set, True)
+            self._hvd_inner = True
+            try:
+                return super().apply(reduced, trainable_variables, **kwargs)
+            finally:
+                self._hvd_inner = False
+
+    _DistributedKerasOptimizer.__name__ = (
+        name or "Distributed" + cls.__name__)
+    cfg = optimizer.get_config()
+    return _DistributedKerasOptimizer.from_config(cfg)
+
+
+def broadcast_model(model, root_rank: int = 0) -> None:
+    """Broadcast model (and, when built, optimizer) variables from root."""
+    broadcast_variables(model.variables, root_rank=root_rank)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and getattr(opt, "variables", None):
+        broadcast_variables(
+            [v for v in opt.variables if v.shape.num_elements()],
+            root_rank=root_rank)

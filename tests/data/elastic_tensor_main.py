@@ -1,0 +1,74 @@
+"""Elastic integration-test worker with REAL tensor state and (optionally)
+real multi-process JAX collectives (HVD_TPU_MULTIPROCESS_JAX=1).
+
+Unlike elastic_main.py (scalar epoch only), this worker carries a params
+vector through `TpuState`, so `state.sync()` provably transfers rank-0's
+committed parameters to a joining worker across process boundaries —
+the reference's `broadcast_parameters`-on-reset contract (SURVEY.md §3.5).
+
+Update rule per epoch: params += allreduce_avg(rank+1), making the
+trajectory deterministic given the membership history; every commit
+records the params so the test can assert cross-worker equality.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.runner import elastic_worker  # noqa: E402
+
+LOG_PATH = os.path.join(
+    os.environ["TEST_LOG_DIR"],
+    "worker-{}-{}.jsonl".format(
+        os.environ.get("HOROVOD_HOSTNAME", "localhost"),
+        os.environ.get("HOROVOD_SLOT", "0")),
+)
+
+
+def record(event, state):
+    with open(LOG_PATH, "a") as f:
+        f.write(json.dumps({
+            "event": event,
+            "epoch": getattr(state, "epoch", -1),
+            "params": np.asarray(state.params).tolist(),
+            "rank": hvd.rank() if hvd.is_initialized() else -1,
+            "size": hvd.size() if hvd.is_initialized() else -1,
+            "gen": elastic_worker._known_gen,
+        }) + "\n")
+
+
+# Multi-process mode: the first rendezvous must happen BEFORE init() so the
+# jax.distributed coordinator env is in place for the bootstrap.
+if os.environ.get("HOROVOD_ELASTIC") == "1":
+    elastic_worker.refresh_from_control_plane()
+hvd.init()
+
+state = hvd.elastic.TpuState(params=jnp.zeros((4,)), opt_state=None, epoch=0)
+
+
+@hvd.elastic.run
+def train(state):
+    num_epochs = int(os.environ.get("NUM_EPOCHS", "6"))
+    epoch_time = float(os.environ.get("EPOCH_TIME", "0.5"))
+    while state.epoch < num_epochs:
+        contrib = jnp.full((4,), float(hvd.rank() + 1))
+        upd = hvd.allreduce(contrib, op=hvd.Average)
+        state.params = jnp.asarray(state.params) + upd
+        time.sleep(epoch_time)
+        state.epoch += 1
+        record("commit", state)
+        state.commit()
+    record("done", state)
+
+
+train(state)
+record("exit", state)

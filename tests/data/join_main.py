@@ -1,0 +1,51 @@
+"""Worker main for the REAL multi-process join test.
+
+Two processes train with UNEVEN batch counts (rank r gets 3 + 2*r
+batches).  A rank that exhausts its data calls `hvd.join()`, which keeps
+servicing the survivors' collectives with zero contributions via
+control-plane signature mirroring (ops/join.py) — the reference's
+EnqueueJoin behavior (SURVEY.md §2.1 Join op) without a background
+thread.  Gradient averages must therefore stay correct for the survivors
+(not dragged toward zero), and join() returns the last joining rank.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    hvd.join_mode()  # armed on every process before training (uneven data)
+    rank, n = hvd.rank(), hvd.size()
+
+    num_batches = 3 + 2 * rank
+    averages = []
+    for step in range(num_batches):
+        grad = jnp.full((4,), float(rank + 1))
+        avg = hvd.allreduce(grad, op=hvd.Average, name=f"grad.{step}")
+        averages.append(float(np.asarray(avg)[0]))
+
+    last = hvd.join()
+
+    out_dir = os.environ["HVD_TEST_OUT"]
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "size": n, "averages": averages,
+                   "last_joined": last}, f)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

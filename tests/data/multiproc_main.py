@@ -1,0 +1,62 @@
+"""Worker main for REAL cross-process collective integration tests.
+
+Launched by `exec_run` with -np 2: each process pins the CPU platform,
+bootstraps `jax.distributed` through `hvd.init()` (coordinator env comes
+from the launcher), and runs actual cross-process collectives — the
+TPU-native analog of the reference's `horovodrun -np 2 pytest` pattern
+(SURVEY.md §4).  Results are written to $HVD_TEST_OUT/rank{r}.json for the
+test to assert.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+# The axon sitecustomize pins the TPU plugin regardless of env; tests must
+# never claim the shared chip (same override as tests/conftest.py).
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    rank, n = hvd.rank(), hvd.size()
+    assert n == int(os.environ["HOROVOD_SIZE"]), (n, os.environ["HOROVOD_SIZE"])
+    assert jax.process_count() == n, "jax.distributed did not bootstrap"
+
+    results = {"rank": rank, "size": n}
+
+    # allreduce: sum of rank-dependent contributions.
+    out = hvd.allreduce(jnp.array([1.0, 2.0]) * (rank + 1), op=hvd.Sum)
+    results["allreduce_sum"] = np.asarray(out).tolist()
+
+    # average round-trips the mean.
+    out = hvd.allreduce(jnp.full((3,), float(rank)), op=hvd.Average)
+    results["allreduce_avg"] = np.asarray(out).tolist()
+
+    # broadcast: everyone gets root's value.
+    out = hvd.broadcast(jnp.array([100.0 + rank]), root_rank=0)
+    results["broadcast"] = np.asarray(out).tolist()
+
+    # allgather: first-dim concat in rank order.
+    out = hvd.allgather(jnp.full((1, 2), float(rank)))
+    results["allgather"] = np.asarray(out).tolist()
+
+    out_dir = os.environ["HVD_TEST_OUT"]
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(results, f)
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

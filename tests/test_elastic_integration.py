@@ -25,7 +25,8 @@ class ElasticJob:
     file (the reference's discovery-script fakery)."""
 
     def __init__(self, tmp_path: Path, hosts, min_np=1, max_np=None,
-                 num_epochs=6, epoch_time=0.4, extra_env=None):
+                 num_epochs=6, epoch_time=0.4, extra_env=None,
+                 worker=WORKER_MAIN):
         self.tmp = tmp_path
         self.hosts_file = tmp_path / "hosts.txt"
         self.set_hosts(hosts)
@@ -52,7 +53,7 @@ class ElasticJob:
                "--min-np", str(min_np)]
         if max_np:
             cmd += ["--max-np", str(max_np)]
-        cmd += [sys.executable, WORKER_MAIN]
+        cmd += [sys.executable, worker]
         self.proc = subprocess.Popen(
             cmd, env=env, cwd=REPO_ROOT,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
@@ -173,3 +174,42 @@ class TestElastic:
         job.fail_host("hostA")
         rc, out = job.wait()
         assert rc != 0
+
+
+@pytest.mark.integration
+class TestElasticMultiprocessJax:
+    """Elastic with REAL cross-process JAX collectives
+    (HVD_TPU_MULTIPROCESS_JAX=1): every published rank bootstraps
+    jax.distributed, state.sync() moves actual tensors between processes,
+    and a reset tears the distributed runtime down and back up
+    (reference: the full §3.5 recovery cycle)."""
+
+    WORKER = os.path.join(REPO_ROOT, "tests", "data",
+                          "elastic_tensor_main.py")
+
+    def test_scale_up_syncs_tensor_state(self, tmp_path):
+        job = ElasticJob(
+            tmp_path, [("hostA", 1)], num_epochs=8, epoch_time=0.4,
+            extra_env={"HVD_TPU_MULTIPROCESS_JAX": "1",
+                       # one CPU device per process: the pytest session's
+                       # 8-virtual-device XLA_FLAGS must not leak in
+                       "XLA_FLAGS": ""},
+            worker=self.WORKER)
+        job.wait_for_event("hostA-0", "commit", min_epoch=2)
+        job.set_hosts([("hostA", 1), ("hostB", 1)])
+        rc, out = job.wait(timeout=240)
+        assert rc == 0, out
+        hist = job.histories()
+        a, b = hist["hostA-0"], hist.get("hostB-0", [])
+        assert b, f"joiner never started: {out}"
+        assert a[-1]["event"] == "exit" and b[-1]["event"] == "exit"
+        # Both finished at size 2 under a real 2-process world.
+        assert a[-1]["size"] == 2 and b[-1]["size"] == 2
+        # The joiner's FIRST commit carries synced (non-zero) params —
+        # rank-0's committed trajectory reached it via a real
+        # cross-process broadcast, not a fresh start.
+        first_b_commit = next(r for r in b if r["event"] == "commit")
+        assert first_b_commit["epoch"] >= 3
+        assert all(p > 2.0 for p in first_b_commit["params"])
+        # And the final params agree exactly across workers.
+        assert a[-1]["params"] == b[-1]["params"]

@@ -101,3 +101,12 @@ class TestExamples:
             env=env)
         assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
         assert "epoch 3" in r.stdout
+
+
+@pytest.mark.integration
+class TestKerasExample:
+    def test_keras_mnist(self):
+        out = _run_example("keras_mnist.py",
+                          ["--epochs", "1", "--n", "128",
+                           "--batch-size", "32"], timeout=420)
+        assert "final loss:" in out

@@ -1,0 +1,114 @@
+"""True-join / uneven-data tests (reference: test_torch.py /
+test_tensorflow.py join cases — a data-exhausted rank stops contributing,
+averages are over the ranks still contributing, join() returns the last
+joining rank).
+
+Sim layer here exercises the masked-collective numerics on the 8-rank
+mesh; tests/test_multiprocess.py::TestJoinMultiprocess exercises the real
+2-process signature-mirroring path.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import join as join_mod
+from horovod_tpu.ops.collectives import PerRank
+
+
+@pytest.fixture(autouse=True)
+def clean_join_state():
+    join_mod.reset()
+    yield
+    join_mod.reset()
+
+
+def per_rank(values):
+    return PerRank([jnp.asarray(v) for v in values])
+
+
+class TestMaskedNumerics:
+    def test_average_over_active_ranks_only(self):
+        # Ranks 5,6,7 exhausted their data: averages cover ranks 0-4.
+        join_mod._mark_joined([5, 6, 7])
+        vals = [float(r) for r in range(8)]
+        out = hvd.allreduce(per_rank([[v] for v in vals]), op=hvd.Average)
+        np.testing.assert_allclose(np.asarray(out), [np.mean(vals[:5])])
+
+    def test_sum_ignores_joined(self):
+        join_mod._mark_joined([0, 1])
+        out = hvd.allreduce(per_rank([[1.0]] * 8), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), [6.0])
+
+    def test_min_max_use_identity_for_joined(self):
+        join_mod._mark_joined([7])
+        vals = [[float(r)] for r in range(8)]  # rank 7 has the max value
+        mx = hvd.allreduce(per_rank(vals), op=hvd.Max)
+        np.testing.assert_allclose(np.asarray(mx), [6.0])
+        join_mod.reset()
+        join_mod._mark_joined([0])  # rank 0 has the min value
+        mn = hvd.allreduce(per_rank(vals), op=hvd.Min)
+        np.testing.assert_allclose(np.asarray(mn), [1.0])
+
+    def test_int_sum_masked(self):
+        join_mod._mark_joined([2, 3])
+        out = hvd.allreduce(per_rank([[2]] * 8), op=hvd.Sum)
+        assert np.asarray(out).dtype == np.int32
+        np.testing.assert_array_equal(np.asarray(out), [12])
+
+    def test_grouped_allreduce_masked(self):
+        join_mod._mark_joined([4, 5, 6, 7])
+        outs = hvd.grouped_allreduce(
+            [per_rank([[float(r)] for r in range(8)]),
+             per_rank([[2.0 * r] for r in range(8)])],
+            op=hvd.Average)
+        np.testing.assert_allclose(np.asarray(outs[0]), [1.5])
+        np.testing.assert_allclose(np.asarray(outs[1]), [3.0])
+
+    def test_unarmed_path_unchanged(self):
+        out = hvd.allreduce(per_rank([[1.0]] * 8), op=hvd.Average)
+        np.testing.assert_allclose(np.asarray(out), [1.0])
+
+    def test_uneven_batch_training_average(self):
+        """The uneven-data training contract: ranks with exhausted data
+        stop influencing the gradient average."""
+        grads = [[1.0, 1.0]] * 8
+        # Epoch 1: everyone contributes.
+        out1 = hvd.allreduce(per_rank(grads), op=hvd.Average)
+        np.testing.assert_allclose(np.asarray(out1), [1.0, 1.0])
+        # Epoch 2: ranks 6,7 ran out; survivors' average is unchanged by
+        # the absent ranks (NOT dragged toward zero).
+        join_mod._mark_joined([6, 7])
+        out2 = hvd.allreduce(per_rank(grads), op=hvd.Average)
+        np.testing.assert_allclose(np.asarray(out2), [1.0, 1.0])
+
+
+class TestJoinApi:
+    def test_join_completes_and_clears(self):
+        last = hvd.join()
+        assert last == 7  # all 8 sim ranks join at once; max rank returned
+        # Once every rank joined the cycle completes: state clears so
+        # later collectives run unmasked (reference: training continues
+        # normally after join — e.g. a final metric allreduce).
+        assert hvd.joined_ranks() == []
+
+    def test_collective_after_complete_join_is_unmasked(self):
+        hvd.join()
+        out = hvd.allreduce(per_rank([[1.0]] * 8), op=hvd.Average)
+        np.testing.assert_allclose(np.asarray(out), [1.0])
+        out = hvd.allreduce(per_rank([[1.0]] * 8), op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(out), [8.0])
+
+    def test_repeated_join_cycles(self):
+        # A second uneven-data phase starts a fresh cycle.
+        assert hvd.join() == 7
+        assert hvd.join() == 7
+        assert hvd.joined_ranks() == []
+
+    def test_join_mode_arms(self):
+        assert not join_mod.armed()
+        hvd.join_mode(True)
+        assert join_mod.armed()
+        hvd.join_mode(False)
+        assert not join_mod.armed()
