@@ -71,7 +71,8 @@ class _joinable:
                  op: Optional["ReduceOp"] = None,
                  root_rank: Optional[int] = None,
                  process_set: Optional[ProcessSet] = None,
-                 prescale: float = 1.0, postscale: float = 1.0):
+                 prescale: float = 1.0, postscale: float = 1.0,
+                 extra: Optional[Dict[str, Any]] = None):
         self._outer = not getattr(_join_tls, "nested", False)
         if self._outer and _join.armed():
             shapes, dtypes = [], []
@@ -92,6 +93,8 @@ class _joinable:
                 sig["pre"] = float(prescale)
             if postscale != 1.0:
                 sig["post"] = float(postscale)
+            if extra:
+                sig.update(extra)
             _join.publish_signature(sig)
 
     def __enter__(self):
@@ -252,6 +255,66 @@ def _is_tracer(x: Any) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
+def _tracer_set_guard(kind: str, process_set: Optional[ProcessSet]) -> None:
+    """In-jit paths that cannot honor a rank subset must refuse it loudly
+    (reference: process_set.cc semantics apply to every op; silently
+    reducing over the whole axis would be a wrong-answer path)."""
+    if process_set is not None and process_set.process_set_id != 0:
+        raise HorovodTpuError(
+            f"{kind} with a non-global process_set inside jit is not "
+            f"supported; run it on the eager path, or restrict the "
+            f"computation with shard_map over the set's sub-mesh"
+        )
+
+
+def _tracer_require_global_axis(ax: str) -> None:
+    if ax != GLOBAL_AXIS:
+        raise HorovodTpuError(
+            "process_set inside jit requires the global 'hvd' axis "
+            f"(axis index = global rank); got axis {ax!r}"
+        )
+
+
+def _tracer_member_mask(ps: ProcessSet, ax: str):
+    """Scalar bool: is this rank (axis index on the global axis) a member
+    of `ps`?  Only meaningful when `ax` indexes global ranks."""
+    _tracer_require_global_axis(ax)
+    idx = lax.axis_index(ax)
+    return jnp.isin(idx, jnp.asarray(ps.ranks))
+
+
+def _tracer_set_reduce(x, op: ReduceOp, ps: ProcessSet, ax: str):
+    """In-jit allreduce over a rank subset, done by masking: non-members
+    contribute the op's identity to a full-axis collective, so every rank
+    (member or not) receives the subset's reduction.  SPMD requires all
+    ranks to execute the collective anyway, so this costs nothing extra
+    over axis_index_groups and avoids XLA's equal-group-size constraints.
+    """
+    member = _tracer_member_mask(ps, ax)
+    n = len(ps.ranks)
+    if op is Average:
+        s = lax.psum(jnp.where(member, x, jnp.zeros_like(x)), ax)
+        return (s.astype(jnp.float32) / n).astype(x.dtype)
+    if op is Sum:
+        return lax.psum(jnp.where(member, x, jnp.zeros_like(x)), ax)
+    if op is Min:
+        big = jnp.asarray(
+            jnp.finfo(x.dtype).max
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.iinfo(x.dtype).max, x.dtype)
+        return lax.pmin(jnp.where(member, x, big), ax)
+    if op is Max:
+        small = jnp.asarray(
+            jnp.finfo(x.dtype).min
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.iinfo(x.dtype).min, x.dtype)
+        return lax.pmax(jnp.where(member, x, small), ax)
+    if op is Product:
+        g = lax.all_gather(jnp.where(member, x, jnp.ones_like(x)), ax)
+        return jnp.prod(g, axis=0)
+    raise HorovodTpuError(f"Unsupported in-jit reduce op {op}")
+
+
 # ---------------------------------------------------------------------------
 # Building global (per-rank-sharded) arrays from local contributions
 # ---------------------------------------------------------------------------
@@ -273,6 +336,22 @@ def _local_contributions(
     return [x] * len(st_local)
 
 
+def _stage_shard(c, d: jax.Device):
+    """One (1, *shape) shard committed to device `d`.
+
+    Device-resident inputs stay device-resident: a `jax.Array` is reshaped
+    on its own device and moved by `device_put` directly (same-device = a
+    no-op view; cross-device rides ICI/DMA).  Only host data (numpy, python
+    scalars) pays a host→device transfer.  Reference analog: the fusion
+    buffer keeps payloads in device memory end to end
+    (fusion_buffer_manager.cc) — round-tripping an eager collective's input
+    through `np.asarray` would be a D2H+H2D per call.
+    """
+    if isinstance(c, jax.Array) and not c.is_deleted():
+        return jax.device_put(c[None], d)
+    return jax.device_put(np.asarray(c)[None], d)
+
+
 def _make_global(tensor: Union[Any, PerRank], ps: ProcessSet) -> jax.Array:
     """Build the (set_size, *shape) array sharded one-rank-per-device."""
     contribs = _local_contributions(tensor, ps)
@@ -283,14 +362,28 @@ def _make_global(tensor: Union[Any, PerRank], ps: ProcessSet) -> jax.Array:
         d for d in devs if d.process_index == basics.process_index()
     ]
     sharding = NamedSharding(ps.mesh, P(GLOBAL_AXIS))
-    shards = [
-        jax.device_put(np.asarray(c)[None], d)
-        for c, d in zip(contribs, local_devs)
-    ]
+    shards = [_stage_shard(c, d) for c, d in zip(contribs, local_devs)]
     global_shape = (ps.size(),) + tuple(shape)
     return jax.make_array_from_single_device_arrays(
         global_shape, sharding, shards
     ), dtype
+
+
+def _local_rows(out_arr: jax.Array, ps: ProcessSet,
+                local: Sequence[int]) -> List[jax.Array]:
+    """Per-local-rank rows of a rank-sharded (set_size, ...) result.
+
+    Reads ONLY addressable shards: in multi-process mode a global array's
+    remote shards cannot be fetched, and computing `out[i]` per-process
+    would issue different programs on different processes (SPMD violation).
+    Shard i of a P(GLOBAL_AXIS) output lives on the set's i-th device, so
+    each process's rows are exactly its local shards.  Rows stay
+    device-resident (no host round-trip).
+    """
+    by_row: Dict[int, jax.Array] = {}
+    for sh in out_arr.addressable_shards:
+        by_row[sh.index[0].start or 0] = sh.data
+    return [by_row[ps.ranks.index(r)][0] for r in local]
 
 
 def _replicated(ps: ProcessSet) -> NamedSharding:
@@ -433,7 +526,9 @@ def allreduce(
         ax = axis_name or GLOBAL_AXIS
         x = tensor * jnp.asarray(prescale_factor, tensor.dtype) \
             if prescale_factor != 1.0 else tensor
-        if op is Average:
+        if process_set is not None and process_set.process_set_id != 0:
+            out = _tracer_set_reduce(x, op, process_set, ax)
+        elif op is Average:
             out = lax.pmean(x, ax)
         elif op is Sum:
             out = lax.psum(x, ax)
@@ -501,6 +596,7 @@ def grouped_allreduce(
             red = allreduce(
                 buf, op=op, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor, axis_name=ax,
+                process_set=process_set,
             )
             offset = 0
             for i in idxs:
@@ -558,6 +654,7 @@ def allgather(
     sliced on the way out).
     """
     if _is_tracer(tensor):
+        _tracer_set_guard("allgather", process_set)
         ax = axis_name or GLOBAL_AXIS
         return lax.all_gather(tensor, ax, tiled=True)
 
@@ -651,8 +748,21 @@ def broadcast(
     EnqueueTensorBroadcast)."""
     if _is_tracer(tensor):
         ax = axis_name or GLOBAL_AXIS
+        root = root_rank
+        if process_set is not None and process_set.process_set_id != 0:
+            # root_rank is set-relative (reference semantics); translate
+            # to the global axis index.  Non-members receive the value
+            # too — harmless under SPMD, where they must execute the
+            # collective regardless.
+            _tracer_require_global_axis(ax)
+            if root_rank not in range(len(process_set.ranks)):
+                raise HorovodTpuError(
+                    f"root_rank {root_rank} out of range for set of size "
+                    f"{len(process_set.ranks)}"
+                )
+            root = process_set.ranks[root_rank]
         idx = lax.axis_index(ax)
-        masked = jnp.where(idx == root_rank, tensor,
+        masked = jnp.where(idx == root, tensor,
                            jnp.zeros_like(tensor))
         return lax.psum(masked, ax)
 
@@ -700,6 +810,7 @@ def alltoall(
     (received, received_splits) like the reference.
     """
     if _is_tracer(tensor):
+        _tracer_set_guard("alltoall", process_set)
         if splits is not None:
             raise HorovodTpuError(
                 "alltoall with splits is not supported inside jit; uneven "
@@ -720,28 +831,29 @@ def alltoall(
                 f"alltoall without splits requires dim0 ({d0}) divisible by "
                 f"set size ({n})"
             )
-        xs, _ = _make_global(PerRank(contribs), ps)
+        with _joinable("alltoall", [contribs[0]], process_set=ps):
+            xs, _ = _make_global(PerRank(contribs), ps)
 
-        def build():
-            def fn(x):
-                # x: (n, d0, *s) rank-sharded on axis 0.
-                c = x.shape[1] // n
-                y = x.reshape((n, n, c) + x.shape[2:])
-                y = jnp.swapaxes(y, 0, 1)  # (recv, send, c, *s)
-                return y.reshape((n, n * c) + x.shape[2:])
+            def build():
+                def fn(x):
+                    # x: (n, d0, *s) rank-sharded on axis 0.
+                    c = x.shape[1] // n
+                    y = x.reshape((n, n, c) + x.shape[2:])
+                    y = jnp.swapaxes(y, 0, 1)  # (recv, send, c, *s)
+                    return y.reshape((n, n * c) + x.shape[2:])
 
-            return jax.jit(
-                fn,
-                in_shardings=(_rank_sharded(ps),),
-                out_shardings=_rank_sharded(ps),
-            )
+                return jax.jit(
+                    fn,
+                    in_shardings=(_rank_sharded(ps),),
+                    out_shardings=_rank_sharded(ps),
+                )
 
-        program = _cached_program(("alltoall", ps.process_set_id), build)
-        with _traced("ALLTOALL", name) as tr:
-            out = tr.track(program(xs))
+            program = _cached_program(("alltoall", ps.process_set_id), build)
+            with _traced("ALLTOALL", name) as tr:
+                out = tr.track(program(xs))
         # Return this process's received rows, one per local rank.
         local = [r for r in basics.local_device_ranks() if r in ps.ranks]
-        rows = [out[ps.ranks.index(r)] for r in local]
+        rows = _local_rows(out, ps, local)
         if isinstance(tensor, PerRank):
             return PerRank(rows)
         return rows[0]
@@ -751,6 +863,26 @@ def alltoall(
         splits.values if isinstance(splits, PerRank) else
         [np.asarray(splits, np.int32)] * len(contribs)
     )
+    # Publish [0, *tail] — a mirroring joined rank sends nothing (zero
+    # splits) but must run the same split-exchange + padded programs.
+    _join_sig_shape = [0] + list(contribs[0].shape[1:])
+    with _joinable("alltoallv", [], process_set=ps,
+                   extra={"shapes": [_join_sig_shape],
+                          "dtypes": [str(contribs[0].dtype)]}):
+        return _alltoallv_eager(tensor, contribs, splits_arr, ps, n, name)
+
+
+def _alltoallv_eager(tensor, contribs, splits_arr, ps, n, name):
+    for c, sp in zip(contribs, splits_arr):
+        sp = np.asarray(sp)
+        if sp.shape != (n,):
+            raise HorovodTpuError(
+                f"alltoall splits must have one entry per rank "
+                f"({n}), got shape {tuple(sp.shape)}")
+        if np.any(sp < 0) or int(sp.sum()) != int(c.shape[0]):
+            raise HorovodTpuError(
+                f"alltoall splits must be non-negative and sum to dim0 "
+                f"({int(c.shape[0])}), got {sp.tolist()}")
     all_splits = _alltoall_exchange_splits(splits_arr, ps)
     maxc = int(max(int(s) for row in all_splits for s in row)) or 1
     padded = []
@@ -782,16 +914,19 @@ def alltoall(
 
     program = _cached_program(("alltoallv", ps.process_set_id), build)
     with _traced("ALLTOALL", name):
-        # np.asarray is a blocking device→host fetch: the bracket stays
-        # open across the genuinely-blocking part, so a hang here is
-        # visible to the watchdog without readiness tracking.
-        out = np.asarray(program(xs))
-    local = [r for r in basics.local_device_ranks() if r in ps.ranks]
+        # np.asarray per local shard is a blocking device→host fetch: the
+        # bracket stays open across the genuinely-blocking part, so a hang
+        # here is visible to the watchdog without readiness tracking.
+        local = [r for r in basics.local_device_ranks() if r in ps.ranks]
+        local_out = {
+            r: np.asarray(row)
+            for r, row in zip(local, _local_rows(program(xs), ps, local))
+        }
     results, rsplits = [], []
     for r in local:
         i = ps.ranks.index(r)
         recv_counts = [int(all_splits[s][i]) for s in range(n)]
-        pieces = [out[i, s, : recv_counts[s]] for s in range(n)]
+        pieces = [local_out[r][s, : recv_counts[s]] for s in range(n)]
         results.append(jnp.concatenate(pieces, axis=0))
         rsplits.append(jnp.asarray(recv_counts, jnp.int32))
     if isinstance(tensor, PerRank):
@@ -837,6 +972,7 @@ def reducescatter(
             f"reducescatter supports Sum and Average, got {op}"
         )
     if _is_tracer(tensor):
+        _tracer_set_guard("reducescatter", process_set)
         ax = axis_name or GLOBAL_AXIS
         out = lax.psum_scatter(tensor, ax, tiled=True)
         if op is Average:
@@ -851,26 +987,51 @@ def reducescatter(
         raise HorovodTpuError(
             f"reducescatter requires dim0 ({d0}) divisible by set size ({n})"
         )
-    xs, _ = _make_global(PerRank(contribs), ps)
+    with _joinable("reducescatter", [contribs[0]], op=op, process_set=ps):
+        xs, _ = _make_global(PerRank(contribs), ps)
+        if _join.armed():
+            # Masked variant: joined ranks contribute zeros and Average
+            # divides by the active count (reference: controller.cc
+            # joined_size scaling applies to every reduce-type op).
+            mask, _ = _make_global(
+                PerRank(_join.active_mask_contrib(ps)), ps)
 
-    def build():
-        def fn(x):
-            red = jnp.sum(x, axis=0) if op is Sum else jnp.mean(x, axis=0)
-            return red.reshape((n, d0 // n) + x.shape[2:])
+            def build_masked():
+                def fn(x, m):
+                    s = _join.masked_reduce_in_graph(x, m, op, n)
+                    return s.reshape((n, x.shape[1] // n) + x.shape[2:])
 
-        return jax.jit(
-            fn,
-            in_shardings=(_rank_sharded(ps),),
-            out_shardings=_rank_sharded(ps),
-        )
+                return jax.jit(
+                    fn,
+                    in_shardings=(_rank_sharded(ps), _rank_sharded(ps)),
+                    out_shardings=_rank_sharded(ps),
+                )
 
-    program = _cached_program(
-        ("reducescatter", ps.process_set_id, op.name), build
-    )
-    with _traced("REDUCESCATTER", name) as tr:
-        out = tr.track(program(xs))
+            program = _cached_program(
+                ("masked_reducescatter", ps.process_set_id, op.name),
+                build_masked)
+            with _traced("REDUCESCATTER", name) as tr:
+                out = tr.track(program(xs, mask))
+        else:
+            def build():
+                def fn(x):
+                    red = (jnp.sum(x, axis=0) if op is Sum
+                           else jnp.mean(x, axis=0))
+                    return red.reshape((n, x.shape[1] // n) + x.shape[2:])
+
+                return jax.jit(
+                    fn,
+                    in_shardings=(_rank_sharded(ps),),
+                    out_shardings=_rank_sharded(ps),
+                )
+
+            program = _cached_program(
+                ("reducescatter", ps.process_set_id, op.name), build
+            )
+            with _traced("REDUCESCATTER", name) as tr:
+                out = tr.track(program(xs))
     local = [r for r in basics.local_device_ranks() if r in ps.ranks]
-    rows = [out[ps.ranks.index(r)] for r in local]
+    rows = _local_rows(out, ps, local)
     if isinstance(tensor, PerRank):
         return PerRank(rows)
     return rows[0]

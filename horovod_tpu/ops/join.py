@@ -286,6 +286,31 @@ def _mirror_collective(sig: Dict[str, Any], C) -> bool:
             jnp.zeros(tuple(sig["shapes"][0]), jnp.dtype(sig["dtypes"][0])),
             root_rank=sig["root_rank"], process_set=ps)
         jax.block_until_ready(out)
+    elif kind == "reducescatter":
+        out = C.reducescatter(
+            jnp.zeros(tuple(sig["shapes"][0]), jnp.dtype(sig["dtypes"][0])),
+            op=_op_by_name(C, sig["op"]), process_set=ps)
+        jax.block_until_ready(out)
+    elif kind == "alltoall":
+        # Fixed-shape path: every rank must contribute the same dim0, so
+        # the joined rank sends zeros (receivers see zero chunks from it —
+        # the compiled-SPMD analog of the reference's zero-tensor
+        # participation).
+        out = C.alltoall(
+            jnp.zeros(tuple(sig["shapes"][0]), jnp.dtype(sig["dtypes"][0])),
+            process_set=ps)
+        jax.block_until_ready(out)
+    elif kind == "alltoallv":
+        # Splits path: a zero split to every peer — exact reference
+        # semantics (joined rank sends nothing; peers' recv splits from it
+        # are 0).  Runs the same split-exchange + padded programs as the
+        # active ranks.
+        shape = list(sig["shapes"][0])
+        shape[0] = 0
+        out, rsplits = C.alltoall(
+            jnp.zeros(tuple(shape), jnp.dtype(sig["dtypes"][0])),
+            splits=[0] * ps.size(), process_set=ps)
+        jax.block_until_ready((out, rsplits))
     elif kind == "barrier":
         C.barrier(process_set=ps)
     else:

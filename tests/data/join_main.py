@@ -38,12 +38,27 @@ def main():
         avg = hvd.allreduce(grad, op=hvd.Average, name=f"grad.{step}")
         averages.append(float(np.asarray(avg)[0]))
 
+    # Survivors (rank > 0) also run reducescatter + both alltoall flavors
+    # while rank 0 is already joined: the joined rank must mirror them with
+    # zero contributions (the reference's JoinOp covers every enqueue type,
+    # not just allreduce).
+    extra = {}
+    if rank > 0:
+        rs = hvd.reducescatter(jnp.asarray([10.0, 20.0]), op=hvd.Average)
+        extra["rs"] = [float(v) for v in np.asarray(rs)]
+        a2a = hvd.alltoall(jnp.full((n,), 5.0))
+        extra["a2a"] = [float(v) for v in np.asarray(a2a)]
+        recv, rsplits = hvd.alltoall(
+            jnp.asarray([1.0, 2.0, 3.0]), splits=[1] * (n - 1) + [2])
+        extra["a2av"] = [float(v) for v in np.asarray(recv)]
+        extra["a2av_splits"] = [int(v) for v in np.asarray(rsplits)]
+
     last = hvd.join()
 
     out_dir = os.environ["HVD_TEST_OUT"]
     with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
         json.dump({"rank": rank, "size": n, "averages": averages,
-                   "last_joined": last}, f)
+                   "last_joined": last, **extra}, f)
     hvd.shutdown()
 
 

@@ -403,3 +403,138 @@ def test_alltoall_splits_inside_jit_raises(mesh):
 
     with pytest.raises(HorovodTpuError):
         jax.jit(_shard_mapped(f, mesh))(vals)
+
+
+# ---------------------------------------------------------------------------
+# Process sets inside jit (reference: process_set.cc semantics apply to
+# every op; the tracer path must honor the subset or refuse loudly)
+# ---------------------------------------------------------------------------
+
+def test_allreduce_process_set_inside_shard_map(mesh):
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    try:
+        vals = per_rank_data((4,), np.float32)
+        stacked = jnp.stack(vals)
+
+        def f(x):
+            return hvd.allreduce(x[0], op=hvd.Average, process_set=ps)
+
+        out = jax.jit(_shard_mapped(f, mesh))(stacked)
+        expected = np.mean(np.stack([vals[r] for r in ps.ranks]), 0)
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_allreduce_process_set_sum_min_inside_shard_map(mesh):
+    ps = hvd.add_process_set([1, 3, 5])
+    try:
+        vals = per_rank_data((3,), np.float32)
+        stacked = jnp.stack(vals)
+
+        def f(x):
+            return (hvd.allreduce(x[0], op=hvd.Sum, process_set=ps),
+                    hvd.allreduce(x[0], op=hvd.Min, process_set=ps))
+
+        s, mn = jax.jit(_shard_mapped(f, mesh))(stacked)
+        sub = np.stack([vals[r] for r in ps.ranks])
+        np.testing.assert_allclose(np.asarray(s), sub.sum(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(mn), sub.min(0), rtol=1e-5)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_broadcast_process_set_inside_shard_map(mesh):
+    ps = hvd.add_process_set([1, 3])
+    try:
+        vals = per_rank_data((2,), np.float32)
+        stacked = jnp.stack(vals)
+
+        def f(x):
+            # root_rank is set-relative: 1 -> global rank 3.
+            return hvd.broadcast(x[0], root_rank=1, process_set=ps)
+
+        out = jax.jit(_shard_mapped(f, mesh))(stacked)
+        np.testing.assert_allclose(np.asarray(out), vals[3], rtol=1e-5)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_gather_type_process_set_inside_jit_raises(mesh):
+    from horovod_tpu.common.exceptions import HorovodTpuError
+
+    ps = hvd.add_process_set([0, 1])
+    try:
+        vals = jnp.stack([jnp.arange(N, dtype=jnp.float32)] * N)
+
+        def g(x):
+            return hvd.allgather(x[0], process_set=ps)
+
+        with pytest.raises(HorovodTpuError, match="process_set inside jit"):
+            jax.jit(_shard_mapped(g, mesh))(vals)
+
+        def rs(x):
+            return hvd.reducescatter(x[0], process_set=ps)
+
+        with pytest.raises(HorovodTpuError, match="process_set inside jit"):
+            jax.jit(_shard_mapped(rs, mesh))(vals)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+# ---------------------------------------------------------------------------
+# Device-resident eager path (reference: fusion_buffer_manager.cc keeps
+# payloads in device memory; the eager API must not round-trip via host)
+# ---------------------------------------------------------------------------
+
+def test_eager_allreduce_no_device_to_host():
+    x = jnp.arange(1024, dtype=jnp.float32)  # device-resident input
+    with jax.transfer_guard_device_to_host("disallow"):
+        out = hvd.allreduce(x, op=hvd.Sum)
+        out2 = hvd.allreduce(PerRank([x + r for r in range(N)]), op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.arange(1024, dtype=np.float32) * N)
+    np.testing.assert_allclose(
+        np.asarray(out2),
+        np.arange(1024, dtype=np.float32) * N + sum(range(N)))
+
+
+def test_eager_broadcast_no_device_to_host():
+    x = jnp.full((16,), float(hvd.rank()))
+    with jax.transfer_guard_device_to_host("disallow"):
+        out = hvd.broadcast(x, root_rank=0)
+    assert np.asarray(out).shape == (16,)
+
+
+def test_reducescatter_two_shapes_same_cache():
+    # Regression: the program cache must not bake the first call's dim0.
+    out1 = hvd.reducescatter(np.ones((N * 2,), np.float32), op=hvd.Sum)
+    out2 = hvd.reducescatter(np.ones((N * 4,), np.float32), op=hvd.Sum)
+    assert np.asarray(out1).shape == (2,)
+    assert np.asarray(out2).shape == (4,)
+
+
+def test_alltoall_splits_must_sum_to_dim0():
+    from horovod_tpu.common.exceptions import HorovodTpuError
+
+    with pytest.raises(HorovodTpuError, match="sum to dim0"):
+        hvd.alltoall(np.arange(3, dtype=np.float32),
+                     splits=[2] + [0] * (N - 2) + [3])
+    with pytest.raises(HorovodTpuError, match="one entry per rank"):
+        hvd.alltoall(np.arange(3, dtype=np.float32), splits=[1, 2])
+
+
+def test_broadcast_process_set_root_out_of_range_in_jit(mesh):
+    from horovod_tpu.common.exceptions import HorovodTpuError
+
+    ps = hvd.add_process_set([1, 3])
+    try:
+        vals = jnp.stack([jnp.full((2,), float(r)) for r in range(N)])
+
+        def f(x):
+            return hvd.broadcast(x[0], root_rank=-1, process_set=ps)
+
+        with pytest.raises(HorovodTpuError, match="out of range"):
+            jax.jit(_shard_mapped(f, mesh))(vals)
+    finally:
+        hvd.remove_process_set(ps)

@@ -93,3 +93,14 @@ class TestJoinMultiprocess:
         # Rank 1 joined last.
         assert res[0]["last_joined"] == 1
         assert res[1]["last_joined"] == 1
+        # Collectives issued while rank 0 was joined (mirrored with zero
+        # contributions — JoinOp covers every enqueue type):
+        # reducescatter Average over active count 1 → rank 1's own row.
+        assert res[1]["rs"] == [20.0]
+        # Fixed alltoall: rank 0 contributes zeros; rank 1 receives
+        # [rank0's chunk (0), its own chunk (5)].
+        assert res[1]["a2a"] == [0.0, 5.0]
+        # Splits alltoall: joined rank sends zero splits — rank 1 receives
+        # only its own 2 elements, recv splits [0, 2].
+        assert res[1]["a2av"] == [2.0, 3.0]
+        assert res[1]["a2av_splits"] == [0, 2]
