@@ -98,7 +98,9 @@ from .parallel.optimizer import (  # noqa: F401
 )
 
 from .parallel.data_parallel import (  # noqa: F401
+    allreduce_gradients,
     data_parallel,
+    distributed_grad,
     DistributedGradientTape,
     shard_batch,
 )

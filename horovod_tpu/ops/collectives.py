@@ -273,6 +273,16 @@ def _tracer_require_global_axis(ax: str) -> None:
             "process_set inside jit requires the global 'hvd' axis "
             f"(axis index = global rank); got axis {ax!r}"
         )
+    # The name alone is not enough: a hierarchical ("dcn", "hvd") mesh
+    # reuses the 'hvd' name for its slice-LOCAL axis, where axis_index is
+    # the intra-slice index, not the global rank — masking by it would
+    # silently reduce the wrong subset.
+    if basics.is_initialized() and lax.axis_size(ax) != basics.size():
+        raise HorovodTpuError(
+            f"process_set inside jit requires the 'hvd' axis to span all "
+            f"{basics.size()} ranks; this mesh's spans {lax.axis_size(ax)} "
+            f"(hierarchical sub-axis?) — use the eager API instead"
+        )
 
 
 def _tracer_member_mask(ps: ProcessSet, ax: str):
@@ -526,7 +536,15 @@ def allreduce(
         ax = axis_name or GLOBAL_AXIS
         x = tensor * jnp.asarray(prescale_factor, tensor.dtype) \
             if prescale_factor != 1.0 else tensor
-        if process_set is not None and process_set.process_set_id != 0:
+        # Multi-slice: a ("dcn", "hvd") axis pair + the reference's
+        # HOROVOD_HIERARCHICAL_ALLREDUCE flag routes through ICI
+        # reduce-scatter → DCN allreduce → ICI all-gather.
+        from ..parallel import hierarchical as _hier
+        hier_out = (None if process_set is not None
+                    else _hier.maybe_hierarchical(x, ax, op.name))
+        if hier_out is not None:
+            out = hier_out
+        elif process_set is not None and process_set.process_set_id != 0:
             out = _tracer_set_reduce(x, op, process_set, ax)
         elif op is Average:
             out = lax.pmean(x, ax)

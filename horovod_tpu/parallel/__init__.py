@@ -10,8 +10,12 @@ composed over named ICI axes), exercised by the flagship transformer in
 from .mesh import (  # noqa: F401
     AXIS_ORDER,
     MeshConfig,
+    create_hierarchical_mesh,
     create_hybrid_mesh,
     mesh_axis_size,
+)
+from .hierarchical import (  # noqa: F401
+    hierarchical_allreduce,
 )
 from .sequence import (  # noqa: F401
     full_attention,

@@ -59,10 +59,8 @@ def allreduce_gradients(
     the reference default), overridden live by the autotuner when
     HOROVOD_AUTOTUNE=1."""
     if fusion_threshold_bytes is None:
-        from ..utils import autotune as _at
-        from ..common import util as _util
-        fusion_threshold_bytes = _at.tuned_fusion_threshold(
-            _util.env_int("FUSION_THRESHOLD", 64 * 1024 * 1024))
+        from ..utils.autotune import current_fusion_threshold
+        fusion_threshold_bytes = current_fusion_threshold()
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -188,7 +186,31 @@ def data_parallel(
     # as NamedSharding over the mesh — the next call would then see
     # different input shardings and silently recompile the whole program
     # (observed: an extra full ResNet-50 compile inside the timed loop).
+    #
+    # The cache key includes the live autotuner's fusion threshold: the
+    # bucketing inside the traced step bakes the threshold read at trace
+    # time, so when HOROVOD_AUTOTUNE proposes a new value the step must
+    # retrace to actually change the bucket count (reference:
+    # parameter_manager.cc re-tunes the running job's fusion buffer).
     compiled_cache = {}
+
+    def _autotune_key():
+        from ..utils import autotune as _at
+        if _at.get_manager() is None:
+            return None
+        return _at.tuned_fusion_threshold(-1)
+
+    def _autotune_record(args):
+        from ..utils import autotune as _at
+        pm = _at.get_manager()
+        if pm is None:
+            return
+        items = 1
+        if batch_args and batch_args[0] < len(args):
+            leaves = jax.tree_util.tree_leaves(args[batch_args[0]])
+            if leaves and hasattr(leaves[0], "shape") and leaves[0].shape:
+                items = int(leaves[0].shape[0])
+        pm.record_step(items)
 
     def _coerce(x, sharding):
         # jit with explicit in_shardings REJECTS committed arrays whose
@@ -203,7 +225,8 @@ def data_parallel(
 
     def call(*args):
         n_args = len(args)
-        entry = compiled_cache.get(n_args)
+        key = (n_args, _autotune_key())
+        entry = compiled_cache.get(key)
         if entry is None:
             in_specs = tuple(
                 P(axis_name) if i in batch_args else P()
@@ -223,12 +246,24 @@ def data_parallel(
                 donate_argnums=tuple(d for d in donate_args if d < n_args),
             )
             entry = (fn, in_shardings)
-            compiled_cache[n_args] = entry
+            # Only the current threshold's program will ever run again:
+            # evict superseded-threshold entries so a long autotune run
+            # does not accumulate one full compiled step per proposal.
+            for k in [k for k in compiled_cache
+                      if k[0] == n_args and k[1] != key[1]]:
+                del compiled_cache[k]
+            compiled_cache[key] = entry
         fn, in_shardings = entry
         args = tuple(
             jax.tree_util.tree_map(lambda x, s=s: _coerce(x, s), a)
             for a, s in zip(args, in_shardings)
         )
-        return fn(*args)
+        out = fn(*args)
+        # Feed the autotuner (HOROVOD_AUTOTUNE=1): one throughput sample
+        # per steps_per_sample invocations drives the GP/EI proposal loop
+        # (reference: parameter_manager.cc fed from the runtime, not by
+        # user code).
+        _autotune_record(args)
+        return out
 
     return call

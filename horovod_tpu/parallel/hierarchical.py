@@ -1,0 +1,110 @@
+"""Hierarchical (multi-slice) allreduce: ICI reduce-scatter → DCN
+allreduce → ICI all-gather.
+
+Reference parity: `NCCLHierarchicalAllreduce`
+(horovod/common/ops/nccl_operations.cc, SURVEY.md §2.2): NCCL
+ReduceScatter intra-node → MPI allreduce across nodes → NCCL Allgather,
+selected by HOROVOD_HIERARCHICAL_ALLREDUCE.  TPU pods have exactly the
+same two-tier topology — ICI within a slice (fast, torus), DCN between
+slices (slow, ethernet) — so the same algorithm applies: each element
+crosses DCN only once per 1/ici_size shard instead of riding a flat
+ring over the slowest link.
+
+In-jit only (the compiled SPMD world where two mesh axes exist); the
+eager single-axis API keeps using the flat compiled programs.  Selected
+automatically by `hvd.allreduce(x, axis_name=("dcn", "hvd"))` when
+HOROVOD_HIERARCHICAL_ALLREDUCE=1, or explicitly via
+`hierarchical_allreduce`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import util
+from ..common.exceptions import HorovodTpuError
+
+
+def enabled() -> bool:
+    """Env switch, reference name kept (HOROVOD_HIERARCHICAL_ALLREDUCE)."""
+    return util.env_bool("HIERARCHICAL_ALLREDUCE", False)
+
+
+def hierarchical_reduce_leaf(x, dcn_axis: str, ici_axis: str, average: bool):
+    """One leaf: flatten → psum_scatter(ICI) → psum(DCN) → all_gather(ICI).
+
+    Padding makes any size divisible by the ICI axis; the pad rides the
+    collectives as zeros and is sliced off before reshaping back.
+    """
+    n_ici = lax.axis_size(ici_axis)
+    n_dcn = lax.axis_size(dcn_axis)
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n_ici
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    s = lax.psum_scatter(flat, ici_axis, tiled=True)   # 1/n_ici shard, ICI sum
+    s = lax.psum(s, dcn_axis)                          # cross-slice, DCN
+    g = lax.all_gather(s, ici_axis, tiled=True)        # reassemble over ICI
+    if pad:
+        g = g[: x.size]
+    out = g.reshape(x.shape)
+    if average:
+        out = (out.astype(jnp.float32) / (n_ici * n_dcn)).astype(x.dtype)
+    return out
+
+
+def hierarchical_allreduce(
+    tree: Any,
+    dcn_axis: str = "dcn",
+    ici_axis: Optional[str] = None,
+    average: bool = True,
+):
+    """Hierarchical allreduce of a pytree (gradients), fused: all leaves
+    of one dtype are concatenated into a single flat buffer so the three
+    collectives run once per dtype, not once per tensor (the fusion-buffer
+    behavior of the reference, in-graph)."""
+    from ..common.basics import GLOBAL_AXIS
+
+    ici_axis = ici_axis or GLOBAL_AXIS
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    out = [None] * len(leaves)
+    by_dtype = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    for dt, idxs in by_dtype.items():
+        flats = [jnp.ravel(leaves[i]) for i in idxs]
+        sizes = [f.size for f in flats]
+        buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        red = hierarchical_reduce_leaf(buf, dcn_axis, ici_axis, average)
+        off = 0
+        for i, sz in zip(idxs, sizes):
+            out[i] = red[off: off + sz].reshape(jnp.shape(leaves[i]))
+            off += sz
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def maybe_hierarchical(x, axes, op_name: str):
+    """Dispatch hook for `hvd.allreduce` inside jit: a 2-name axis tuple
+    plus the env flag routes Average/Sum through the hierarchical path.
+    Returns None when the flat path should run instead."""
+    if not (isinstance(axes, (tuple, list)) and len(axes) == 2):
+        return None
+    if not enabled() or op_name not in ("Average", "Sum"):
+        return None
+    dcn_axis, ici_axis = axes
+    return hierarchical_reduce_leaf(
+        x, dcn_axis, ici_axis, average=(op_name == "Average"))
+
+
+__all__ = [
+    "enabled",
+    "hierarchical_allreduce",
+    "hierarchical_reduce_leaf",
+    "maybe_hierarchical",
+]

@@ -7,14 +7,18 @@ XLA lays onto the ICI torus, so tensor/pipeline/sequence/expert
 parallelism compose with the Horovod-style DP API.
 
 Axis conventions (order = mesh axis order, outermost first):
-    dp  — data parallel (gradient psum; maps to DCN across slices)
+    dcn — cross-slice data parallel (rides DCN between pod slices; the
+          TPU analog of the reference's cross-node tier in
+          NCCLHierarchicalAllreduce, ops/nccl_operations.cc)
+    dp  — data parallel within a slice (gradient psum over ICI)
     pp  — pipeline stages (ppermute ring)
     ep  — expert parallel (all_to_all token dispatch)
     tp  — tensor parallel (allreduce/reduce-scatter of activations)
     sp  — sequence/context parallel (ring attention ppermute / Ulysses
           all_to_all)
 
-tp innermost so its latency-critical collectives ride the shortest ICI
+dcn outermost so slice-local axes stay contiguous on the ICI torus; tp
+innermost so its latency-critical collectives ride the shortest ICI
 hops — the layout the scaling-book recipe prescribes.
 """
 
@@ -30,11 +34,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common.exceptions import HorovodTpuError
 
-AXIS_ORDER = ("dp", "pp", "ep", "tp", "sp")
+AXIS_ORDER = ("dcn", "dp", "pp", "ep", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
+    dcn: int = 1
     dp: int = 1
     pp: int = 1
     ep: int = 1
@@ -54,6 +59,7 @@ def create_hybrid_mesh(
     ep: int = 1,
     tp: int = 1,
     sp: int = 1,
+    dcn: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Build a named mesh with the requested parallelism degrees.
@@ -61,10 +67,15 @@ def create_hybrid_mesh(
     Axis sizes must multiply to the device count.  `dp=-1` (or any single
     -1 axis) absorbs the remaining devices, e.g.
     `create_hybrid_mesh(dp=-1, tp=4)` on 32 chips → dp=8, tp=4.
+
+    `dcn > 1` declares a multi-slice job: the outermost axis crosses pod
+    slices over DCN.  On real multi-slice hardware pass devices in
+    slice-major order (jax.devices() already is); gradient reduction
+    should then use the hierarchical path (parallel/hierarchical.py).
     """
     devs = list(devices) if devices is not None else list(jax.devices())
     n = len(devs)
-    sizes = {"dp": dp, "pp": pp, "ep": ep, "tp": tp, "sp": sp}
+    sizes = {"dcn": dcn, "dp": dp, "pp": pp, "ep": ep, "tp": tp, "sp": sp}
     wild = [a for a, s in sizes.items() if s == -1]
     if len(wild) > 1:
         raise HorovodTpuError("at most one mesh axis may be -1")
@@ -87,10 +98,36 @@ def mesh_axis_size(mesh: Mesh, axis: str) -> int:
 
 
 def batch_spec(mesh: Mesh) -> P:
-    """PartitionSpec for a [batch, ...] input: batch over dp (and ep when
-    experts ride the data axis)."""
-    axes = [a for a in ("dp", "ep") if mesh_axis_size(mesh, a) > 1]
+    """PartitionSpec for a [batch, ...] input: batch over dcn and dp (and
+    ep when experts ride the data axis)."""
+    axes = [a for a in ("dcn", "dp", "ep") if mesh_axis_size(mesh, a) > 1]
     return P(tuple(axes) if axes else None)
+
+
+def create_hierarchical_mesh(
+    dcn: int,
+    ici: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Two-tier data-parallel mesh ("dcn", "hvd"): `dcn` slices over DCN,
+    `ici` chips per slice over ICI.  The inner axis keeps the global
+    `hvd` name so the whole Horovod-style DP API works per slice.
+
+    Reference: the communicator split MPIContext::Initialize builds
+    (global / local / cross) that NCCLHierarchicalAllreduce runs on.
+    """
+    from ..common.basics import GLOBAL_AXIS
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if n % dcn:
+        raise HorovodTpuError(
+            f"{n} devices not divisible into {dcn} slices")
+    ici = ici or n // dcn
+    if dcn * ici != n:
+        raise HorovodTpuError(
+            f"dcn={dcn} x ici={ici} != {n} devices")
+    return Mesh(np.asarray(devs).reshape(dcn, ici), ("dcn", GLOBAL_AXIS))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -91,9 +91,52 @@ def allreduce_(tensor: "torch.Tensor", **kw) -> "torch.Tensor":
     return tensor
 
 
-def allreduce_async(tensor, op=Average, name=None) -> int:
-    return HandleManager.global_instance().allocate(
-        allreduce(tensor, op=op, name=name))
+# ---------------------------------------------------------------------------
+# True-async API: the handle holds the un-materialized jax.Array (JAX
+# dispatch is async — the collective runs on device while Python
+# continues); torch conversion happens only at synchronize().  Reference:
+# mpi_ops_v2.cc DoAllreduce returns before the background thread executes;
+# handle_manager.cc resolves on completion.
+# ---------------------------------------------------------------------------
+
+# handle -> (template torch tensor, in_place flag)
+_async_meta = {}
+
+
+def _async_dispatch(arr, like: "torch.Tensor", inplace: bool) -> int:
+    h = HandleManager.global_instance().allocate(arr)
+    _async_meta[h] = (like, inplace)
+    return h
+
+
+def allreduce_async(tensor, op=Average, name=None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    arr = C.allreduce(_to_np(tensor), op=op, name=name,
+                      process_set=process_set)
+    return _async_dispatch(arr, tensor, inplace=False)
+
+
+def allreduce_async_(tensor, op=Average, name=None,
+                     process_set: Optional[ProcessSet] = None) -> int:
+    arr = C.allreduce(_to_np(tensor), op=op, name=name,
+                      process_set=process_set)
+    return _async_dispatch(arr, tensor, inplace=True)
+
+
+def allgather_async(tensor, name=None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    arr = C.allgather(_to_np(tensor), name=name, process_set=process_set)
+    return _async_dispatch(arr, tensor, inplace=False)
+
+
+def broadcast_async(tensor, root_rank: int = 0, name=None) -> int:
+    arr = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name)
+    return _async_dispatch(arr, tensor, inplace=False)
+
+
+def broadcast_async_(tensor, root_rank: int = 0, name=None) -> int:
+    arr = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name)
+    return _async_dispatch(arr, tensor, inplace=True)
 
 
 def allgather(tensor: "torch.Tensor", name: Optional[str] = None,
@@ -127,7 +170,19 @@ def grouped_allreduce(tensors, op=Average, name=None):
 
 
 def synchronize(handle: int):
-    return _synchronize_handle(handle)
+    """Block until the handle's collective completes; return the result
+    as a torch tensor (in-place variants copy into and return the
+    original tensor)."""
+    out = _synchronize_handle(handle)
+    meta = _async_meta.pop(handle, None)
+    if meta is None:
+        return out
+    like, inplace = meta
+    t = _to_torch(out, like)
+    if inplace:
+        like.copy_(t)
+        return like
+    return t
 
 
 # ---------------------------------------------------------------------------
@@ -177,6 +232,13 @@ class _DistributedOptimizer:
     (post-accumulate-grad hooks, torch>=2.1) so communication starts
     during backward; `backward_passes_per_step` accumulates locally and
     reduces every Nth pass.
+
+    Fusion: hook-path gradients are packed into size-capped buckets
+    (HOROVOD_FUSION_THRESHOLD, live-autotuned) and dispatched as ONE
+    grouped allreduce per bucket — the torch analog of the reference's
+    fusion buffer (fusion_buffer_manager.cc + torch/optimizer.py).  The
+    dispatched jax programs run while backward continues; results are
+    materialized into `p.grad` only at synchronize().
     """
 
     def __init__(self, optimizer: "torch.optim.Optimizer",
@@ -199,7 +261,13 @@ class _DistributedOptimizer:
             raise ValueError("Duplicate parameter names "
                              "(reference: duplicated-name error)")
         self._hooks = []
-        self._pending = {}
+        # Fusion-bucket state (reset each step).
+        self._bucket: list = []
+        self._bucket_bytes = 0
+        # (handle, params, ctxs) per dispatched bucket.
+        self._in_flight: list = []
+        self._reduced_ids: set = set()
+        self.total_flushes = 0  # observable: fused buckets dispatched
         if hasattr(torch.Tensor, "register_post_accumulate_grad_hook"):
             for p in self._params:
                 if p.requires_grad:
@@ -208,18 +276,46 @@ class _DistributedOptimizer:
         self._synchronized = False
 
     # -- hook path -------------------------------------------------------
+    def _enqueue(self, p: "torch.Tensor") -> None:
+        """Add a gradient to the current fusion bucket exactly once per
+        step; overflow dispatches the bucket."""
+        if id(p) in self._reduced_ids:
+            return
+        self._reduced_ids.add(id(p))
+        self._bucket.append(p)
+        self._bucket_bytes += p.grad.numel() * p.grad.element_size()
+        from ..utils.autotune import current_fusion_threshold
+        if self._bucket_bytes >= current_fusion_threshold():
+            self._flush()
+
     def _hook(self, p: "torch.Tensor") -> None:
         if self._pass_count % self._bpps != self._bpps - 1:
             return
-        name = self._names.get(id(p), f"param.{id(p)}")
-        self._pending[id(p)] = allreduce_async(
-            p.grad, op=self._op, name=f"allreduce.{name}.grad")
+        self._enqueue(p)
+
+    def _flush(self) -> None:
+        """Dispatch the current bucket as one grouped (fused) allreduce."""
+        if not self._bucket:
+            return
+        params, self._bucket, self._bucket_bytes = self._bucket, [], 0
+        compressed, ctxs = [], []
+        for p in params:
+            c, ctx = self._compression.compress(_to_np(p.grad))
+            compressed.append(c)
+            ctxs.append(ctx)
+        outs = C.grouped_allreduce(compressed, op=self._op)
+        h = HandleManager.global_instance().allocate(outs)
+        self._in_flight.append((h, params, ctxs))
+        self.total_flushes += 1
 
     def synchronize(self) -> None:
-        for p in self._params:
-            h = self._pending.pop(id(p), None)
-            if h is not None:
-                p.grad.copy_(synchronize(h))
+        self._flush()
+        for h, params, ctxs in self._in_flight:
+            outs = _synchronize_handle(h)
+            for p, o, ctx in zip(params, outs, ctxs):
+                p.grad.copy_(_to_torch(self._compression.decompress(o, ctx),
+                                       p.grad))
+        self._in_flight = []
         self._synchronized = True
 
     # -- optimizer protocol ---------------------------------------------
@@ -229,12 +325,14 @@ class _DistributedOptimizer:
             return None  # accumulation pass: no sync, no step
         if not self._synchronized:
             # Hooks may be unavailable (old torch) or grads produced
-            # outside autograd — reduce everything now.
+            # outside autograd — reduce the stragglers now (_enqueue
+            # dedups against grads already bucketed by hooks).
             for p in self._params:
-                if p.grad is not None and id(p) not in self._pending:
-                    allreduce_(p.grad, op=self._op)
+                if p.grad is not None:
+                    self._enqueue(p)
             self.synchronize()
         self._synchronized = False
+        self._reduced_ids = set()
         if self._bpps > 1:
             for p in self._params:
                 if p.grad is not None:

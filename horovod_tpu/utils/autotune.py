@@ -339,3 +339,12 @@ def tuned_fusion_threshold(default: int) -> int:
     if _manager is not None and "fusion_threshold" in _manager._tunables:
         return int(_manager.value("fusion_threshold"))
     return default
+
+
+def current_fusion_threshold() -> int:
+    """The live fusion threshold: HOROVOD_FUSION_THRESHOLD (64 MB
+    reference default), overridden by the autotuner when active.  The
+    single source of truth for every bucketing path (JAX gradient trees,
+    torch hook buckets)."""
+    return tuned_fusion_threshold(
+        util.env_int("FUSION_THRESHOLD", 64 * 1024 * 1024))

@@ -21,6 +21,7 @@ the two-tier policy of the reference.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -30,6 +31,74 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..common import util
 
 logger = logging.getLogger("horovod_tpu.stall_inspector")
+
+
+class KvRankReporter:
+    """Per-rank progress publishing over the control-plane KV.
+
+    The reference's stall inspector names the ranks that have NOT
+    submitted a stalled tensor (stall_inspector.cc
+    CheckForStalledTensors: "missing ranks").  Under SPMD the analog is
+    the per-rank eager-collective sequence number: every rank publishes
+    (seq, timestamp) from its watchdog; a stalled rank compares peers'
+    seq against its own — a peer with a lower seq has not entered the
+    collective this rank is blocked in, and a stale timestamp means the
+    peer is dead.
+    """
+
+    _NS = "stall/rank/"
+
+    def __init__(self, client, rank: int):
+        self._client = client
+        self._rank = rank
+
+    @classmethod
+    def from_env(cls) -> Optional["KvRankReporter"]:
+        if "HOROVOD_RENDEZVOUS_ADDR" not in os.environ:
+            return None
+        try:
+            from ..common import basics
+            from ..runner.elastic_worker import client_from_env
+
+            if not basics.is_initialized() or basics.num_processes() <= 1:
+                return None
+            return cls(client_from_env(), basics.rank())
+        except Exception:  # noqa: BLE001 — reporting is best-effort
+            logger.debug("stall KV reporter unavailable", exc_info=True)
+            return None
+
+    def publish(self, seq: int) -> None:
+        try:
+            self._client.put(
+                f"{self._NS}{self._rank}",
+                json.dumps({"seq": seq, "ts": time.time()}))
+        except Exception:  # noqa: BLE001
+            logger.debug("stall publish failed", exc_info=True)
+
+    def laggards(self, my_seq: int, stale_after: float) -> List[str]:
+        """Ranks behind this rank's op sequence, or with stale
+        heartbeats ('rank N (no heartbeat for Xs)')."""
+        out: List[str] = []
+        try:
+            now = time.time()
+            for key in self._client.keys(self._NS):
+                r = int(key.rsplit("/", 1)[1])
+                if r == self._rank:
+                    continue
+                raw = self._client.get(key)
+                if raw is None:
+                    continue
+                info = json.loads(raw)
+                age = now - float(info.get("ts", 0))
+                if age > stale_after:
+                    out.append(f"rank {r} (no heartbeat for {age:.0f}s)")
+                elif int(info.get("seq", 0)) < my_seq:
+                    out.append(
+                        f"rank {r} (at op {info.get('seq', 0)}, "
+                        f"this rank at {my_seq})")
+        except Exception:  # noqa: BLE001
+            logger.debug("stall laggard query failed", exc_info=True)
+        return out
 
 
 class StallInspector:
@@ -42,12 +111,14 @@ class StallInspector:
         check_interval_seconds: float = 1.0,
         warn_fn: Optional[Callable[[str], None]] = None,
         abort_fn: Optional[Callable[[str], None]] = None,
+        reporter: Optional[KvRankReporter] = None,
     ):
         self.warn_time = warn_time_seconds
         self.shutdown_time = shutdown_time_seconds
         self.check_interval = check_interval_seconds
         self._warn_fn = warn_fn or (lambda msg: logger.warning(msg))
         self._abort_fn = abort_fn or self._default_abort
+        self._reporter = reporter
         self._lock = threading.Lock()
         # op key -> (description, start wall time, result-or-None).
         # A None result means the op is closed explicitly by record_end;
@@ -134,10 +205,18 @@ class StallInspector:
             if age >= self.warn_time and key not in self._warned:
                 self._warned.add(key)
                 warned_now.append(desc)
+                blame = ""
+                if self._reporter is not None:
+                    with self._lock:
+                        my_seq = self._next_key
+                    lag = self._reporter.laggards(
+                        my_seq, stale_after=max(self.warn_time, 5.0))
+                    if lag:
+                        blame = f" Ranks behind: {', '.join(lag)}."
                 self._warn_fn(
                     f"One or more collectives stalled for {age:.0f}s: "
                     f"[{desc}]. A rank may be lagging, dead, or running a "
-                    f"different program. Ranks pending: see launcher logs."
+                    f"different program.{blame}"
                 )
             if worst is None or age > worst[1]:
                 worst = (desc, age)
@@ -165,6 +244,10 @@ class StallInspector:
 
     def _run(self) -> None:
         while not self._stop.wait(self.check_interval):
+            if self._reporter is not None:
+                with self._lock:
+                    seq = self._next_key
+                self._reporter.publish(seq)
             self.check()
 
     def stop(self) -> None:
@@ -190,7 +273,9 @@ def init_from_env() -> Optional[StallInspector]:
     warn = util.env_float("STALL_CHECK_TIME_SECONDS", 60.0)
     shutdown = util.env_float("STALL_SHUTDOWN_TIME_SECONDS", 0.0)
     _inspector = StallInspector(
-        warn_time_seconds=warn, shutdown_time_seconds=shutdown
+        warn_time_seconds=warn, shutdown_time_seconds=shutdown,
+        check_interval_seconds=min(1.0, max(0.1, warn / 4.0)),
+        reporter=KvRankReporter.from_env(),
     )
     _inspector.start()
     return _inspector

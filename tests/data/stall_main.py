@@ -1,0 +1,46 @@
+"""Worker main for the stall-inspector rank-naming test.
+
+Rank 0 sleeps before the second collective; rank 1 blocks in it.  Rank
+1's stall inspector (warn threshold lowered via env) must name rank 0 as
+the laggard — the reference's "missing ranks" diagnostic
+(stall_inspector.cc CheckForStalledTensors) rebuilt on the control-plane
+KV heartbeats.
+"""
+
+import logging
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    logging.basicConfig(level=logging.WARNING, stream=sys.stderr)
+    hvd.init()
+    rank = hvd.rank()
+
+    out1 = np.asarray(hvd.allreduce(jnp.ones((4,)), name="step1"))
+    assert out1[0] == 1.0
+
+    if rank == 0:
+        time.sleep(float(os.environ.get("STALL_TEST_SLEEP", "8")))
+
+    out2 = np.asarray(hvd.allreduce(jnp.full((4,), 2.0), name="step2"))
+    assert out2[0] == 2.0
+    print(f"rank {rank} done", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -110,3 +110,65 @@ class TestParameterManager:
         assert at.tuned_fusion_threshold(1) == 64 << 20
         at.shutdown_manager()
         assert at.tuned_fusion_threshold(7) == 7
+
+
+class TestAutotuneWiredIntoTrainingPath:
+    """HOROVOD_AUTOTUNE=1 must tune the money path with no user code:
+    the step callable returned by `data_parallel` feeds `record_step`
+    per invocation, and a new fusion-threshold proposal retraces the
+    step with a different bucket count (reference: parameter_manager.cc
+    is fed from the runtime and re-tunes the live job)."""
+
+    def test_autotune_changes_bucket_count_mid_run(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+        from horovod_tpu.ops import collectives as C
+        from horovod_tpu.utils import autotune as at
+
+        # Tight loop: 1 warmup sample, 1 step per sample.
+        monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "1")
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "1")
+        # Start with a tiny threshold so the initial trace has many
+        # buckets; proposals range over [1MB, 256MB] -> 1 bucket.
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "8")
+        at.shutdown_manager()
+        assert at.init_from_env() is not None
+        try:
+            bucket_counts = []
+            real_grouped = C.grouped_allreduce
+
+            def counting_grouped(tensors, **kw):
+                # Called once per bucket at trace time.
+                bucket_counts.append(len(tensors))
+                return real_grouped(tensors, **kw)
+
+            monkeypatch.setattr(C, "grouped_allreduce", counting_grouped)
+
+            params = {f"w{i}": jnp.ones((4,)) for i in range(6)}
+
+            def step(params, batch):
+                def loss_fn(p):
+                    return sum(jnp.sum(w * batch[0]) for w in p.values())
+
+                grads = jax.grad(loss_fn)(params)
+                grads = hvd.allreduce_gradients(grads)
+                return jax.tree_util.tree_map(
+                    lambda w, g: w - 0.1 * g, params, grads), jnp.zeros(())
+
+            compiled = hvd.data_parallel(
+                step, batch_args=(1,), donate_args=())
+            batch = hvd.shard_batch((jnp.ones((8, 4)),))
+            traces_seen = set()
+            for _ in range(12):
+                params, _ = compiled(params, batch)
+                traces_seen.add(len(bucket_counts))
+            # The tuner proposed new thresholds -> the step retraced with
+            # a different number of fused buckets at least once.
+            assert len(bucket_counts) > 1, "step never retraced"
+            assert len(set(bucket_counts)) > 1, (
+                f"bucket count never changed: {bucket_counts}")
+        finally:
+            at.shutdown_manager()

@@ -104,3 +104,32 @@ class TestJoinMultiprocess:
         # only its own 2 elements, recv splits [0, 2].
         assert res[1]["a2av"] == [2.0, 3.0]
         assert res[1]["a2av_splits"] == [0, 2]
+
+
+STALL_WORKER = os.path.join(REPO_ROOT, "tests", "data", "stall_main.py")
+
+
+@pytest.mark.integration
+class TestStallInspectorNamesRanks:
+    """Reference: stall_inspector.cc reports which ranks have NOT
+    submitted a stalled tensor.  Rank 0 lags 8s before the second
+    collective; rank 1's inspector (warn=2s) must warn AND name rank 0
+    via the control-plane heartbeats; the job then completes normally."""
+
+    def test_lagging_rank_is_named(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "2"
+        env["STALL_TEST_SLEEP"] = "8"
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             "python", STALL_WORKER],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=REPO_ROOT)
+        out = r.stdout + r.stderr
+        assert r.returncode == 0, f"launch failed:\n{out}"
+        assert "rank 0 done" in out and "rank 1 done" in out
+        assert "stalled" in out, out
+        assert "Ranks behind: rank 0" in out, out

@@ -45,9 +45,15 @@ class TestTorchOps:
         torch.testing.assert_close(out, t)
 
     def test_async_handle(self):
+        import time
+
         t = torch.ones(3)
         h = hvd_torch.allreduce_async(t, op=hvd_torch.Sum)
-        assert hvd_torch.poll(h)
+        # poll() must eventually report completion without synchronize().
+        deadline = time.time() + 30
+        while not hvd_torch.poll(h):
+            assert time.time() < deadline, "collective never completed"
+            time.sleep(0.01)
         out = hvd_torch.synchronize(h)
         np.testing.assert_allclose(np.asarray(out),
                                    np.full(3, hvd_torch.size()))
@@ -156,3 +162,139 @@ class TestCallbacks:
         assert cb.lr(1) == 1.0
         assert cb.lr(3) == pytest.approx(0.1)
         assert cb.lr(10) == pytest.approx(0.01)
+
+
+class TestTrueAsync:
+    """The async API must not materialize results at dispatch time
+    (reference: handle_manager.cc — the handle resolves only when the
+    background collective completes; here the un-materialized jax.Array
+    is the in-flight state)."""
+
+    def test_handle_holds_unmaterialized_jax_array(self):
+        import jax
+
+        t = torch.ones(8)
+        h = hvd_torch.allreduce_async(t, op=hvd_torch.Sum)
+        raw = hvd_torch.HandleManager.global_instance()._results[h]
+        assert isinstance(raw, jax.Array)  # not a torch tensor yet
+        out = hvd_torch.synchronize(h)
+        assert isinstance(out, torch.Tensor)
+        torch.testing.assert_close(out, t * hvd_torch.size())
+
+    def test_poll_can_be_false_before_completion(self):
+        # A large enough reduction is still in flight when dispatch
+        # returns (JAX async dispatch); poll() must report that instead
+        # of blocking.
+        t = torch.randn(4 * 1024 * 1024)
+        observed_false = False
+        handles = []
+        for _ in range(4):
+            h = hvd_torch.allreduce_async(t)
+            if not hvd_torch.poll(h):
+                observed_false = True
+            handles.append(h)
+        for h in handles:
+            hvd_torch.synchronize(h)
+        assert observed_false, (
+            "poll() was True immediately after every async dispatch — "
+            "the API is completing synchronously")
+
+    def test_allreduce_async_inplace(self):
+        t = torch.ones(6)
+        h = hvd_torch.allreduce_async_(t, op=hvd_torch.Sum)
+        out = hvd_torch.synchronize(h)
+        assert out is t
+        torch.testing.assert_close(t, torch.full((6,), float(hvd_torch.size())))
+
+    def test_broadcast_async(self):
+        t = torch.full((3,), 7.0)
+        h = hvd_torch.broadcast_async(t, root_rank=0)
+        torch.testing.assert_close(hvd_torch.synchronize(h), t)
+
+
+class TestHookFusion:
+    """Hook-path gradients must be bucketed into fused grouped
+    allreduces capped by HOROVOD_FUSION_THRESHOLD (reference: fusion
+    buffer + torch/optimizer.py per-param hooks feeding it)."""
+
+    def _run_steps(self, threshold, steps=2):
+        import os
+
+        old = os.environ.get("HOROVOD_FUSION_THRESHOLD")
+        os.environ["HOROVOD_FUSION_THRESHOLD"] = str(threshold)
+        try:
+            torch.manual_seed(0)
+            model = torch.nn.Sequential(
+                torch.nn.Linear(8, 16), torch.nn.ReLU(),
+                torch.nn.Linear(16, 1))  # 4 params
+            opt = hvd_torch.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.01),
+                named_parameters=model.named_parameters())
+            x = torch.randn(4, 8)
+            for _ in range(steps):
+                opt.zero_grad()
+                torch.nn.functional.mse_loss(
+                    model(x), x.sum(1, keepdim=True)).backward()
+                opt.step()
+            return opt
+        finally:
+            if old is None:
+                os.environ.pop("HOROVOD_FUSION_THRESHOLD", None)
+            else:
+                os.environ["HOROVOD_FUSION_THRESHOLD"] = old
+
+    def test_large_threshold_single_bucket_per_step(self):
+        opt = self._run_steps(64 * 1024 * 1024, steps=3)
+        # All 4 params fit one bucket -> exactly 1 fused dispatch/step.
+        assert opt.total_flushes == 3, opt.total_flushes
+
+    def test_tiny_threshold_more_buckets(self):
+        opt = self._run_steps(4, steps=1)  # every grad overflows a bucket
+        assert opt.total_flushes == 4, opt.total_flushes
+
+    def test_fp16_compression_trains(self):
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 1)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(),
+            compression=hvd_torch.Compression.fp16)
+        x = torch.randn(16, 4)
+        y = x.sum(1, keepdim=True)
+        first = last = None
+        for _ in range(10):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            first = float(loss.detach()) if first is None else first
+            last = float(loss.detach())
+        assert last < first
+
+    def test_each_grad_reduced_exactly_once_per_step(self, monkeypatch):
+        # Regression: the step() straggler sweep must not re-enqueue
+        # grads already sitting in an un-flushed hook bucket.
+        import horovod_tpu.torch as ht
+
+        counts = []
+        real = ht.C.grouped_allreduce
+
+        def counting(tensors, **kw):
+            counts.append(len(tensors))
+            return real(tensors, **kw)
+
+        monkeypatch.setattr(ht.C, "grouped_allreduce", counting)
+        torch.manual_seed(0)
+        model = torch.nn.Sequential(
+            torch.nn.Linear(8, 16), torch.nn.ReLU(),
+            torch.nn.Linear(16, 1))  # 4 params
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.01),
+            named_parameters=model.named_parameters())
+        x = torch.randn(4, 8)
+        for _ in range(2):
+            opt.zero_grad()
+            torch.nn.functional.mse_loss(
+                model(x), x.sum(1, keepdim=True)).backward()
+            opt.step()
+        assert sum(counts) == 8, (counts, "expected 4 grads x 2 steps")
