@@ -89,11 +89,17 @@ def build_step(opt, cfg, distributed: bool):
         x, y = batch
 
         def loss_fn(p):
+            # Batch-norm stats are LOCAL per worker — reference parity:
+            # Horovod's benchmark models use plain BatchNorm; cross-rank
+            # SyncBatchNormalization is opt-in (sync_batch_norm.py).
+            # Syncing here costs ~2 tiny collectives per BN layer per
+            # pass and is what sank scaling_eff_sim8 to 0.85 in r02 (see
+            # docs/PERF_NOTES.md).
             logits, ns = resnet_apply(
                 {"params": p, "batch_stats": state["batch_stats"],
                  "config": cfg},
                 x, train=True, compute_dtype=jnp.bfloat16,
-                axis_name=hvd.GLOBAL_AXIS if distributed else None)
+                axis_name=None)
             onehot = jax.nn.one_hot(y, logits.shape[-1])
             loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
             return loss, ns
@@ -102,6 +108,11 @@ def build_step(opt, cfg, distributed: bool):
             loss_fn, has_aux=True)(state["params"])
         if distributed:
             grads = hvd.allreduce(grads)
+            # Stats computed per-shard must be re-replicated before the
+            # step returns them under out_specs=P(): ONE fused pmean of
+            # the whole batch_stats tree (vs r02's 2 collectives per BN
+            # layer at apply time — see docs/PERF_NOTES.md).
+            ns = hvd.allreduce(ns)
         updates, new_opt = opt.update(grads, opt_state, state["params"])
         new_params = optax.apply_updates(state["params"], updates)
         return {"params": new_params, "batch_stats": ns}, new_opt, loss
@@ -135,9 +146,14 @@ def time_steps(compiled, state, opt_state, batch, warmup, iters):
 # Simulated scaling efficiency child (ResNet-18 on an n-device CPU mesh)
 # ---------------------------------------------------------------------------
 
-def run_sim_child(n_devices: int) -> None:
+def run_sim_child(n_devices: int, distributed: bool = True) -> None:
     """Child mode: per-chip img/sec of the framework DP step on an
-    n-device virtual CPU mesh.  Prints one JSON line."""
+    n-device virtual CPU mesh.  Prints one JSON line.
+
+    distributed=False runs the identical compute WITHOUT the gradient
+    allreduce — the compute-only baseline that isolates per-step
+    collective time (reference: the timeline's NEGOTIATE/NCCL phases vs
+    compute)."""
     from horovod_tpu.common.util import force_cpu_platform
     force_cpu_platform(n_devices)
     import jax
@@ -159,11 +175,32 @@ def run_sim_child(n_devices: int) -> None:
                           jnp.float32)
     y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 100)
 
-    step = hvd.data_parallel(build_step(opt, v["config"], distributed=True))
+    step = hvd.data_parallel(
+        build_step(opt, v["config"], distributed=distributed))
     sb = hvd.shard_batch((x, y))
     t, _, _ = time_steps(step, state, opt_state, sb, warmup=2, iters=6)
     print(json.dumps({"n": n_devices, "step_time_s": t,
                       "per_chip_img_sec": batch / t / n_devices}))
+
+
+def _run_sim(n: int, distributed: bool, timeout: float):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--sim-child", str(n)]
+    if not distributed:
+        cmd.append("--no-dist")
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        log(f"sim-scaling child n={n} timed out")
+        return None
+    if r.returncode != 0:
+        log(f"sim-scaling child n={n} rc={r.returncode} "
+            f"stderr tail: {r.stderr[-500:]}")
+        return None
+    return json.loads(r.stdout.strip().splitlines()[-1])["step_time_s"]
 
 
 def sim_scaling_efficiency(timeout: float = 600.0):
@@ -174,28 +211,61 @@ def sim_scaling_efficiency(timeout: float = 600.0):
     extra time is collective/framework overhead.  Efficiency is therefore
     8*T1/T8 (clamped to 1.0) — the shared-core analog of per-chip
     throughput retention on real hardware.
+
+    Also reports the per-step collective share: T8(dist) - T8(no dist),
+    the same decomposition the reference's timeline gives per tensor.
     """
-    results = {}
-    for n in (1, 8):
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)
-        try:
-            r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--sim-child",
-                 str(n)],
-                capture_output=True, text=True, timeout=timeout, env=env,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-        except subprocess.TimeoutExpired:
-            log(f"sim-scaling child n={n} timed out")
-            return None
-        if r.returncode != 0:
-            log(f"sim-scaling child n={n} rc={r.returncode} "
-                f"stderr tail: {r.stderr[-500:]}")
-            return None
-        line = r.stdout.strip().splitlines()[-1]
-        results[n] = json.loads(line)["step_time_s"]
-        log(f"sim-scaling n={n}: {results[n]*1e3:.1f} ms/step")
-    return min(1.0, 8.0 * results[1] / results[8])
+    t1 = _run_sim(1, True, timeout)
+    t8 = _run_sim(8, True, timeout)
+    if t1 is None or t8 is None:
+        return None
+    log(f"sim-scaling n=1: {t1*1e3:.1f} ms/step")
+    log(f"sim-scaling n=8: {t8*1e3:.1f} ms/step")
+    t8_nodist = _run_sim(8, False, timeout)
+    if t8_nodist is not None:
+        log(f"sim-scaling n=8 compute-only: {t8_nodist*1e3:.1f} ms/step "
+            f"-> collective share {(t8 - t8_nodist)*1e3:.1f} ms/step "
+            f"({100 * (t8 - t8_nodist) / t8:.1f}%)")
+    return min(1.0, 8.0 * t1 / t8)
+
+
+# ---------------------------------------------------------------------------
+# Keras-path measurement (BASELINE config 3: TF2 Keras DistributedOptimizer)
+# ---------------------------------------------------------------------------
+
+def run_keras_bench() -> float:
+    """img/sec of the Keras frontend path: a small convnet trained
+    through hvd.tensorflow.keras.DistributedOptimizer (TF executes on
+    host CPU; the collective rides the XLA core).  Measures the bridge
+    overhead the TF/Keras shim adds per step."""
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow.keras as hvd_k
+
+    tf.random.set_seed(0)
+    batch = 64
+    x = np.random.randn(batch, 28, 28, 1).astype("float32")
+    y = np.random.randint(0, 10, (batch,))
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(16, 3, activation="relu",
+                               input_shape=(28, 28, 1)),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Conv2D(32, 3, activation="relu"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = hvd_k.DistributedOptimizer(tf.keras.optimizers.SGD(0.01))
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+    model.compile(optimizer=opt, loss=loss_fn)
+    warmup, iters = 2, 8
+    for _ in range(warmup):
+        model.train_on_batch(x, y)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        model.train_on_batch(x, y)
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
 
 
 # ---------------------------------------------------------------------------
@@ -216,9 +286,13 @@ def run_bench(platform: str) -> dict:
     hvd.init()
     actual = jax.devices()[0].platform
     on_tpu = actual == "tpu"
-    # Reference benchmark: batch 64 per worker @ 224x224 (docs/benchmarks.rst
-    # / pytorch_synthetic_benchmark.py default batch-size=32; tf_cnn uses 64).
-    batch = 64 if on_tpu else 4
+    # Reference benchmark: 224x224 synthetic images (docs/benchmarks.rst /
+    # pytorch_synthetic_benchmark.py).  The reference's batch 64 is a
+    # GPU-era choice; the v5e MXU wants larger batches (sweep in
+    # docs/PERF_NOTES.md: 64→2131, 128→2398, 256→2416 img/s/chip), so
+    # the TPU default is 256.  HOROVOD_BENCH_BATCH overrides.
+    batch = int(os.environ.get("HOROVOD_BENCH_BATCH", 0)) or \
+        (256 if on_tpu else 4)
     image = 224 if on_tpu else 64
     warmup, iters = (5, 20) if on_tpu else (2, 3)
     log(f"platform={actual} devices={len(jax.devices())} "
@@ -255,17 +329,30 @@ def run_bench(platform: str) -> dict:
     raw_imgsec = batch / t_raw
     log(f"raw jax:   {t_raw*1e3:.1f} ms/step, {raw_imgsec:.1f} img/s/chip")
 
-    return {
+    # --- Keras frontend path (BASELINE config 3) ---
+    keras_img_sec = None
+    try:
+        keras_img_sec = run_keras_bench()
+        log(f"keras_img_sec: {keras_img_sec:.1f} img/s "
+            f"(TF-on-CPU frontend through DistributedOptimizer)")
+    except Exception as e:  # noqa: BLE001 — keras path must not sink bench
+        log(f"keras bench failed: {type(e).__name__}: {e}")
+
+    out = {
         "metric": "resnet50_synthetic_img_sec_per_chip",
         "value": round(fw_imgsec, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(fw_imgsec / raw_imgsec, 4),
     }
+    if keras_img_sec is not None:
+        out["keras_img_sec"] = round(keras_img_sec, 1)
+    return out
 
 
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--sim-child":
-        run_sim_child(int(sys.argv[2]))
+        run_sim_child(int(sys.argv[2]),
+                      distributed="--no-dist" not in sys.argv)
         return
 
     result = None

@@ -125,5 +125,9 @@ def autotune_record_step(items: float = 1.0) -> None:
     if mgr is not None:
         mgr.record_step(items)
 
+from .parallel.hierarchical import (  # noqa: F401
+    hierarchical_allreduce,
+)
+
 from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401

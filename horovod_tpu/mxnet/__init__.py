@@ -1,0 +1,274 @@
+"""`horovod_tpu.mxnet` — MXNet frontend shim over the XLA collective
+core.
+
+Reference parity: `import horovod.mxnet as hvd` (horovod/mxnet/
+__init__.py, mpi_ops.py, mpi_ops.cc ≈1.2k LoC C++).  The reference's
+native plugin pushes async ops into MXNet's dependency engine; here
+NDArrays bridge through numpy into the compiled XLA collective programs
+— the same pattern as the torch shim (torch/__init__.py), so the shim
+is ~an order of magnitude smaller than the reference bridge.
+
+MXNet itself is duck-typed: anything with `.asnumpy()` and slice
+assignment (`arr[:] = value`) works, which is exactly the NDArray
+contract.  The module imports without mxnet installed; only
+`DistributedTrainer` (a gluon subclass) requires the real package.
+
+    import horovod_tpu.mxnet as hvd
+    hvd.init()
+    trainer = hvd.DistributedTrainer(params, "sgd", {"learning_rate": 0.1})
+    hvd.broadcast_parameters(net.collect_params(), root_rank=0)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+# Re-export the core surface (reference: horovod.mxnet re-exports basics).
+from ..common.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    size,
+    rank,
+    local_size,
+    local_rank,
+    cross_size,
+    cross_rank,
+    tpu_built,
+    xla_built,
+    mpi_built,
+    nccl_built,
+    gloo_built,
+    mpi_threads_supported,
+    add_process_set,
+    remove_process_set,
+    ProcessSet,
+)
+from ..common.exceptions import HorovodInternalError  # noqa: F401
+from ..ops import collectives as C
+from ..ops.collectives import Average, Sum, Adasum, barrier, join  # noqa: F401
+from ..ops.compression import Compression  # noqa: F401
+
+try:  # pragma: no cover — mxnet not in the base image
+    import mxnet as mx
+except ImportError:
+    mx = None
+
+
+def _to_np(t: Any) -> np.ndarray:
+    """NDArray (or anything NDArray-shaped) → numpy."""
+    if hasattr(t, "asnumpy"):
+        return t.asnumpy()
+    return np.asarray(t)
+
+
+def _like(t: Any, data) -> Any:
+    """Materialize `data` shaped like the input NDArray."""
+    out = np.asarray(data)
+    if hasattr(t, "asnumpy") and mx is not None:
+        return mx.nd.array(out, dtype=out.dtype)
+    if hasattr(t, "asnumpy"):
+        # Duck-typed NDArray (tests): construct via the input's class.
+        return type(t)(out)
+    return out
+
+
+def _assign_(t: Any, data) -> Any:
+    """In-place write honoring the NDArray slice-assignment contract."""
+    t[:] = np.asarray(data)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Collective ops (reference: horovod/mxnet/mpi_ops.py)
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              priority: int = 0,
+              process_set: Optional[ProcessSet] = None):
+    """`priority` is accepted for API parity; XLA schedules collectives
+    itself, so it is a no-op (reference: MXNet engine priority)."""
+    out = C.allreduce(_to_np(tensor), average=average, name=name,
+                      process_set=process_set)
+    return _like(tensor, out)
+
+
+def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
+               priority: int = 0,
+               process_set: Optional[ProcessSet] = None):
+    out = C.allreduce(_to_np(tensor), average=average, name=name,
+                      process_set=process_set)
+    return _assign_(tensor, out)
+
+
+def grouped_allreduce(tensors, average: bool = True,
+                      name: Optional[str] = None, priority: int = 0):
+    outs = C.grouped_allreduce([_to_np(t) for t in tensors],
+                               average=average)
+    return [_like(t, o) for t, o in zip(tensors, outs)]
+
+
+def grouped_allreduce_(tensors, average: bool = True,
+                       name: Optional[str] = None, priority: int = 0):
+    outs = C.grouped_allreduce([_to_np(t) for t in tensors],
+                               average=average)
+    for t, o in zip(tensors, outs):
+        _assign_(t, o)
+    return tensors
+
+
+def allgather(tensor, name: Optional[str] = None, priority: int = 0,
+              process_set: Optional[ProcessSet] = None):
+    out = C.allgather(_to_np(tensor), name=name, process_set=process_set)
+    return _like(tensor, out)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              priority: int = 0,
+              process_set: Optional[ProcessSet] = None):
+    out = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
+                      process_set=process_set)
+    return _like(tensor, out)
+
+
+def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None,
+               priority: int = 0,
+               process_set: Optional[ProcessSet] = None):
+    out = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
+                      process_set=process_set)
+    return _assign_(tensor, out)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             priority: int = 0,
+             process_set: Optional[ProcessSet] = None):
+    out = C.alltoall(_to_np(tensor), splits=splits, name=name,
+                     process_set=process_set)
+    if isinstance(out, tuple):
+        recv, rsplits = out
+        return _like(tensor, recv), _like(tensor, rsplits)
+    return _like(tensor, out)
+
+
+# ---------------------------------------------------------------------------
+# Parameter broadcast (reference: horovod/mxnet/__init__.py
+# broadcast_parameters)
+# ---------------------------------------------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         prefix: Optional[str] = None) -> None:
+    """In-place broadcast of a parameter dict.
+
+    Accepts a plain dict of NDArrays or a gluon ParameterDict (values
+    with `.list_data()`), mirroring the reference's two accepted forms.
+    """
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+    for name, p in items:
+        if hasattr(p, "list_data"):  # gluon Parameter
+            for arr in p.list_data():
+                broadcast_(arr, root_rank=root_rank, name=str(name))
+        elif p is not None:
+            broadcast_(p, root_rank=root_rank, name=str(name))
+
+
+def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+    from ..ops.functions import broadcast_object as _bo
+    return _bo(obj, root_rank=root_rank)
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer / DistributedTrainer (reference:
+# horovod/mxnet/__init__.py)
+# ---------------------------------------------------------------------------
+
+class DistributedOptimizer:
+    """Wraps an mx.optimizer.Optimizer: gradients are allreduced before
+    each update (reference: DistributedOptimizer.update/update_multi_
+    precision hooks `_do_allreduce` before delegating)."""
+
+    def __init__(self, optimizer, gradient_predivide_factor: float = 1.0,
+                 num_groups: int = 0, process_set: Optional[ProcessSet] = None):
+        self._opt = optimizer
+        self._predivide = gradient_predivide_factor
+        self._process_set = process_set
+
+    def _do_allreduce(self, index, grad) -> None:
+        if size() == 1:
+            return
+        if isinstance(index, (tuple, list)):
+            outs = C.grouped_allreduce(
+                [_to_np(g) / self._predivide for g in grad],
+                average=True, process_set=self._process_set)
+            for g, o in zip(grad, outs):
+                _assign_(g, o)
+        else:
+            out = C.allreduce(_to_np(grad) / self._predivide, average=True,
+                              process_set=self._process_set)
+            _assign_(grad, out)
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        return self._opt.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        return self._opt.update_multi_precision(index, weight, grad, state)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    # Optimizer protocol passthroughs the reference forwards explicitly.
+    def set_learning_rate(self, lr):
+        return self._opt.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        return self._opt.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        return self._opt.set_wd_mult(args_wd_mult)
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None,
+                       compression=Compression.none,
+                       gradient_predivide_factor: float = 1.0):
+    """Gluon trainer whose `_allreduce_grads` averages over ranks
+    (reference: DistributedTrainer(mx.gluon.Trainer)).  Requires the
+    real mxnet package; constructed lazily so the module imports
+    without it."""
+    if mx is None:
+        raise ImportError(
+            "horovod_tpu.mxnet.DistributedTrainer requires mxnet; "
+            "use DistributedOptimizer for the engine-level API")
+
+    class _Trainer(mx.gluon.Trainer):  # pragma: no cover — needs mxnet
+        def __init__(self):
+            # Scale LR down by size like the reference: gradients are
+            # summed by _allreduce_grads and rescaled here.
+            opt_params = dict(optimizer_params or {})
+            super().__init__(params, optimizer, opt_params, kvstore=None)
+            self._update_on_kvstore = False
+
+        def _allreduce_grads(self):
+            if size() == 1:
+                return
+            grads = [p.grad(d) for p in self._params.values()
+                     if p.grad_req != "null" for d in [p.list_ctx()[0]]]
+            grouped_allreduce_(grads, average=True)
+
+    return _Trainer()
+
+
+__all__ = [
+    "init", "shutdown", "size", "rank", "local_size", "local_rank",
+    "cross_size", "cross_rank",
+    "allreduce", "allreduce_", "grouped_allreduce", "grouped_allreduce_",
+    "allgather", "broadcast", "broadcast_", "alltoall",
+    "broadcast_parameters", "broadcast_object",
+    "DistributedOptimizer", "DistributedTrainer",
+    "Average", "Sum", "Adasum", "Compression", "barrier", "join",
+]

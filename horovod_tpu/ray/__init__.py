@@ -1,0 +1,172 @@
+"""`horovod_tpu.ray` — Ray-cluster adapter (reference: horovod/ray/
+runner.py `RayExecutor`, elastic.py `ElasticRayExecutor`).
+
+The heavy lifting (persistent pool, per-rank env, KV command loop) lives
+in `horovod_tpu.runner.executor`; this module adapts the same API onto
+Ray actors when `ray` is installed.  Without Ray, `RayExecutor`
+constructs but delegates to the process-pool `Executor` on localhost —
+the degenerate single-node cluster — so the API surface is usable (and
+testable) everywhere.
+
+    from horovod_tpu.ray import RayExecutor
+    ex = RayExecutor(num_workers=4)
+    ex.start()
+    ex.run(train_fn)
+    ex.shutdown()
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common.exceptions import HorovodTpuError
+from ..runner.executor import ElasticExecutor, Executor
+
+try:
+    import ray as _ray
+except ImportError:  # pragma: no cover — ray not in the base image
+    _ray = None
+
+
+def ray_available() -> bool:
+    return _ray is not None
+
+
+def assign_ranks(worker_hostnames: List[str]) -> List[Dict[str, int]]:
+    """Horovod env ranks for actors grouped by host (reference:
+    horovod/ray/utils.py map_blocking + runner.py's rank bookkeeping).
+
+    Actors on the same host get consecutive local ranks; hosts are
+    ordered by first appearance so rank 0 lands on the first host.
+    """
+    size = len(worker_hostnames)
+    host_order: List[str] = []
+    for h in worker_hostnames:
+        if h not in host_order:
+            host_order.append(h)
+    local_counts: Dict[str, int] = {h: 0 for h in host_order}
+    out: List[Dict[str, int]] = []
+    for rank, h in enumerate(worker_hostnames):
+        out.append({
+            "HOROVOD_RANK": rank,
+            "HOROVOD_SIZE": size,
+            "HOROVOD_LOCAL_RANK": local_counts[h],
+            "HOROVOD_CROSS_RANK": host_order.index(h),
+            "HOROVOD_CROSS_SIZE": len(host_order),
+        })
+        local_counts[h] += 1
+    for env in out:
+        env["HOROVOD_LOCAL_SIZE"] = local_counts[
+            worker_hostnames[env["HOROVOD_RANK"]]]
+    return out
+
+
+class RayExecutor:
+    """Reference-shaped executor: Ray actors when available, the local
+    process pool otherwise."""
+
+    def __init__(self, settings: Any = None, num_workers: int = 1,
+                 cpus_per_worker: int = 1, use_gpu: bool = False,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self._num_workers = num_workers
+        self._cpus = cpus_per_worker
+        self._extra_env = dict(extra_env or {})
+        self._workers: List[Any] = []
+        self._local: Optional[Executor] = None
+        if use_gpu:
+            raise HorovodTpuError(
+                "use_gpu is not applicable on the TPU build "
+                "(reference flag kept for API parity)")
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        if _ray is None:
+            self._local = Executor(np=self._num_workers,
+                                   extra_env=self._extra_env)
+            self._local.start()
+            return
+        if not _ray.is_initialized():
+            _ray.init(ignore_reinit_error=True)
+
+        @_ray.remote(num_cpus=self._cpus)
+        class _Worker:  # pragma: no cover — requires a ray runtime
+            def hostname(self):
+                return socket.gethostname()
+
+            def set_env(self, env):
+                os.environ.update({k: str(v) for k, v in env.items()})
+                return True
+
+            def exec_fn(self, fn, args, kwargs):
+                return fn(*args, **kwargs)
+
+        self._workers = [_Worker.remote() for _ in range(self._num_workers)]
+        hostnames = _ray.get([w.hostname.remote() for w in self._workers])
+        envs = assign_ranks(hostnames)
+        coordinator = f"{hostnames[0]}:46327"
+        for w, env in zip(self._workers, envs):
+            env = {**env, **self._extra_env,
+                   "HOROVOD_NUM_PROCESSES": env["HOROVOD_SIZE"],
+                   "HOROVOD_PROCESS_ID": env["HOROVOD_RANK"],
+                   "HOROVOD_COORDINATOR_ADDR": coordinator}
+            _ray.get(w.set_env.remote(env))
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[dict] = None) -> List[Any]:
+        if self._local is not None:
+            return self._local.run(fn, args, kwargs)
+        if not self._workers:
+            raise HorovodTpuError("RayExecutor not started")
+        return _ray.get([
+            w.exec_fn.remote(fn, args, kwargs or {})
+            for w in self._workers])
+
+    # Reference aliases.
+    def execute(self, fn: Callable) -> List[Any]:
+        return self.run(fn)
+
+    def run_remote(self, fn: Callable, args: tuple = (),
+                   kwargs: Optional[dict] = None):
+        if self._local is not None:
+            return self._local.run_remote(fn, args, kwargs)
+        return [w.exec_fn.remote(fn, args, kwargs or {})
+                for w in self._workers]
+
+    def get(self, token):
+        if self._local is not None:
+            return self._local.get(token)
+        return _ray.get(token)
+
+    def shutdown(self) -> None:
+        if self._local is not None:
+            self._local.shutdown()
+            self._local = None
+            return
+        for w in self._workers:
+            _ray.kill(w)
+        self._workers = []
+
+
+class ElasticRayExecutor:
+    """Reference-shaped elastic executor; without Ray it delegates to the
+    discovery-script-driven `ElasticExecutor` (same semantics the
+    reference implements with Ray-actor discovery)."""
+
+    def __init__(self, discovery_script: str, min_np: int = 1,
+                 max_np: Optional[int] = None, slots: int = 1):
+        if _ray is not None:  # pragma: no cover
+            raise HorovodTpuError(
+                "Ray-native elastic execution is not implemented; use "
+                "ElasticExecutor with a host discovery script")
+        self._inner = ElasticExecutor(
+            discovery_script, min_np=min_np, max_np=max_np, slots=slots)
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[dict] = None) -> List[Any]:
+        return self._inner.run(fn, args, kwargs)
+
+
+__all__ = ["RayExecutor", "ElasticRayExecutor", "assign_ranks",
+           "ray_available"]

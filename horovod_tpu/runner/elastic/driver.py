@@ -128,10 +128,10 @@ class ElasticDriver:
         # rendezvous would point new remote workers at themselves.
         if (not self._all_local(slots)
                 and self.settings.rendezvous_addr in (None, "127.0.0.1")):
-            self.settings.rendezvous_addr = _my_addr(slots)
+            self.settings.rendezvous_addr = _my_addr(slots, self.settings.nics)
         rank0 = slots[0]
         if _is_local(rank0.hostname):
-            coord = (f"{'127.0.0.1' if self._all_local(slots) else _my_addr(slots)}"
+            coord = (f"{'127.0.0.1' if self._all_local(slots) else _my_addr(slots, self.settings.nics)}"
                      f":{_free_port()}")
         else:
             coord = f"{rank0.hostname}:{self._coordinator_port()}"
@@ -211,7 +211,7 @@ class ElasticDriver:
 
     # -- main loop -------------------------------------------------------
 
-    def run(self) -> int:
+    def run(self, result_hook=None) -> int:
         # Ensure workers are torn down even when the driver is SIGTERMed
         # (tests and schedulers kill the driver; workers live in their own
         # process groups and would otherwise leak).
@@ -233,13 +233,18 @@ class ElasticDriver:
         # Multi-host: advertise a routable rendezvous address.
         if any(not _is_local(h) for h in hosts):
             slots_probe = self._compute_assignments(hosts)
-            self.settings.rendezvous_addr = _my_addr(slots_probe)
+            self.settings.rendezvous_addr = _my_addr(slots_probe, self.settings.nics)
         self._active_hosts = hosts
         self._publish_generation(self._compute_assignments(hosts))
         self._spawn_missing_workers()
 
         try:
-            return self._monitor_loop()
+            rc = self._monitor_loop()
+            if rc == 0 and result_hook is not None:
+                # Same contract as exec_run's result_hook: pull worker
+                # results off the KV store before the server stops.
+                result_hook(self.server)
+            return rc
         finally:
             safe_exec.terminate_trees([
                 h.pid for h, _, _ in self.workers.values()
@@ -323,11 +328,11 @@ class ElasticDriver:
             time.sleep(0.2)
 
 
-def elastic_run(settings: Settings) -> int:
+def elastic_run(settings: Settings, result_hook=None) -> int:
     """Entry from launch.py for `--host-discovery-script` runs."""
     if not settings.host_discovery_script:
         raise HorovodTpuError("elastic runs require --host-discovery-script")
     discovery = HostDiscoveryScript(
         settings.host_discovery_script,
         default_slots=settings.slots_per_host or 1)
-    return ElasticDriver(settings, discovery).run()
+    return ElasticDriver(settings, discovery).run(result_hook)

@@ -136,7 +136,8 @@ def exec_run(settings: Settings, slots: List[SlotInfo],
     worker results out of the KV store."""
     server = RendezvousServer(verbose=settings.verbose)
     port = server.start()
-    settings.rendezvous_addr = settings.rendezvous_addr or _my_addr(slots)
+    settings.rendezvous_addr = settings.rendezvous_addr or _my_addr(
+        slots, settings.nics)
     settings.rendezvous_port = port
 
     # The jax.distributed coordinator is bound by the rank-0 *worker*, so
@@ -145,7 +146,8 @@ def exec_run(settings: Settings, slots: List[SlotInfo],
     # well-known port (overridable via --coordinator-port / Settings).
     all_local = all(_is_local(s.hostname) for s in slots)
     if _is_local(slots[0].hostname):
-        coord_host = "127.0.0.1" if all_local else _my_addr(slots)
+        coord_host = ("127.0.0.1" if all_local and not settings.nics
+                      else _my_addr(slots, settings.nics))
         coord_port = settings.coordinator_port or _free_port()
     else:
         coord_host = slots[0].hostname
@@ -207,18 +209,21 @@ def exec_run(settings: Settings, slots: List[SlotInfo],
         server.stop()
 
 
-def _my_addr(slots: List[SlotInfo]) -> str:
-    """Address workers use to reach the launcher's rendezvous server."""
+def _my_addr(slots: List[SlotInfo], nics: Optional[str] = None) -> str:
+    """Address workers use to reach the launcher's rendezvous server.
+
+    `nics` (--network-interfaces) pins the advertised interface; see
+    runner/network.py (reference: driver_service NIC selection).
+    """
+    from . import network
+
+    if nics:
+        return network.resolve_advertise_address(nics)
     if all(_is_local(s.hostname) for s in slots):
         return "127.0.0.1"
     # Multi-host: pick the interface routing toward the first remote host.
     remote = next(s.hostname for s in slots if not _is_local(s.hostname))
-    try:
-        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
-            s.connect((remote, 1))
-            return s.getsockname()[0]
-    except OSError:
-        return socket.gethostbyname(socket.gethostname())
+    return network.resolve_advertise_address(None, remote)
 
 
 def _free_port() -> int:

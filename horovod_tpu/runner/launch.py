@@ -190,6 +190,17 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                 raise HorovodTpuError(
                     f"elastic launcher unavailable: {e}") from e
             return elastic_run(settings)
+        # Inside an LSF batch job with no explicit hosts, the scheduler's
+        # allocation IS the host list (reference: launch.py auto-detects
+        # LSF and routes through js_run).
+        from . import lsf
+        if settings.hosts is None and lsf.in_lsf_job():
+            settings.hosts = lsf.lsf_hosts()
+            if not args.np:
+                settings.num_proc = sum(h.slots for h in settings.hosts)
+                args.np = settings.num_proc
+            if lsf.jsrun_available():
+                return lsf.js_run(settings)
         if not args.np:
             print("Error: -np is required for static runs", file=sys.stderr)
             return 2

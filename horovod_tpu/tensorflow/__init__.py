@@ -434,3 +434,43 @@ def DistributedOptimizer(optimizer, op=Average,
         optimizer, op=op, compression=compression,
         backward_passes_per_step=backward_passes_per_step,
         process_set=process_set)
+
+
+def SyncBatchNormalization(*args, process_set: Optional[ProcessSet] = None,
+                           **kwargs):
+    """Batch normalization with cross-rank statistics (reference:
+    horovod/tensorflow/sync_batch_norm.py `SyncBatchNormalization`).
+
+    Overrides Keras BN's `_moments`: local moments are combined across
+    ranks (mean of means; variance via E[x^2]-E[x]^2), assuming equal
+    per-rank batch sizes like the reference.
+    """
+    import tensorflow as tf
+
+    class _SyncBatchNormalization(tf.keras.layers.BatchNormalization):
+        def __init__(self, *a, **kw):
+            if kw.pop("synchronized", False):
+                pass  # our sync replaces keras's own
+            super().__init__(*a, **kw)
+            self._hvd_process_set = process_set
+
+        def _moments(self, inputs, mask):
+            mean, var = super()._moments(inputs, mask)
+            n = (self._hvd_process_set.size()
+                 if self._hvd_process_set else size())
+            if n == 1:
+                return mean, var
+            sq = var + tf.square(mean)
+            group_mean, group_sq = grouped_allreduce(
+                [mean, sq], op=Average,
+                process_set=self._hvd_process_set)
+            # The numpy bridge is non-differentiable; straight-through
+            # keeps the LOCAL moment gradient path (global value, local
+            # gradient — same construction as the torch shim, combined
+            # with gradient averaging this matches the reference up to
+            # rank-identical loss terms).
+            group_mean = mean + tf.stop_gradient(group_mean - mean)
+            group_sq = sq + tf.stop_gradient(group_sq - sq)
+            return group_mean, group_sq - tf.square(group_mean)
+
+    return _SyncBatchNormalization(*args, **kwargs)

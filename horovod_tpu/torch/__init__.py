@@ -354,3 +354,56 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         optimizer, named_parameters=named_parameters,
         compression=compression,
         backward_passes_per_step=backward_passes_per_step, op=op)
+
+
+class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
+    """Batch normalization with cross-rank statistics (reference:
+    horovod/torch/sync_batch_norm.py `SyncBatchNorm`).
+
+    Training-mode statistics are the global batch's (combined across
+    ranks, equal per-rank batch sizes assumed).  Gradients flow through
+    the LOCAL moment contributions (straight-through on the cross-rank
+    correction); combined with DistributedOptimizer's gradient
+    averaging this matches the reference's synced gradient up to
+    rank-identical loss terms — the reference's custom autograd kernel
+    does the exact cross-rank backward, which a CPU-bridge shim cannot.
+    """
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D)")
+
+    def forward(self, input: "torch.Tensor") -> "torch.Tensor":
+        if not self.training or size() == 1:
+            return super().forward(input)
+        self._check_input_dim(input)
+        dims = [0] + list(range(2, input.dim()))
+        local_mean = input.mean(dims)
+        local_sq = (input * input).mean(dims)
+        gm, gsq = grouped_allreduce(
+            [local_mean.detach(), local_sq.detach()], op=Average)
+        # Straight-through: global value, local gradient path.
+        mean = local_mean + (gm - local_mean.detach())
+        var = (local_sq + (gsq - local_sq.detach())) - mean * mean
+        if self.track_running_stats and self.running_mean is not None:
+            n = input.numel() // input.size(1) * size()
+            unbiased = var.detach() * n / max(n - 1, 1)
+            if self.num_batches_tracked is not None:
+                self.num_batches_tracked.add_(1)
+            if self.momentum is None:
+                # torch contract: momentum=None means cumulative moving
+                # average (matches _BatchNorm.forward's
+                # exponential_average_factor handling).
+                m = 1.0 / float(self.num_batches_tracked)
+            else:
+                m = self.momentum
+            self.running_mean.mul_(1 - m).add_(mean.detach(), alpha=m)
+            self.running_var.mul_(1 - m).add_(unbiased, alpha=m)
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        out = (input - mean.reshape(shape)) / torch.sqrt(
+            var.reshape(shape) + self.eps)
+        if self.affine:
+            out = out * self.weight.reshape(shape) + \
+                self.bias.reshape(shape)
+        return out

@@ -51,6 +51,24 @@ def main():
     out = hvd.allgather(jnp.full((1, 2), float(rank)))
     results["allgather"] = np.asarray(out).tolist()
 
+    # alltoall: rank r receives chunk r from every sender s (= value s).
+    out = hvd.alltoall(jnp.full((n,), float(rank)))
+    results["alltoall"] = np.asarray(out).tolist()
+
+    # reducescatter: each rank receives its row of the summed tensor.
+    out = hvd.reducescatter(jnp.full((2 * n,), float(rank + 1)), op=hvd.Sum)
+    results["reducescatter"] = np.asarray(out).tolist()
+
+    # Concurrent process sets across processes (reference: process_set.cc
+    # — collectives on disjoint subsets run concurrently): evens and odds
+    # each reduce within their own set.
+    evens = hvd.add_process_set(list(range(0, n, 2)))
+    odds = hvd.add_process_set(list(range(1, n, 2)))
+    mine = evens if rank % 2 == 0 else odds
+    out = hvd.allreduce(jnp.array([float(rank + 1)]), op=hvd.Sum,
+                        process_set=mine)
+    results["ps_sum"] = np.asarray(out).tolist()
+
     out_dir = os.environ["HVD_TEST_OUT"]
     with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
         json.dump(results, f)

@@ -1,0 +1,79 @@
+"""One fake Spark barrier task (reference test pattern: Spark tests run
+against a local fake cluster, SURVEY.md §4).
+
+Implements the BarrierTaskContext surface over the rendezvous KV (the
+barrier) and drives the real `make_barrier_mapper` + a collective
+workload, then posts the mapper's result to the KV for the test.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from horovod_tpu.runner.rendezvous import RendezvousClient  # noqa: E402
+from horovod_tpu.spark import make_barrier_mapper  # noqa: E402
+
+
+class FakeTaskInfo:
+    def __init__(self, address):
+        self.address = address
+
+
+class FakeBarrierTaskContext:
+    def __init__(self, rank, size, client):
+        self._rank = rank
+        self._size = size
+        self._client = client
+
+    def partitionId(self):  # noqa: N802 — pyspark API name
+        return self._rank
+
+    def getTaskInfos(self):  # noqa: N802
+        return [FakeTaskInfo("127.0.0.1:0") for _ in range(self._size)]
+
+    def barrier(self):
+        self._client.barrier("spark_stage", self._size, timeout=60)
+
+
+def workload(scale):
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    out = hvd.allreduce(
+        np.full((2,), float(hvd.rank() + 1) * scale), average=False)
+    return [float(v) for v in np.asarray(out)]
+
+
+def main():
+    rank = int(sys.argv[1])
+    size = int(sys.argv[2])
+    addr = os.environ["TEST_RDV_ADDR"]
+    port = int(os.environ["TEST_RDV_PORT"])
+    secret = os.environ["TEST_RDV_SECRET"]
+    client = RendezvousClient(addr, port, secret)
+
+    import base64
+    import pickle
+    payload = base64.b64encode(
+        pickle.dumps((workload, (10.0,), {}))).decode()
+    # Distinct coordinator port per test run (the module default may be
+    # occupied by a previous test's TIME_WAIT socket).
+    import horovod_tpu.spark as hs
+    hs.COORDINATOR_PORT = int(os.environ["TEST_COORD_PORT"])
+    mapper = make_barrier_mapper(payload, addr, port, secret)
+    ctx = FakeBarrierTaskContext(rank, size, client)
+    results = list(mapper(rank, iter([]), ctx=ctx))
+    (out_rank, data) = results[0]
+    client.put(f"spark/result/{out_rank}", data)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Programmatic executor tests (reference: test/single/test_ray.py's
+RayExecutor semantics — persistent pool, repeated run(), per-rank
+results, failure surfacing — on localhost processes).
+"""
+
+import os
+
+import pytest
+
+from horovod_tpu.runner.executor import Executor
+from horovod_tpu.common.exceptions import HorovodTpuError
+
+
+def fn_rank():
+    return int(os.environ["HOROVOD_RANK"])
+
+
+def fn_add(a, b=0):
+    return a + b + int(os.environ["HOROVOD_RANK"])
+
+
+def fn_fail():
+    raise RuntimeError("boom from worker")
+
+
+def fn_collective():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    out = hvd.allreduce(np.full((2,), float(hvd.rank() + 1)), average=False)
+    return [float(v) for v in np.asarray(out)]
+
+
+@pytest.fixture()
+def clean_env(monkeypatch):
+    # Workers must see one CPU device each, not the sim's 8.
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+
+@pytest.mark.integration
+class TestExecutor:
+    def test_pool_reuse_and_rank_results(self, clean_env):
+        with Executor(np=2) as ex:
+            assert ex.run(fn_rank) == [0, 1]
+            # Same pool, second dispatch — no relaunch.
+            assert ex.run(fn_add, args=(10,), kwargs={"b": 5}) == [15, 16]
+            assert ex.run(fn_rank) == [0, 1]
+
+    def test_worker_exception_surfaces_and_pool_survives(self, clean_env):
+        with Executor(np=2) as ex:
+            with pytest.raises(HorovodTpuError, match="boom from worker"):
+                ex.run(fn_fail)
+            # The pool stays alive after a failed command (reference:
+            # actors survive task exceptions).
+            assert ex.run(fn_rank) == [0, 1]
+
+    def test_run_remote_then_get(self, clean_env):
+        with Executor(np=2) as ex:
+            t1 = ex.run_remote(fn_rank)
+            t2 = ex.run_remote(fn_add, args=(1,))
+            assert ex.get(t2) == [1, 2]
+            assert ex.get(t1) == [0, 1]
+
+    def test_cross_process_collective_through_pool(self, clean_env):
+        with Executor(np=2) as ex:
+            out = ex.run(fn_collective, timeout=240)
+        # sum of (1,2) over 2 ranks = 3 on both.
+        assert out == [[3.0, 3.0], [3.0, 3.0]]
+
+    def test_not_started_raises(self):
+        ex = Executor(np=2)
+        with pytest.raises(HorovodTpuError, match="not started"):
+            ex.run(fn_rank)
+
+
+class TestRayAdapter:
+    def test_assign_ranks_groups_by_host(self):
+        from horovod_tpu.ray import assign_ranks
+
+        envs = assign_ranks(["a", "b", "a", "b"])
+        assert [e["HOROVOD_RANK"] for e in envs] == [0, 1, 2, 3]
+        assert [e["HOROVOD_LOCAL_RANK"] for e in envs] == [0, 0, 1, 1]
+        assert [e["HOROVOD_CROSS_RANK"] for e in envs] == [0, 1, 0, 1]
+        assert all(e["HOROVOD_LOCAL_SIZE"] == 2 for e in envs)
+        assert all(e["HOROVOD_CROSS_SIZE"] == 2 for e in envs)
+
+    @pytest.mark.integration
+    def test_ray_executor_falls_back_to_local_pool(self, monkeypatch):
+        from horovod_tpu.ray import RayExecutor, ray_available
+
+        if ray_available():  # pragma: no cover — ray not in base image
+            pytest.skip("ray installed; fallback path not in use")
+        monkeypatch.delenv("XLA_FLAGS", raising=False)
+        ex = RayExecutor(num_workers=2)
+        ex.start()
+        try:
+            assert ex.run(fn_rank) == [0, 1]
+        finally:
+            ex.shutdown()
+
+
+def fn_elastic_rank():
+    return int(os.environ["HOROVOD_RANK"])
+
+
+@pytest.mark.integration
+class TestElasticExecutor:
+    def test_run_returns_results(self, tmp_path, clean_env):
+        from horovod_tpu.runner.executor import ElasticExecutor
+
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text("localhost:2\n")
+        script = tmp_path / "discover.sh"
+        script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+        script.chmod(0o755)
+
+        ex = ElasticExecutor(str(script), min_np=2, slots=2)
+        results = ex.run(fn_elastic_rank)
+        assert sorted(results) == [0, 1]

@@ -55,6 +55,43 @@ class TestCrossProcessCollectives:
             assert res["broadcast"] == [100.0]
             # concat in rank order
             assert res["allgather"] == [[0.0, 0.0], [1.0, 1.0]]
+            # rank r's received chunk from sender s = s
+            assert res["alltoall"] == [0.0, 1.0]
+            # summed tensor rows, one per rank
+            assert res["reducescatter"] == [3.0, 3.0]
+        # Singleton process sets at np=2: each rank reduces alone.
+        assert results[0]["ps_sum"] == [1.0]
+        assert results[1]["ps_sum"] == [2.0]
+
+    def test_four_process_collectives(self, tmp_path):
+        """np=4 (reference floor is 2 processes; SURVEY §4 says go
+        beyond): mesh order, every collective, and process-set subsets
+        that span non-adjacent processes."""
+        n = 4
+        r = _launch(n, tmp_path, timeout=420)
+        assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+        results = {}
+        for rank in range(n):
+            path = tmp_path / f"rank{rank}.json"
+            assert path.exists(), \
+                f"rank {rank} wrote no result:\n{r.stdout}\n{r.stderr}"
+            results[rank] = json.loads(path.read_text())
+        total = sum(range(1, n + 1))  # 10
+        for rank, res in results.items():
+            assert res["size"] == n
+            assert res["allreduce_sum"] == [1.0 * total, 2.0 * total]
+            avg = sum(range(n)) / n
+            assert res["allreduce_avg"] == [avg] * 3
+            assert res["broadcast"] == [100.0]
+            assert res["allgather"] == [[float(s)] * 2 for s in range(n)]
+            # mesh/rank order: received chunk s comes from global rank s.
+            assert res["alltoall"] == [float(s) for s in range(n)]
+            assert res["reducescatter"] == [float(total)] * 2
+        # Process sets spanning non-adjacent processes: evens=[0,2] sum
+        # (1+3)=4, odds=[1,3] sum (2+4)=6 — computed concurrently.
+        for rank in range(n):
+            expected = 4.0 if rank % 2 == 0 else 6.0
+            assert results[rank]["ps_sum"] == [expected], results[rank]
 
 
 JOIN_WORKER = os.path.join(REPO_ROOT, "tests", "data", "join_main.py")

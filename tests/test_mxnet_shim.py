@@ -1,0 +1,153 @@
+"""MXNet frontend shim tests (reference: test/parallel/test_mxnet1.py /
+test_mxnet2.py API surface).
+
+MXNet is not in this image; the shim is duck-typed on the NDArray
+contract (`asnumpy()` + slice assignment), so a minimal fake NDArray
+exercises the full bridge — the same collectives the real package would
+drive.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu.mxnet as hvd_mx
+
+N = 8  # sim ranks
+
+
+class FakeNDArray:
+    """The NDArray surface the shim relies on."""
+
+    def __init__(self, data):
+        self._data = np.array(data, copy=True)
+
+    def asnumpy(self):
+        return self._data.copy()
+
+    def __setitem__(self, key, value):
+        self._data[key] = value
+
+    def __truediv__(self, other):
+        return FakeNDArray(self._data / other)
+
+    @property
+    def shape(self):
+        return self._data.shape
+
+
+class TestMxnetOps:
+    def test_allreduce_roundtrip(self):
+        t = FakeNDArray(np.arange(6, dtype=np.float32))
+        out = hvd_mx.allreduce(t)
+        assert isinstance(out, FakeNDArray)
+        np.testing.assert_allclose(out.asnumpy(), t.asnumpy())
+
+    def test_allreduce_sum_inplace(self):
+        t = FakeNDArray(np.ones(4, np.float32))
+        ret = hvd_mx.allreduce_(t, average=False)
+        assert ret is t
+        np.testing.assert_allclose(t.asnumpy(), np.full(4, float(N)))
+
+    def test_grouped_allreduce_inplace(self):
+        ts = [FakeNDArray(np.ones(2, np.float32)),
+              FakeNDArray(np.full(3, 2.0, np.float32))]
+        hvd_mx.grouped_allreduce_(ts, average=True)
+        np.testing.assert_allclose(ts[0].asnumpy(), np.ones(2))
+        np.testing.assert_allclose(ts[1].asnumpy(), np.full(3, 2.0))
+
+    def test_allgather(self):
+        t = FakeNDArray(np.ones((2, 3), np.float32))
+        out = hvd_mx.allgather(t)
+        assert out.asnumpy().shape == (2 * N, 3)
+
+    def test_broadcast(self):
+        t = FakeNDArray(np.full(3, 7.0, np.float32))
+        out = hvd_mx.broadcast(t, root_rank=0)
+        np.testing.assert_allclose(out.asnumpy(), 7.0)
+
+    def test_alltoall(self):
+        t = FakeNDArray(np.arange(N, dtype=np.float32))
+        out = hvd_mx.alltoall(t)
+        assert out.asnumpy().shape == (N,)
+
+    def test_broadcast_parameters_dict(self):
+        params = {"w": FakeNDArray(np.ones(3, np.float32)),
+                  "b": FakeNDArray(np.zeros(2, np.float32))}
+        hvd_mx.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(params["w"].asnumpy(), 1.0)
+
+    def test_broadcast_parameters_rejects_non_dict(self):
+        with pytest.raises(ValueError, match="invalid params"):
+            hvd_mx.broadcast_parameters([1, 2, 3])
+
+    def test_broadcast_object(self):
+        assert hvd_mx.broadcast_object({"epoch": 2}) == {"epoch": 2}
+
+
+class FakeOptimizer:
+    """mx.optimizer.Optimizer surface used by DistributedOptimizer."""
+
+    def __init__(self):
+        self.updates = []
+        self.learning_rate = 0.1
+
+    def update(self, index, weight, grad, state):
+        self.updates.append(("update", index))
+        if isinstance(index, (list, tuple)):  # mxnet's multi-index form
+            for w, g in zip(weight, grad):
+                w[:] = w.asnumpy() - self.learning_rate * g.asnumpy()
+            return
+        weight[:] = weight.asnumpy() - self.learning_rate * grad.asnumpy()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.updates.append(("ump", index))
+        weight[:] = weight.asnumpy() - self.learning_rate * grad.asnumpy()
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = lr
+
+
+class TestMxnetDistributedOptimizer:
+    def test_update_allreduces_then_applies(self):
+        inner = FakeOptimizer()
+        opt = hvd_mx.DistributedOptimizer(inner)
+        w = FakeNDArray(np.ones(3, np.float32))
+        g = FakeNDArray(np.full(3, 2.0, np.float32))
+        opt.update(0, w, g, None)
+        # grad averaged over identical contributions = unchanged; weight
+        # stepped by lr * grad.
+        np.testing.assert_allclose(g.asnumpy(), 2.0)
+        np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.1 * 2.0)
+        assert inner.updates == [("update", 0)]
+
+    def test_grouped_update(self):
+        inner = FakeOptimizer()
+        opt = hvd_mx.DistributedOptimizer(inner)
+        ws = [FakeNDArray(np.ones(2, np.float32)),
+              FakeNDArray(np.ones(2, np.float32))]
+        gs = [FakeNDArray(np.full(2, 1.0, np.float32)),
+              FakeNDArray(np.full(2, 3.0, np.float32))]
+        opt.update([0, 1], ws, gs, [None, None])
+        np.testing.assert_allclose(gs[0].asnumpy(), 1.0)
+        np.testing.assert_allclose(gs[1].asnumpy(), 3.0)
+
+    def test_predivide(self):
+        inner = FakeOptimizer()
+        opt = hvd_mx.DistributedOptimizer(inner,
+                                          gradient_predivide_factor=2.0)
+        w = FakeNDArray(np.zeros(2, np.float32))
+        g = FakeNDArray(np.full(2, 4.0, np.float32))
+        opt.update(0, w, g, None)
+        np.testing.assert_allclose(g.asnumpy(), 2.0)  # 4 / 2, averaged
+
+    def test_passthrough(self):
+        inner = FakeOptimizer()
+        opt = hvd_mx.DistributedOptimizer(inner)
+        opt.set_learning_rate(0.5)
+        assert inner.learning_rate == 0.5
+
+    def test_trainer_requires_mxnet(self):
+        if hvd_mx.mx is not None:  # pragma: no cover
+            pytest.skip("mxnet installed")
+        with pytest.raises(ImportError, match="requires mxnet"):
+            hvd_mx.DistributedTrainer({}, "sgd")

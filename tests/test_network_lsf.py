@@ -1,0 +1,160 @@
+"""NIC discovery + LSF path tests (reference: test_run.py's host/NIC
+parsing and js_run cmdline-construction tests with mocked exec).
+"""
+
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.runner import lsf, network
+from horovod_tpu.runner.lsf_bootstrap import derive_horovod_env
+from horovod_tpu.runner.settings import Settings
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestNetwork:
+    def test_local_interfaces_include_loopback(self):
+        ifaces = network.local_interfaces()
+        assert any(addr == "127.0.0.1" for addr in ifaces.values()), ifaces
+
+    def test_resolve_by_nic_name(self):
+        ifaces = network.local_interfaces()
+        lo = next(n for n, a in ifaces.items() if a == "127.0.0.1")
+        assert network.resolve_advertise_address(lo) == "127.0.0.1"
+        # First existing interface in the list wins.
+        assert network.resolve_advertise_address(
+            f"doesnotexist0,{lo}") == "127.0.0.1"
+
+    def test_resolve_unknown_nic_raises(self):
+        with pytest.raises(HorovodTpuError, match="none of"):
+            network.resolve_advertise_address("definitely-not-a-nic0")
+
+    def test_common_interfaces_intersection(self):
+        per_host = {
+            "a": {"eth0": "10.0.0.1", "ib0": "192.168.0.1", "lo": "127.0.0.1"},
+            "b": {"eth0": "10.0.0.2", "lo": "127.0.0.1"},
+        }
+        assert network.common_interfaces(per_host) == ["eth0"]
+        assert network.common_interfaces(per_host, exclude_loopback=False) \
+            == ["eth0", "lo"]
+
+    def test_probe_remote_interfaces_mocked_ssh(self):
+        def fake_run(cmd, **kw):
+            assert cmd[0] == "ssh" and "hostX" in cmd
+            return types.SimpleNamespace(
+                returncode=0, stdout='{"eth0": "10.0.0.5"}\n', stderr="")
+
+        out = network.probe_remote_interfaces("hostX", runner=fake_run)
+        assert out == {"eth0": "10.0.0.5"}
+
+    def test_probe_remote_failure_raises(self):
+        def fake_run(cmd, **kw):
+            return types.SimpleNamespace(returncode=255, stdout="",
+                                         stderr="ssh: no route")
+
+        with pytest.raises(HorovodTpuError, match="NIC probe"):
+            network.probe_remote_interfaces("hostX", runner=fake_run)
+
+    @pytest.mark.integration
+    def test_launcher_honors_network_interfaces_flag(self, tmp_path):
+        """--network-interfaces lo must be LIVE: workers rendezvous over
+        127.0.0.1 (the lo address) and the job completes."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["HVD_TEST_OUT"] = str(tmp_path)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        lo = next(n for n, a in network.local_interfaces().items()
+                  if a == "127.0.0.1")
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             "--network-interfaces", lo,
+             "python", os.path.join(REPO_ROOT, "tests", "data",
+                                    "multiproc_main.py")],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=REPO_ROOT)
+        assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+        assert (tmp_path / "rank0.json").exists()
+
+
+class TestLsf:
+    def test_in_lsf_job(self):
+        assert not lsf.in_lsf_job({})
+        assert lsf.in_lsf_job({"LSB_JOBID": "1",
+                               "LSB_HOSTS": "n1 n1 n2 n2"})
+        assert not lsf.in_lsf_job({"LSB_HOSTS": "n1"})  # no job id
+
+    def test_lsf_hosts_mcpu(self):
+        hosts = lsf.lsf_hosts({"LSB_MCPU_HOSTS": "batch5 1 n01 4 n02 4"})
+        assert [(h.hostname, h.slots) for h in hosts] == \
+            [("n01", 4), ("n02", 4)]
+
+    def test_lsf_hosts_plain(self):
+        hosts = lsf.lsf_hosts({"LSB_HOSTS": "batch1 n01 n01 n02"})
+        assert [(h.hostname, h.slots) for h in hosts] == \
+            [("n01", 2), ("n02", 1)]
+
+    def test_lsf_hosts_malformed(self):
+        with pytest.raises(HorovodTpuError, match="malformed"):
+            lsf.lsf_hosts({"LSB_MCPU_HOSTS": "n01 4 n02"})
+        with pytest.raises(HorovodTpuError, match="not inside"):
+            lsf.lsf_hosts({})
+
+    def test_build_jsrun_command(self):
+        s = Settings(num_proc=8, command=["python", "train.py"])
+        cmd = lsf.build_jsrun_command(s, 8)
+        assert cmd[:5] == ["jsrun", "--nrs", "8", "--tasks_per_rs", "1"]
+        assert cmd[-2:] == ["python", "train.py"]
+        assert "horovod_tpu.runner.lsf_bootstrap" in cmd
+
+    def test_js_run_with_mocked_jsrun(self):
+        seen = {}
+
+        def fake_run(cmd, env=None):
+            seen["cmd"] = cmd
+            seen["env"] = env
+            return types.SimpleNamespace(returncode=0)
+
+        s = Settings(num_proc=4, command=["python", "t.py"])
+        rc = lsf.js_run(s, runner=fake_run)
+        assert rc == 0
+        assert seen["cmd"][0] == "jsrun"
+        assert seen["env"]["HOROVOD_SIZE"] == "4"
+        assert "HOROVOD_RENDEZVOUS_PORT" in seen["env"]
+        assert "HOROVOD_SECRET_KEY" in seen["env"]
+
+
+class TestLsfBootstrap:
+    def test_derive_from_ompi(self):
+        env = {
+            "OMPI_COMM_WORLD_RANK": "3",
+            "OMPI_COMM_WORLD_SIZE": "8",
+            "OMPI_COMM_WORLD_LOCAL_RANK": "1",
+            "OMPI_COMM_WORLD_LOCAL_SIZE": "4",
+            "LSB_JOBID": "7",
+            "LSB_MCPU_HOSTS": "n01 4 n02 4",
+        }
+        out = derive_horovod_env(env)
+        assert out["HOROVOD_RANK"] == "3"
+        assert out["HOROVOD_SIZE"] == "8"
+        assert out["HOROVOD_LOCAL_RANK"] == "1"
+        assert out["HOROVOD_LOCAL_SIZE"] == "4"
+        assert out["HOROVOD_COORDINATOR_ADDR"] == "n01:46331"
+
+    def test_derive_prefers_existing_coordinator(self):
+        env = {
+            "PMIX_RANK": "0",
+            "HOROVOD_SIZE": "2",
+            "HOROVOD_COORDINATOR_ADDR": "x:1",
+        }
+        out = derive_horovod_env(env)
+        assert "HOROVOD_COORDINATOR_ADDR" not in out  # left untouched
+
+    def test_derive_requires_rank(self):
+        with pytest.raises(RuntimeError, match="no rank variable"):
+            derive_horovod_env({"OMPI_COMM_WORLD_SIZE": "2"})

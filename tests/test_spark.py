@@ -1,0 +1,178 @@
+"""Spark integration tests (reference: test/single/test_spark.py — run()
+semantics against a local fake cluster; no JVM needed here because the
+barrier-task surface is duck-typed).
+"""
+
+import base64
+import os
+import pickle
+import socket
+import subprocess
+import sys
+
+import pytest
+
+import horovod_tpu.spark as hvd_spark
+from horovod_tpu.common.exceptions import HorovodTpuError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# run() orchestration against an in-process fake pyspark
+# ---------------------------------------------------------------------------
+
+class _FakeTaskInfo:
+    def __init__(self, address):
+        self.address = address
+
+
+class _FakeCtx:
+    def __init__(self, rank, size):
+        self._rank, self._size = rank, size
+
+    def partitionId(self):  # noqa: N802
+        return self._rank
+
+    def getTaskInfos(self):  # noqa: N802
+        return [_FakeTaskInfo("127.0.0.1:0")] * self._size
+
+    def barrier(self):
+        pass  # sequential fake: nothing to synchronize
+
+
+class _FakeRDD:
+    def __init__(self, n):
+        self._n = n
+
+    def barrier(self):
+        return self
+
+    def mapPartitionsWithIndex(self, mapper):  # noqa: N802
+        self._mapper = mapper
+        return self
+
+    def collect(self):
+        rows = []
+        saved = dict(os.environ)
+        try:
+            for r in range(self._n):
+                rows.extend(self._mapper(r, iter([]), ctx=_FakeCtx(r, self._n)))
+        finally:
+            os.environ.clear()
+            os.environ.update(saved)
+        return rows
+
+
+class _FakeConf:
+    def get(self, key, default=None):
+        return "127.0.0.1" if key == "spark.driver.host" else default
+
+
+class _FakeSparkContext:
+    defaultParallelism = 2
+
+    def getConf(self):
+        return _FakeConf()
+
+    def parallelize(self, seq, n):
+        return _FakeRDD(n)
+
+
+def _fn_env_echo(tag):
+    return (tag, os.environ["HOROVOD_RANK"], os.environ["HOROVOD_SIZE"])
+
+
+@pytest.fixture()
+def fake_pyspark(monkeypatch):
+    import types
+
+    mod = types.ModuleType("pyspark")
+    mod.SparkContext = types.SimpleNamespace(
+        _active_spark_context=_FakeSparkContext())
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+    return mod
+
+
+class TestSparkRun:
+    def test_run_returns_results_by_rank(self, fake_pyspark):
+        out = hvd_spark.run(_fn_env_echo, args=("t",), num_proc=3)
+        assert out == [("t", "0", "3"), ("t", "1", "3"), ("t", "2", "3")]
+
+    def test_run_defaults_to_parallelism(self, fake_pyspark):
+        out = hvd_spark.run(_fn_env_echo, args=("d",))
+        assert len(out) == 2  # defaultParallelism
+
+    def test_run_without_pyspark_raises_import_error(self, monkeypatch):
+        monkeypatch.setitem(sys.modules, "pyspark", None)
+        with pytest.raises(ImportError, match="requires pyspark"):
+            hvd_spark.run(_fn_env_echo)
+
+    def test_run_without_context_raises(self, fake_pyspark):
+        fake_pyspark.SparkContext._active_spark_context = None
+        with pytest.raises(HorovodTpuError, match="No active SparkContext"):
+            hvd_spark.run(_fn_env_echo)
+
+    def test_run_elastic_shrinks_on_failure(self, fake_pyspark,
+                                            monkeypatch):
+        calls = []
+
+        def flaky_run(fn, args=(), kwargs=None, num_proc=None, **kw):
+            calls.append(num_proc)
+            if num_proc > 2:
+                raise RuntimeError("stage failed")
+            return ["ok"] * num_proc
+
+        monkeypatch.setattr(hvd_spark, "run", flaky_run)
+        out = hvd_spark.run_elastic(_fn_env_echo, num_proc=4, min_np=2)
+        assert out == ["ok", "ok"]
+        assert calls == [4, 3, 2]
+
+
+# ---------------------------------------------------------------------------
+# Real 2-process barrier stage: collectives through the mapper
+# ---------------------------------------------------------------------------
+
+@pytest.mark.integration
+class TestSparkBarrierCollectives:
+    def test_two_task_barrier_allreduce(self):
+        from horovod_tpu.runner.rendezvous import RendezvousServer
+
+        server = RendezvousServer()
+        port = server.start()
+        with socket.socket() as s:
+            s.bind(("", 0))
+            coord_port = s.getsockname()[1]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "TEST_RDV_ADDR": "127.0.0.1",
+            "TEST_RDV_PORT": str(port),
+            "TEST_RDV_SECRET": server.secret,
+            "TEST_COORD_PORT": str(coord_port),
+        })
+        script = os.path.join(REPO_ROOT, "tests", "data",
+                              "spark_task_main.py")
+        procs = [
+            subprocess.Popen([sys.executable, script, str(r), "2"], env=env)
+            for r in range(2)
+        ]
+        try:
+            for p in procs:
+                assert p.wait(timeout=240) == 0
+            kv = server.kv()
+            results = {}
+            for r in range(2):
+                raw = kv.get(f"spark/result/{r}")
+                assert raw is not None, f"no result from task {r}"
+                results[r] = pickle.loads(base64.b64decode(raw))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            server.stop()
+        # sum over ranks of (rank+1)*10 = 30 on both tasks.
+        assert results[0] == [30.0, 30.0]
+        assert results[1] == [30.0, 30.0]

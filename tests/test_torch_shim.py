@@ -298,3 +298,64 @@ class TestHookFusion:
                 model(x), x.sum(1, keepdim=True)).backward()
             opt.step()
         assert sum(counts) == 8, (counts, "expected 4 grads x 2 steps")
+
+
+class TestSyncBatchNorm:
+    """Reference: horovod/torch/sync_batch_norm.py — training stats are
+    the global batch's.  On the sim every rank sees the same data, so
+    sync stats == local stats; gradient flow and running-stat updates
+    are the testable contracts."""
+
+    def test_matches_local_bn_on_identical_data(self):
+        torch.manual_seed(0)
+        x = torch.randn(8, 4)
+        sbn = hvd_torch.SyncBatchNorm(4)
+        bn = torch.nn.BatchNorm1d(4)
+        torch.testing.assert_close(sbn(x), bn(x), atol=1e-5, rtol=1e-4)
+        torch.testing.assert_close(sbn.running_mean, bn.running_mean,
+                                   atol=1e-5, rtol=1e-4)
+        # Bessel correction uses the GLOBAL batch count (8 ranks x 8 =
+        # 64) like the reference's SyncBatchNorm, so running_var differs
+        # from local BN (n=8) by (64/63)/(8/7).
+        # One update from init 1.0: rv = 0.9*1.0 + 0.1*unbiased_var.
+        n_local, n_global = 8, 8 * hvd_torch.size()
+        expected = (bn.running_var - 0.9) * \
+            (n_global / (n_global - 1)) / (n_local / (n_local - 1)) + 0.9
+        torch.testing.assert_close(sbn.running_var, expected,
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_gradients_flow(self):
+        x = torch.randn(8, 3, requires_grad=True)
+        sbn = hvd_torch.SyncBatchNorm(3)
+        sbn(x).sum().backward()
+        assert x.grad is not None and torch.isfinite(x.grad).all()
+        assert sbn.weight.grad is not None
+
+    def test_eval_mode_uses_running_stats(self):
+        sbn = hvd_torch.SyncBatchNorm(2)
+        sbn(torch.randn(16, 2))  # one training step
+        sbn.eval()
+        out = sbn(torch.zeros(4, 2))
+        assert torch.isfinite(out).all()
+
+    def test_4d_input(self):
+        x = torch.randn(4, 3, 5, 5)
+        out = hvd_torch.SyncBatchNorm(3)(x)
+        assert out.shape == x.shape
+
+    def test_rejects_1d(self):
+        with pytest.raises(ValueError, match="at least 2D"):
+            hvd_torch.SyncBatchNorm(3)(torch.randn(3))
+
+    def test_momentum_none_cumulative_average(self):
+        # torch contract: momentum=None -> cumulative moving average,
+        # same as the np=1 fallthrough path.
+        sbn = hvd_torch.SyncBatchNorm(2, momentum=None)
+        bn = torch.nn.BatchNorm1d(2, momentum=None)
+        torch.manual_seed(0)
+        for _ in range(3):
+            x = torch.randn(16, 2)
+            sbn(x), bn(x)
+        torch.testing.assert_close(sbn.running_mean, bn.running_mean,
+                                   atol=1e-5, rtol=1e-4)
+        assert int(sbn.num_batches_tracked) == 3
