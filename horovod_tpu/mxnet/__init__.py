@@ -200,14 +200,21 @@ class DistributedOptimizer:
     def _do_allreduce(self, index, grad) -> None:
         if size() == 1:
             return
+        # Reference semantics: predivide is scale-NEUTRAL — prescale by
+        # 1/f before the reduction (numerical-range control for low
+        # precision), postscale by f after, so the result is still the
+        # true average (horovod/mxnet/__init__.py _do_allreduce).
+        pre, post = 1.0 / self._predivide, self._predivide
         if isinstance(index, (tuple, list)):
             outs = C.grouped_allreduce(
-                [_to_np(g) / self._predivide for g in grad],
-                average=True, process_set=self._process_set)
+                [_to_np(g) for g in grad], average=True,
+                prescale_factor=pre, postscale_factor=post,
+                process_set=self._process_set)
             for g, o in zip(grad, outs):
                 _assign_(g, o)
         else:
-            out = C.allreduce(_to_np(grad) / self._predivide, average=True,
+            out = C.allreduce(_to_np(grad), average=True,
+                              prescale_factor=pre, postscale_factor=post,
                               process_set=self._process_set)
             _assign_(grad, out)
 

@@ -44,6 +44,11 @@ _EPS = 1e-30
 
 def _pair_combine(a, b):
     """Combine one pair of gradients (computed at f32)."""
+    from . import pallas_kernels as PK
+
+    if PK.pallas_enabled(a.size):
+        return PK.pallas_pair_combine_batched(
+            a[None], b[None])[0].astype(a.dtype)
     af = a.astype(jnp.float32)
     bf = b.astype(jnp.float32)
     dot = jnp.vdot(af.ravel(), bf.ravel())
@@ -53,6 +58,18 @@ def _pair_combine(a, b):
     ca = jnp.where(na > _EPS, 1.0 - dot / (2.0 * jnp.maximum(na, _EPS)), 1.0)
     cb = jnp.where(nb > _EPS, 1.0 - dot / (2.0 * jnp.maximum(nb, _EPS)), 1.0)
     return (ca * af + cb * bf).astype(a.dtype)
+
+
+def _pair_combine_batched(a, b):
+    """(k, *s) pairwise combine — the fused Pallas kernels when on TPU
+    (ops/pallas_kernels.py: one HBM pass for dot/norms, one for the
+    scaled add, reference adasum.h's Dispatch* inner loops), vmapped jnp
+    otherwise."""
+    from . import pallas_kernels as PK
+
+    if PK.pallas_enabled(a[0].size):
+        return PK.pallas_pair_combine_batched(a, b).astype(a.dtype)
+    return jax.vmap(_pair_combine)(a, b)
 
 
 def adasum_tree_reduce(xs):
@@ -65,9 +82,7 @@ def adasum_tree_reduce(xs):
     if n & (n - 1):
         raise HorovodTpuError(f"Adasum requires power-of-two ranks, got {n}")
     while n > 1:
-        a = xs[0::2]
-        b = xs[1::2]
-        xs = jax.vmap(_pair_combine)(a, b)
+        xs = _pair_combine_batched(xs[0::2], xs[1::2])
         n //= 2
     return xs[0]
 

@@ -105,7 +105,8 @@ class RayExecutor:
         self._workers = [_Worker.remote() for _ in range(self._num_workers)]
         hostnames = _ray.get([w.hostname.remote() for w in self._workers])
         envs = assign_ranks(hostnames)
-        coordinator = f"{hostnames[0]}:46327"
+        from ..runner.exec_run import DEFAULT_COORDINATOR_PORT
+        coordinator = f"{hostnames[0]}:{DEFAULT_COORDINATOR_PORT}"
         for w, env in zip(self._workers, envs):
             env = {**env, **self._extra_env,
                    "HOROVOD_NUM_PROCESSES": env["HOROVOD_SIZE"],

@@ -115,12 +115,12 @@ class Executor:
             extra_env=self._extra_env,
             command=[sys.executable, "-c", _WORKER_LOOP],
         )
-        settings.rendezvous_addr = "127.0.0.1" if all(
-            _is_local(s.hostname) for s in slots) else None
         settings.rendezvous_port = port
         all_local = all(_is_local(s.hostname) for s in slots)
-        coord = f"127.0.0.1:{_free_port()}" if all_local else None
-        if coord is None:
+        if all_local:
+            settings.rendezvous_addr = "127.0.0.1"
+            coord = f"127.0.0.1:{_free_port()}"
+        else:
             from .exec_run import DEFAULT_COORDINATOR_PORT, _my_addr
             settings.rendezvous_addr = _my_addr(slots)
             coord = f"{slots[0].hostname}:{DEFAULT_COORDINATOR_PORT}"
@@ -292,7 +292,9 @@ class ElasticExecutor:
 
         def collect(server):
             kv = server.kv()
-            for key in sorted(kv.keys("runfunc/result/")):
+            # Numeric rank order (lexicographic would put 10 before 2).
+            keys = kv.keys("runfunc/result/")
+            for key in sorted(keys, key=lambda k: int(k.rsplit("/", 1)[1])):
                 raw = kv.get(key)
                 if raw is not None:
                     results.append(pickle.loads(base64.b64decode(raw)))

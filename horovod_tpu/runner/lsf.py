@@ -107,13 +107,18 @@ def js_run(settings: Settings, runner=None) -> int:
     np = settings.num_proc
     server = RendezvousServer(verbose=settings.verbose)
     port = server.start()
+    # Route-probe toward the first compute host so the advertised address
+    # is reachable from the tasks (gethostbyname alone returns loopback on
+    # nodes with a "127.0.1.1 <hostname>" /etc/hosts entry).
+    remote = settings.hosts[0].hostname if settings.hosts else None
     env = dict(os.environ)
     env.update({
         "HOROVOD_SIZE": str(np),
         "HOROVOD_NUM_PROCESSES": str(np),
         "HOROVOD_CONTROLLER": "xla",
         "HOROVOD_CPU_OPERATIONS": "xla",
-        "HOROVOD_RENDEZVOUS_ADDR": resolve_advertise_address(settings.nics),
+        "HOROVOD_RENDEZVOUS_ADDR": resolve_advertise_address(
+            settings.nics, remote),
         "HOROVOD_RENDEZVOUS_PORT": str(port),
         "HOROVOD_SECRET_KEY": server.secret,
     })

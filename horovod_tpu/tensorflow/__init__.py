@@ -471,6 +471,9 @@ def SyncBatchNormalization(*args, process_set: Optional[ProcessSet] = None,
             # rank-identical loss terms).
             group_mean = mean + tf.stop_gradient(group_mean - mean)
             group_sq = sq + tf.stop_gradient(group_sq - sq)
-            return group_mean, group_sq - tf.square(group_mean)
+            # E[x^2] - mean^2 can round slightly negative in f32; a
+            # negative variance would NaN the rsqrt downstream.
+            return group_mean, tf.maximum(
+                group_sq - tf.square(group_mean), 0.0)
 
     return _SyncBatchNormalization(*args, **kwargs)

@@ -383,9 +383,12 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
         local_sq = (input * input).mean(dims)
         gm, gsq = grouped_allreduce(
             [local_mean.detach(), local_sq.detach()], op=Average)
-        # Straight-through: global value, local gradient path.
+        # Straight-through: global value, local gradient path.  Clamp:
+        # E[x^2] - mean^2 can round slightly negative in f32 for large-
+        # mean low-variance channels, which would NaN the sqrt.
         mean = local_mean + (gm - local_mean.detach())
         var = (local_sq + (gsq - local_sq.detach())) - mean * mean
+        var = torch.clamp(var, min=0.0)
         if self.track_running_stats and self.running_mean is not None:
             n = input.numel() // input.size(1) * size()
             unbiased = var.detach() * n / max(n - 1, 1)

@@ -131,14 +131,16 @@ class TestMxnetDistributedOptimizer:
         np.testing.assert_allclose(gs[0].asnumpy(), 1.0)
         np.testing.assert_allclose(gs[1].asnumpy(), 3.0)
 
-    def test_predivide(self):
+    def test_predivide_is_scale_neutral(self):
+        # Reference semantics: prescale 1/f before the reduce, postscale
+        # f after — the result is the true average regardless of f.
         inner = FakeOptimizer()
         opt = hvd_mx.DistributedOptimizer(inner,
                                           gradient_predivide_factor=2.0)
         w = FakeNDArray(np.zeros(2, np.float32))
         g = FakeNDArray(np.full(2, 4.0, np.float32))
         opt.update(0, w, g, None)
-        np.testing.assert_allclose(g.asnumpy(), 2.0)  # 4 / 2, averaged
+        np.testing.assert_allclose(g.asnumpy(), 4.0)
 
     def test_passthrough(self):
         inner = FakeOptimizer()

@@ -265,3 +265,11 @@ class TestSyncBatchNormalization:
         g1 = t1.gradient(l1, x)
         g2 = t2.gradient(l2, x)
         np.testing.assert_allclose(g1.numpy(), g2.numpy(), atol=1e-4)
+
+    def test_no_nan_on_large_mean_tiny_variance(self):
+        tf = pytest.importorskip("tensorflow")
+        import horovod_tpu.tensorflow as hvd_tf
+
+        x = tf.fill((32, 4), 100.0) + tf.random.normal((32, 4)) * 1e-4
+        out = hvd_tf.SyncBatchNormalization(axis=-1)(x, training=True)
+        assert np.isfinite(out.numpy()).all()

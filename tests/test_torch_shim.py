@@ -359,3 +359,10 @@ class TestSyncBatchNorm:
         torch.testing.assert_close(sbn.running_mean, bn.running_mean,
                                    atol=1e-5, rtol=1e-4)
         assert int(sbn.num_batches_tracked) == 3
+
+    def test_no_nan_on_large_mean_tiny_variance(self):
+        # Regression: E[x^2]-mean^2 rounds negative in f32 for constant-
+        # ish channels with large mean; the clamp must prevent NaN.
+        x = torch.full((32, 4), 100.0) + torch.randn(32, 4) * 1e-4
+        out = hvd_torch.SyncBatchNorm(4)(x)
+        assert torch.isfinite(out).all()
