@@ -66,6 +66,7 @@ from .ops.collectives import (  # noqa: F401
     allreduce,
     allreduce_async,
     grouped_allreduce,
+    grouped_allreduce_async,
     allgather,
     allgather_async,
     grouped_allgather,
@@ -74,6 +75,7 @@ from .ops.collectives import (  # noqa: F401
     alltoall,
     alltoall_async,
     reducescatter,
+    reducescatter_async,
     grouped_reducescatter,
     barrier,
     join,

@@ -49,6 +49,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..common import basics
 from ..common.basics import GLOBAL_AXIS, ProcessSet
 from ..common.exceptions import HorovodTpuError
+from ..utils import consistency as _cc
 from ..utils import stall_inspector as _stall
 from ..utils import timeline as _tl
 from . import join as _join
@@ -74,7 +75,7 @@ class _joinable:
                  prescale: float = 1.0, postscale: float = 1.0,
                  extra: Optional[Dict[str, Any]] = None):
         self._outer = not getattr(_join_tls, "nested", False)
-        if self._outer and _join.armed():
+        if self._outer and (_join.armed() or _cc.enabled()):
             shapes, dtypes = [], []
             for t in tensors:
                 if isinstance(t, PerRank):
@@ -95,7 +96,18 @@ class _joinable:
                 sig["post"] = float(postscale)
             if extra:
                 sig.update(extra)
-            _join.publish_signature(sig)
+            if _join.armed():
+                # Join mode owns the signature protocol: the blocking
+                # consistency barrier would deadlock against a joined
+                # rank that only mirrors AFTER the signature is
+                # published (ops/join.py _join_service_loop), and the
+                # mirroring itself already enforces cross-rank
+                # agreement.
+                _join.publish_signature(sig)
+            else:
+                # Debug-mode semantic race detection: every rank must
+                # be issuing this same collective (utils/consistency.py).
+                _cc.check(sig)
 
     def __enter__(self):
         if self._outer:
@@ -161,11 +173,12 @@ class _traced:
 __all__ = [
     "Average", "Sum", "Min", "Max", "Product", "Adasum",
     "PerRank",
-    "allreduce", "allreduce_async", "grouped_allreduce",
+    "allreduce", "allreduce_async",
+    "grouped_allreduce", "grouped_allreduce_async",
     "allgather", "allgather_async", "grouped_allgather",
     "broadcast", "broadcast_async",
     "alltoall", "alltoall_async",
-    "reducescatter", "grouped_reducescatter",
+    "reducescatter", "reducescatter_async", "grouped_reducescatter",
     "barrier", "join", "join_mode", "joined_ranks",
     "poll", "synchronize",
     "clear_caches",
@@ -226,6 +239,7 @@ def clear_caches() -> None:
         _program_cache.clear()
     HandleManager.global_instance().clear()
     _join.reset()
+    _cc.reset()
 
 
 def _cached_program(key: Tuple, builder: Callable[[], Callable]) -> Callable:
@@ -1165,6 +1179,20 @@ def broadcast_async(tensor, root_rank: int = 0, **kwargs) -> int:
 def alltoall_async(tensor, splits=None, **kwargs) -> int:
     return HandleManager.global_instance().allocate(
         alltoall(tensor, splits=splits, **kwargs)
+    )
+
+
+def reducescatter_async(tensor, op: ReduceOp = Average, **kwargs) -> int:
+    return HandleManager.global_instance().allocate(
+        reducescatter(tensor, op=op, **kwargs)
+    )
+
+
+def grouped_allreduce_async(tensors, **kwargs) -> int:
+    """One handle for the whole fused group (reference:
+    grouped_allreduce_async_ in every frontend)."""
+    return HandleManager.global_instance().allocate(
+        grouped_allreduce(tensors, **kwargs)
     )
 
 

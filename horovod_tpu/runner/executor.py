@@ -37,7 +37,7 @@ from .settings import Settings
 logger = logging.getLogger("horovod_tpu.runner.executor")
 
 _WORKER_LOOP = """\
-import base64, os, pickle, sys, traceback
+import base64, importlib.util, os, pickle, sys, traceback
 from horovod_tpu.runner.rendezvous import RendezvousClient
 client = RendezvousClient(
     os.environ["HOROVOD_RENDEZVOUS_ADDR"],
@@ -45,6 +45,25 @@ client = RendezvousClient(
     os.environ["HOROVOD_SECRET_KEY"])
 rank = os.environ["HOROVOD_RANK"]
 client.put("exec/alive/" + rank, "1")
+_main_mods = {}
+
+def _load_main(path):
+    # Functions defined in the driver's __main__ script cannot unpickle
+    # by module reference; load the script as a module (its name is not
+    # __main__, so the `if __name__ == "__main__"` guard stays false) —
+    # the multiprocessing-spawn convention.  Registering it in
+    # sys.modules under BOTH names lets (a) arguments pickled by the
+    # driver as "__main__.X" resolve here and (b) results whose classes
+    # were created under "_hvd_user_main" pickle by reference.
+    if path not in _main_mods:
+        spec = importlib.util.spec_from_file_location("_hvd_user_main", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_hvd_user_main"] = mod
+        spec.loader.exec_module(mod)
+        sys.modules["__main__"] = mod
+        _main_mods[path] = mod
+    return _main_mods[path]
+
 idx = 0
 while True:
     if client.get("exec/stop") is not None and \
@@ -59,13 +78,25 @@ while True:
         if p not in sys.path:
             sys.path.insert(0, p)
     try:
-        fn, args, kwargs = pickle.loads(payload["fn"])
+        if "main_file" in payload:
+            mod = _load_main(payload["main_file"])
+            fn = mod
+            for part in payload["qualname"].split("."):
+                fn = getattr(fn, part)
+            args, kwargs = pickle.loads(payload["argskw"])
+        else:
+            fn, args, kwargs = pickle.loads(payload["fn"])
         out = {"ok": True, "result": fn(*args, **kwargs)}
     except BaseException as e:  # post the failure, stay alive
         out = {"ok": False, "error": f"{type(e).__name__}: {e}",
                "traceback": traceback.format_exc()}
-    client.put(f"exec/result/{idx}/{rank}",
-               base64.b64encode(pickle.dumps(out)).decode())
+    try:
+        data = base64.b64encode(pickle.dumps(out)).decode()
+    except BaseException as e:  # unpicklable result must not kill the loop
+        data = base64.b64encode(pickle.dumps(
+            {"ok": False, "error": f"result not picklable: {e}",
+             "traceback": ""})).decode()
+    client.put(f"exec/result/{idx}/{rank}", data)
     idx += 1
 """
 
@@ -177,16 +208,27 @@ class Executor:
         if not self._started:
             raise HorovodTpuError("Executor not started")
         paths = []
+        fn_file = None
         try:
             import inspect
-            paths.append(os.path.dirname(
-                os.path.abspath(inspect.getfile(fn))))
+            fn_file = os.path.abspath(inspect.getfile(fn))
+            paths.append(os.path.dirname(fn_file))
         except TypeError:
             pass
-        payload = {
-            "fn": pickle.dumps((fn, args, kwargs or {})),
-            "paths": paths,
-        }
+        if getattr(fn, "__module__", None) == "__main__" and fn_file:
+            # __main__-defined functions can't unpickle by reference;
+            # ship the script path + qualname (worker loads the file).
+            payload = {
+                "main_file": fn_file,
+                "qualname": fn.__qualname__,
+                "argskw": pickle.dumps((args, kwargs or {})),
+                "paths": paths,
+            }
+        else:
+            payload = {
+                "fn": pickle.dumps((fn, args, kwargs or {})),
+                "paths": paths,
+            }
         token = self._cmd_idx
         self._server.kv().put(
             f"exec/cmd/{token}",
@@ -196,6 +238,9 @@ class Executor:
 
     def get(self, token: int, timeout: float = 600.0) -> List[Any]:
         """Collect per-rank results for a dispatched command."""
+        # Worker-side classes from a __main__-shipped script pickle as
+        # "_hvd_user_main.X"; that module IS this process's __main__.
+        sys.modules.setdefault("_hvd_user_main", sys.modules["__main__"])
         kv = self._server.kv()
         results: List[Any] = [None] * self._np
         got = set()

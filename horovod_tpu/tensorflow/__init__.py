@@ -70,7 +70,6 @@ from ..ops.collectives import (  # noqa: F401
     poll,
 )
 from ..ops.compression import Compression  # noqa: F401
-from .. import elastic  # noqa: F401
 
 
 def _to_np(t) -> np.ndarray:
@@ -477,3 +476,7 @@ def SyncBatchNormalization(*args, process_set: Optional[ProcessSet] = None,
                 group_sq - tf.square(group_mean), 0.0)
 
     return _SyncBatchNormalization(*args, **kwargs)
+
+
+# Framework-specific elastic namespace (hvd.elastic.TorchState / TensorFlowKerasState analog); at the end of the module because elastic.py imports symbols defined above.
+from . import elastic  # noqa: F401,E402

@@ -1,0 +1,74 @@
+"""TensorFlow/Keras elastic state (reference: horovod/tensorflow/
+elastic.py `TensorFlowKerasState` — host-side weight snapshots +
+broadcast-from-rank-0 sync).
+
+    state = hvd.elastic.TensorFlowKerasState(model, optimizer, epoch=0)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+# Re-export the shared elastic surface so `hvd.elastic.*` works from the
+# TF namespace exactly like the reference's horovod.tensorflow.elastic.
+from ..elastic import (  # noqa: F401
+    ElasticSampler,
+    ObjectState,
+    State,
+    TpuState,
+    notify_hosts_updated,
+    run,
+)
+from ..ops.functions import broadcast_object
+
+
+class TensorFlowKerasState(ObjectState):
+    """Elastic state for a Keras model (+ optimizer variables + scalars).
+
+    save(): snapshots `model.get_weights()` (numpy, host memory);
+    restore(): `set_weights`; sync(): broadcasts rank 0's weights to
+    all (reference: TensorFlowKerasState's _broadcast_model).
+    """
+
+    def __init__(self, model=None, optimizer: Optional[Any] = None,
+                 **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._weights: Any = None
+        self._opt_vars: Any = None
+        super().__init__(**kwargs)
+
+    def _opt_variables(self):
+        if self.optimizer is None:
+            return None
+        return [v.numpy() for v in getattr(self.optimizer, "variables",
+                                           lambda: [])()]
+
+    def save(self) -> None:
+        if self.model is not None:
+            self._weights = self.model.get_weights()
+        self._opt_vars = self._opt_variables()
+        super().save()
+
+    def restore(self) -> None:
+        if self.model is not None and self._weights is not None:
+            self.model.set_weights(self._weights)
+        if self.optimizer is not None and self._opt_vars:
+            for var, val in zip(self.optimizer.variables(), self._opt_vars):
+                var.assign(val)
+        super().restore()
+
+    def sync(self) -> None:
+        if self.model is not None:
+            synced = broadcast_object(self.model.get_weights(), root_rank=0)
+            self.model.set_weights(synced)
+        if self.optimizer is not None:
+            vs = self._opt_variables()
+            if vs:
+                synced = broadcast_object(vs, root_rank=0)
+                for var, val in zip(self.optimizer.variables(), synced):
+                    var.assign(val)
+        super().sync()
+
+
+__all__ = ["TensorFlowKerasState", "broadcast_object"]

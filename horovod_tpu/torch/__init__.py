@@ -60,7 +60,6 @@ from ..ops.collectives import (  # noqa: F401
     synchronize as _synchronize_handle,
 )
 from ..ops.compression import Compression  # noqa: F401
-from .. import elastic  # noqa: F401
 
 
 def _to_np(t: "torch.Tensor") -> np.ndarray:
@@ -139,6 +138,16 @@ def broadcast_async_(tensor, root_rank: int = 0, name=None) -> int:
     return _async_dispatch(arr, tensor, inplace=True)
 
 
+def grouped_allreduce_async(tensors, op=Average, name=None) -> int:
+    outs = C.grouped_allreduce([_to_np(t) for t in tensors], op=op)
+    return _async_dispatch(outs, list(tensors), inplace=False)
+
+
+def grouped_allreduce_async_(tensors, op=Average, name=None) -> int:
+    outs = C.grouped_allreduce([_to_np(t) for t in tensors], op=op)
+    return _async_dispatch(outs, list(tensors), inplace=True)
+
+
 def allgather(tensor: "torch.Tensor", name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None) -> "torch.Tensor":
     out = C.allgather(_to_np(tensor), name=name, process_set=process_set)
@@ -178,6 +187,13 @@ def synchronize(handle: int):
     if meta is None:
         return out
     like, inplace = meta
+    if isinstance(like, list):  # grouped handle
+        ts = [_to_torch(o, l) for o, l in zip(out, like)]
+        if inplace:
+            for l, t in zip(like, ts):
+                l.copy_(t)
+            return like
+        return ts
     t = _to_torch(out, like)
     if inplace:
         like.copy_(t)
@@ -410,3 +426,7 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
             out = out * self.weight.reshape(shape) + \
                 self.bias.reshape(shape)
         return out
+
+
+# Framework-specific elastic namespace (hvd.elastic.TorchState / TensorFlowKerasState analog); at the end of the module because elastic.py imports symbols defined above.
+from . import elastic  # noqa: F401,E402

@@ -1,0 +1,77 @@
+"""Torch elastic state (reference: horovod/torch/elastic/state.py
+`TorchState` — per-object handlers snapshotting `state_dict`s host-side,
+restored on failure, synced from the new rank 0 after a reset).
+
+    state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        ...
+        state.commit()
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+import torch
+
+# Re-export the shared elastic surface so `hvd.elastic.*` works from the
+# torch namespace exactly like the reference's horovod.torch.elastic.
+from ..elastic import (  # noqa: F401
+    ElasticSampler,
+    ObjectState,
+    State,
+    TpuState,
+    notify_hosts_updated,
+    run,
+)
+from . import (
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+
+
+class TorchState(ObjectState):
+    """Elastic state for a torch model + optimizer (+ scalars).
+
+    save(): deep-copies `model.state_dict()` / `optimizer.state_dict()`
+    to host memory (the in-memory checkpoint); restore(): loads them
+    back; sync(): broadcasts from the new rank 0 (reference: TorchState
+    handlers + broadcast_parameters/broadcast_optimizer_state).
+    """
+
+    def __init__(self, model: "torch.nn.Module" = None,
+                 optimizer: "torch.optim.Optimizer" = None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._model_state: Any = None
+        self._opt_state: Any = None
+        super().__init__(**kwargs)
+
+    def save(self) -> None:
+        if self.model is not None:
+            self._model_state = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            self._opt_state = copy.deepcopy(self.optimizer.state_dict())
+        super().save()
+
+    def restore(self) -> None:
+        if self.model is not None and self._model_state is not None:
+            self.model.load_state_dict(self._model_state)
+        if self.optimizer is not None and self._opt_state is not None:
+            self.optimizer.load_state_dict(self._opt_state)
+        super().restore()
+
+    def sync(self) -> None:
+        if self.model is not None:
+            broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            broadcast_optimizer_state(self.optimizer, root_rank=0)
+        # Scalars ride ObjectState's broadcast_object; re-snapshot last.
+        super().sync()
+
+
+__all__ = ["TorchState", "broadcast_object"]

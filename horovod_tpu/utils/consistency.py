@@ -1,0 +1,114 @@
+"""Cross-rank collective-consistency checking (semantic race detection).
+
+Reference parity (SURVEY.md §5 "race detection"): the reference's
+controller rejects duplicate tensor names submitted in one cycle and
+errors on mismatched shapes/dtypes when building responses
+(controller.cc "Duplicate tensor name", message.cc construction checks)
+— its negotiation phase sees every rank's submission, so divergence is
+caught before the collective runs.
+
+Compiled SPMD has no negotiation, so a rank calling `allreduce` with a
+different shape (or a different op sequence) than its peers hangs or
+corrupts silently.  HOROVOD_COLLECTIVE_CONSISTENCY_CHECK=1 restores the
+reference's diagnostic: before executing, every eager collective
+publishes its signature (kind/shapes/dtypes/op, sequence-numbered) to
+the control-plane KV and waits for all ranks' signatures for that
+sequence number; any divergence raises with a per-rank dump.  This is a
+debug mode — it adds one KV round-trip per collective, the same traffic
+class as the reference's per-cycle negotiation.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..common import basics, util
+from ..common.exceptions import HorovodTpuError
+
+logger = logging.getLogger("horovod_tpu.consistency")
+
+_lock = threading.Lock()
+_seq = 0
+# Bumped on reset(): scopes the KV namespace so keys from before a
+# shutdown/re-init can never satisfy a later barrier (the same stale-key
+# hazard join.py solves with its _round component).
+_round = 0
+_kv = None
+
+_TIMEOUT_S = 30.0
+_POLL_S = 0.02
+
+
+def enabled() -> bool:
+    return util.env_bool("COLLECTIVE_CONSISTENCY_CHECK", False)
+
+
+def reset() -> None:
+    global _seq, _round, _kv
+    with _lock:
+        _seq = 0
+        _round += 1
+        _kv = None
+
+
+def _client():
+    global _kv
+    if _kv is None:
+        from ..runner.elastic_worker import client_from_env
+        _kv = client_from_env()
+    return _kv
+
+
+def _ns() -> str:
+    gen = util.getenv("ELASTIC_GEN", "0")
+    return f"cc/{gen}/{basics.size()}/{_round}"
+
+
+def check(sig: Dict[str, Any]) -> None:
+    """Publish this rank's signature for the next collective and verify
+    every rank submitted the same one.  No-op unless enabled and
+    multi-process."""
+    if not enabled() or basics.num_processes() <= 1:
+        return
+    global _seq
+    with _lock:
+        s = _seq
+        _seq += 1
+    kv = _client()
+    me = basics.rank()
+    mine = json.dumps(sig, sort_keys=True)
+    kv.put(f"{_ns()}/{s}/{me}", mine)
+    n = basics.size()
+    deadline = time.monotonic() + _TIMEOUT_S
+    while True:
+        keys = kv.keys(f"{_ns()}/{s}/")
+        if len(keys) >= n:
+            break
+        if time.monotonic() > deadline:
+            missing = sorted(
+                set(range(n))
+                - {int(k.rsplit("/", 1)[1]) for k in keys})
+            raise HorovodTpuError(
+                f"collective consistency check: ranks {missing} did not "
+                f"submit collective #{s} within {_TIMEOUT_S}s (this rank "
+                f"submitted {mine}) — peers are running a different "
+                f"program or have stalled")
+        time.sleep(_POLL_S)
+    per_rank = {}
+    for key in keys:
+        r = int(key.rsplit("/", 1)[1])
+        per_rank[r] = kv.get(key)
+    distinct = set(per_rank.values())
+    if len(distinct) > 1:
+        dump = "\n".join(f"  rank {r}: {v}"
+                         for r, v in sorted(per_rank.items()))
+        raise HorovodTpuError(
+            f"collective consistency check FAILED at collective #{s} — "
+            f"ranks submitted different collectives:\n{dump}")
+
+
+__all__ = ["check", "enabled", "reset"]

@@ -1,0 +1,44 @@
+"""Worker main for the collective-consistency-check test.
+
+CC_TEST_MODE=match: both ranks run identical collectives — the check
+must be transparent.  CC_TEST_MODE=mismatch: rank 1 allreduces a
+different shape — both ranks must fail fast with the per-rank signature
+dump (reference: the controller's mismatched-shape construction error),
+instead of hanging in a divergent compiled collective.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    mode = os.environ["CC_TEST_MODE"]
+    rank = hvd.rank()
+
+    shape = (4,)
+    if mode == "mismatch" and rank == 1:
+        shape = (8,)
+    out = hvd.allreduce(jnp.ones(shape), op=hvd.Sum, name="step1")
+    assert np.asarray(out)[0] == hvd.size()
+    # A second, heterogeneous op keeps the sequence numbers honest.
+    out2 = hvd.broadcast(jnp.full((2,), 5.0 + rank), root_rank=0)
+    assert np.asarray(out2)[0] == 5.0
+    print(f"rank {rank} done", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Driver script for the Executor __main__-class round-trip test:
+a class and a function defined in the driver's __main__ must ship to
+workers and back (the multiprocessing-spawn convention, reference:
+RayExecutor ships closures via cloudpickle)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+class Payload:
+    def __init__(self, rank, tag):
+        self.rank = rank
+        self.tag = tag
+
+
+def make_payload(tag):
+    return Payload(int(os.environ["HOROVOD_RANK"]), tag)
+
+
+def main():
+    os.environ.pop("XLA_FLAGS", None)
+    from horovod_tpu.runner.executor import Executor
+
+    with Executor(np=2) as ex:
+        # Argument is a __main__ class instance; result is too.
+        outs = ex.run(make_payload, args=("t1",))
+        assert [p.rank for p in outs] == [0, 1], outs
+        assert all(isinstance(p, Payload) and p.tag == "t1" for p in outs)
+        outs2 = ex.run(make_payload, args=("t2",))
+        assert [p.tag for p in outs2] == ["t2", "t2"]
+    print("MAIN_CLASS_ROUNDTRIP_OK")
+
+
+if __name__ == "__main__":
+    main()

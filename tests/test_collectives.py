@@ -538,3 +538,16 @@ def test_broadcast_process_set_root_out_of_range_in_jit(mesh):
             jax.jit(_shard_mapped(f, mesh))(vals)
     finally:
         hvd.remove_process_set(ps)
+
+
+def test_reducescatter_and_grouped_async():
+    h = hvd.reducescatter_async(np.ones((N * 2,), np.float32), op=hvd.Sum)
+    out = hvd.synchronize(h)
+    np.testing.assert_allclose(np.asarray(out), np.full((2,), float(N)))
+    h2 = hvd.grouped_allreduce_async(
+        [np.ones((3,), np.float32), np.full((2,), 2.0, np.float32)],
+        op=hvd.Sum)
+    outs = hvd.synchronize(h2)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full((3,), float(N)))
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.full((2,), 2.0 * N))

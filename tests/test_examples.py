@@ -110,3 +110,23 @@ class TestKerasExample:
                           ["--epochs", "1", "--n", "128",
                            "--batch-size", "32"], timeout=420)
         assert "final loss:" in out
+
+
+@pytest.mark.integration
+class TestNewExamples:
+    def test_hierarchical_multislice(self):
+        out = _run_example("hierarchical_multislice.py")
+        assert "final loss" in out
+
+    def test_executor_pool(self):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "examples", "executor_pool.py")],
+            capture_output=True, text=True, timeout=300, cwd=REPO_ROOT,
+            env=env)
+        assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+        assert "pool reused" in r.stdout

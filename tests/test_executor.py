@@ -123,3 +123,23 @@ class TestElasticExecutor:
         ex = ElasticExecutor(str(script), min_np=2, slots=2)
         results = ex.run(fn_elastic_rank)
         assert sorted(results) == [0, 1]
+
+
+@pytest.mark.integration
+def test_main_defined_classes_roundtrip(clean_env):
+    """Functions AND classes defined in the driver's __main__ script
+    must ship to workers and results return (multiprocessing-spawn
+    module aliasing in the worker loop)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [os.sys.executable,
+         os.path.join(repo, "tests", "data", "executor_main_cls.py")],
+        capture_output=True, text=True, timeout=240, env=env, cwd=repo)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "MAIN_CLASS_ROUNDTRIP_OK" in r.stdout

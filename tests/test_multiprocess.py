@@ -110,6 +110,9 @@ class TestJoinMultiprocess:
         env["HVD_TEST_OUT"] = str(tmp_path)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("XLA_FLAGS", None)
+        # Regression: the consistency checker must not deadlock against
+        # join mode (it defers to join's own signature protocol).
+        env["HOROVOD_COLLECTIVE_CONSISTENCY_CHECK"] = "1"
         r = subprocess.run(
             [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
              "python", JOIN_WORKER],
@@ -170,3 +173,40 @@ class TestStallInspectorNamesRanks:
         assert "rank 0 done" in out and "rank 1 done" in out
         assert "stalled" in out, out
         assert "Ranks behind: rank 0" in out, out
+
+
+CC_WORKER = os.path.join(REPO_ROOT, "tests", "data", "consistency_main.py")
+
+
+@pytest.mark.integration
+class TestCollectiveConsistencyCheck:
+    """Semantic race detection (reference: controller.cc duplicate-name
+    / mismatched-shape errors): under the debug flag, divergent
+    collectives fail fast with a per-rank signature dump instead of
+    hanging the compiled collective."""
+
+    def _launch(self, mode):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["HOROVOD_COLLECTIVE_CONSISTENCY_CHECK"] = "1"
+        env["CC_TEST_MODE"] = mode
+        return subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             "python", CC_WORKER],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=REPO_ROOT)
+
+    def test_matching_collectives_pass(self):
+        r = self._launch("match")
+        out = r.stdout + r.stderr
+        assert r.returncode == 0, out
+        assert "rank 0 done" in out and "rank 1 done" in out
+
+    def test_mismatched_shape_fails_fast_with_dump(self):
+        r = self._launch("mismatch")
+        out = r.stdout + r.stderr
+        assert r.returncode != 0
+        assert "consistency check FAILED" in out, out
+        assert "rank 0:" in out and "rank 1:" in out, out

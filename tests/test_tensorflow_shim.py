@@ -273,3 +273,35 @@ class TestSyncBatchNormalization:
         x = tf.fill((32, 4), 100.0) + tf.random.normal((32, 4)) * 1e-4
         out = hvd_tf.SyncBatchNormalization(axis=-1)(x, training=True)
         assert np.isfinite(out.numpy()).all()
+
+
+class TestTensorFlowKerasElasticState:
+    """Reference: horovod/tensorflow/elastic.py TensorFlowKerasState."""
+
+    def _model(self, tf):
+        m = tf.keras.Sequential([tf.keras.layers.Dense(2)])
+        m(tf.ones((1, 3)))  # build
+        return m
+
+    def test_save_restore_roundtrip(self):
+        tf = pytest.importorskip("tensorflow")
+        import horovod_tpu.tensorflow as hvd_tf
+
+        m = self._model(tf)
+        state = hvd_tf.elastic.TensorFlowKerasState(m, epoch=4)
+        saved = [w.copy() for w in m.get_weights()]
+        m.set_weights([w * 0 + 7 for w in m.get_weights()])
+        state.epoch = 9
+        state.restore()
+        for got, want in zip(m.get_weights(), saved):
+            np.testing.assert_allclose(got, want)
+        assert state.epoch == 4
+
+    def test_sync_runs(self):
+        tf = pytest.importorskip("tensorflow")
+        import horovod_tpu.tensorflow as hvd_tf
+
+        m = self._model(tf)
+        state = hvd_tf.elastic.TensorFlowKerasState(m, epoch=2)
+        state.sync()
+        assert state.epoch == 2

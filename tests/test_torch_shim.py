@@ -366,3 +366,65 @@ class TestSyncBatchNorm:
         x = torch.full((32, 4), 100.0) + torch.randn(32, 4) * 1e-4
         out = hvd_torch.SyncBatchNorm(4)(x)
         assert torch.isfinite(out).all()
+
+
+class TestGroupedAsync:
+    def test_grouped_allreduce_async_inplace(self):
+        ts = [torch.ones(3), torch.full((2,), 2.0)]
+        h = hvd_torch.grouped_allreduce_async_(ts, op=hvd_torch.Sum)
+        out = hvd_torch.synchronize(h)
+        assert all(o is t for o, t in zip(out, ts))  # in-place contract
+        n = float(hvd_torch.size())
+        torch.testing.assert_close(ts[0], torch.full((3,), n))
+        torch.testing.assert_close(ts[1], torch.full((2,), 2.0 * n))
+
+    def test_grouped_allreduce_async(self):
+        ts = [torch.ones(2), torch.ones(4)]
+        h = hvd_torch.grouped_allreduce_async(ts)
+        outs = hvd_torch.synchronize(h)
+        assert isinstance(outs, list) and len(outs) == 2
+        torch.testing.assert_close(outs[0], ts[0])
+
+
+class TestTorchElasticState:
+    """Reference: horovod/torch/elastic/state.py TorchState —
+    save/restore are host-side state_dict snapshots; sync broadcasts
+    from rank 0."""
+
+    def test_save_restore_roundtrip(self):
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        state = hvd_torch.elastic.TorchState(
+            model=model, optimizer=opt, epoch=3, batch=7)
+        saved_w = model.weight.detach().clone()
+        # Corrupt everything, then restore.
+        with torch.no_grad():
+            model.weight.mul_(0).add_(99.0)
+        state.epoch = 11
+        state.restore()
+        torch.testing.assert_close(model.weight.detach(), saved_w)
+        assert state.epoch == 3 and state.batch == 7
+
+    def test_commit_then_restore_keeps_committed(self):
+        model = torch.nn.Linear(2, 2)
+        state = hvd_torch.elastic.TorchState(model=model, epoch=0)
+        with torch.no_grad():
+            model.weight.fill_(5.0)
+        state.epoch = 2
+        state.commit()
+        with torch.no_grad():
+            model.weight.fill_(-1.0)
+        state.restore()
+        torch.testing.assert_close(
+            model.weight.detach(), torch.full((2, 2), 5.0))
+        assert state.epoch == 2
+
+    def test_sync_runs(self):
+        model = torch.nn.Linear(2, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        model(torch.randn(4, 2)).sum().backward()
+        opt.step()
+        state = hvd_torch.elastic.TorchState(
+            model=model, optimizer=opt, epoch=1)
+        state.sync()  # single-host: broadcast from rank 0 is identity
+        assert state.epoch == 1
