@@ -83,6 +83,11 @@ class _joinable:
                 t = jnp.asarray(t) if not hasattr(t, "shape") else t
                 shapes.append(list(t.shape))
                 dtypes.append(str(t.dtype))
+            if kind == "allgather":
+                # Ragged first dims are supported (per-rank dim0); mask
+                # dim0 so signatures compare equal across ranks — join
+                # mirroring zeroes it anyway (ops/join.py).
+                shapes = [([0] + s[1:]) if s else s for s in shapes]
             sig = {"kind": kind, "shapes": shapes, "dtypes": dtypes}
             if op is not None:
                 sig["op"] = op.name
@@ -105,9 +110,12 @@ class _joinable:
                 # agreement.
                 _join.publish_signature(sig)
             else:
-                # Debug-mode semantic race detection: every rank must
-                # be issuing this same collective (utils/consistency.py).
-                _cc.check(sig)
+                # Debug-mode semantic race detection: every rank of the
+                # op's process set must be issuing this same collective
+                # (utils/consistency.py); disjoint sets run independent
+                # sequences, like the reference's per-set controllers.
+                _cc.check(sig,
+                          ranks=process_set.ranks if process_set else None)
 
     def __enter__(self):
         if self._outer:

@@ -215,7 +215,11 @@ class Executor:
             paths.append(os.path.dirname(fn_file))
         except TypeError:
             pass
-        if getattr(fn, "__module__", None) == "__main__" and fn_file:
+        if getattr(fn, "__module__", None) == "__main__" and fn_file \
+                and "<locals>" not in getattr(fn, "__qualname__", ""):
+            # Nested functions can't resolve by qualname on the worker;
+            # let them fall through to pickle, which raises the clear
+            # "Can't pickle local object" in the DRIVER.
             # __main__-defined functions can't unpickle by reference;
             # ship the script path + qualname (worker loads the file).
             payload = {

@@ -38,11 +38,18 @@ class TensorFlowKerasState(ObjectState):
         self._opt_vars: Any = None
         super().__init__(**kwargs)
 
+    def _opt_var_objs(self):
+        """Keras 2 exposes `optimizer.variables()` (method); Keras 3
+        makes it a property returning the list."""
+        if self.optimizer is None:
+            return []
+        vs = getattr(self.optimizer, "variables", [])
+        return vs() if callable(vs) else list(vs)
+
     def _opt_variables(self):
         if self.optimizer is None:
             return None
-        return [v.numpy() for v in getattr(self.optimizer, "variables",
-                                           lambda: [])()]
+        return [v.numpy() for v in self._opt_var_objs()]
 
     def save(self) -> None:
         if self.model is not None:
@@ -54,7 +61,7 @@ class TensorFlowKerasState(ObjectState):
         if self.model is not None and self._weights is not None:
             self.model.set_weights(self._weights)
         if self.optimizer is not None and self._opt_vars:
-            for var, val in zip(self.optimizer.variables(), self._opt_vars):
+            for var, val in zip(self._opt_var_objs(), self._opt_vars):
                 var.assign(val)
         super().restore()
 
@@ -66,7 +73,7 @@ class TensorFlowKerasState(ObjectState):
             vs = self._opt_variables()
             if vs:
                 synced = broadcast_object(vs, root_rank=0)
-                for var, val in zip(self.optimizer.variables(), synced):
+                for var, val in zip(self._opt_var_objs(), synced):
                     var.assign(val)
         super().sync()
 

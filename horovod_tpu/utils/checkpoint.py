@@ -1,0 +1,149 @@
+"""Conventional (on-disk) checkpointing for training state.
+
+Reference scope (SURVEY.md §5 "Checkpoint/resume"): upstream delegates
+durable checkpoints to the frameworks — examples save on rank 0
+(`pytorch_imagenet_resnet50.py`), keras callbacks write HDF5, Spark
+estimators persist to a `Store`.  The elastic in-memory
+commit/restore/sync protocol lives in `horovod_tpu.elastic`.
+
+TPU-native implementation: orbax (the JAX-ecosystem checkpointer)
+persists arbitrary pytrees (params / optimizer state / batch stats)
+with the Horovod convention baked in — **rank 0 writes, every rank
+reads, then re-broadcasts** so restored state is bitwise identical on
+all ranks even if the filesystem is not shared-consistent.
+
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    mgr = ckpt.CheckpointManager("/tmp/run1", max_to_keep=3)
+    mgr.save(step, {"params": params, "opt_state": opt_state})
+    state = mgr.restore_latest()     # None if no checkpoint yet
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+from ..common import basics
+
+logger = logging.getLogger("horovod_tpu.checkpoint")
+
+
+class CheckpointManager:
+    """Rank-0-writes / all-ranks-consistent checkpoint manager."""
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True)
+        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+
+    # -- write -----------------------------------------------------------
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Persist `state` (a pytree) at `step`.  Only rank 0 writes
+        (the Horovod convention — every example and keras callback in
+        the reference guards on `hvd.rank() == 0`); other ranks no-op
+        and return False."""
+        import orbax.checkpoint as ocp
+
+        if basics.is_initialized() and basics.rank() != 0:
+            return False
+        self._mgr.save(step, args=ocp.args.StandardSave(state),
+                       force=force)
+        self._mgr.wait_until_finished()
+        return True
+
+    # -- read ------------------------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def _read(self, step: int, template: Any) -> Any:
+        import orbax.checkpoint as ocp
+
+        if template is not None:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template))
+        return self._mgr.restore(step)
+
+    @staticmethod
+    def _multiprocess() -> bool:
+        return basics.is_initialized() and basics.num_processes() > 1
+
+    def restore(self, step: int, template: Any = None) -> Any:
+        """Restore the pytree at `step`; `template` (a matching pytree
+        of arrays) restores into the right shardings/dtypes.
+
+        Multi-process: ONLY rank 0 touches the filesystem (the files may
+        live on rank 0's local disk — save() writes there only); every
+        rank, read success or not, reaches the broadcast, so the ranks
+        neither deadlock nor diverge."""
+        if not self._multiprocess():
+            return self._read(step, template)
+        from ..ops.functions import broadcast_object
+
+        out = None
+        err = None
+        if basics.rank() == 0:
+            try:
+                out = self._read(step, template)
+            except Exception as e:  # noqa: BLE001 — surface on ALL ranks
+                err = f"{type(e).__name__}: {e}"
+        out, err = broadcast_object((out, err), root_rank=0)
+        if err is not None:
+            raise RuntimeError(f"checkpoint restore failed on rank 0: {err}")
+        return out
+
+    def restore_latest(self, template: Any = None) -> Optional[Any]:
+        if not self._multiprocess():
+            step = self.latest_step()
+            if step is None:
+                return None
+            return self._read(step, template)
+        from ..ops.functions import broadcast_object
+
+        out = None
+        err = None
+        if basics.rank() == 0:
+            try:
+                step = self.latest_step()
+                if step is not None:
+                    out = self._read(step, template)
+            except Exception as e:  # noqa: BLE001
+                err = f"{type(e).__name__}: {e}"
+        out, err = broadcast_object((out, err), root_rank=0)
+        if err is not None:
+            raise RuntimeError(f"checkpoint restore failed on rank 0: {err}")
+        return out
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def save_checkpoint(directory: str, state: Any, step: int = 0) -> bool:
+    """One-shot convenience: rank-0 save of `state` at `step`."""
+    with CheckpointManager(directory, max_to_keep=None) as mgr:
+        return mgr.save(step, state)
+
+
+def restore_checkpoint(directory: str, template: Any = None,
+                       step: Optional[int] = None) -> Optional[Any]:
+    """One-shot convenience: restore `step` (default latest)."""
+    with CheckpointManager(directory, max_to_keep=None) as mgr:
+        if step is None:
+            return mgr.restore_latest(template=template)
+        return mgr.restore(step, template=template)
+
+
+__all__ = ["CheckpointManager", "restore_checkpoint", "save_checkpoint"]

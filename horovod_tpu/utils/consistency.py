@@ -32,7 +32,11 @@ from ..common.exceptions import HorovodTpuError
 logger = logging.getLogger("horovod_tpu.consistency")
 
 _lock = threading.Lock()
-_seq = 0
+# Sequence counter PER participant set: disjoint process sets run
+# concurrent, independently-numbered streams (reference: one controller
+# per process set), and interleaving set-scoped with global collectives
+# must not desynchronize either stream.
+_seqs: Dict[tuple, int] = {}
 # Bumped on reset(): scopes the KV namespace so keys from before a
 # shutdown/re-init can never satisfy a later barrier (the same stale-key
 # hazard join.py solves with its _round component).
@@ -48,9 +52,9 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    global _seq, _round, _kv
+    global _seqs, _round, _kv
     with _lock:
-        _seq = 0
+        _seqs = {}
         _round += 1
         _kv = None
 
@@ -68,47 +72,62 @@ def _ns() -> str:
     return f"cc/{gen}/{basics.size()}/{_round}"
 
 
-def check(sig: Dict[str, Any]) -> None:
+# Keys older than this many (completed) sequence numbers are reclaimed:
+# a rank at seq s has completed the seq s-1 barrier, so every
+# participant has read seq <= s-1's keys and anything at s-_GC_LAG is
+# dead (prevents unbounded KV growth over a long debug run).
+_GC_LAG = 4
+
+
+def check(sig: Dict[str, Any], ranks=None) -> None:
     """Publish this rank's signature for the next collective and verify
-    every rank submitted the same one.  No-op unless enabled and
-    multi-process."""
+    every participating rank submitted the same one.  `ranks` scopes the
+    barrier to a process set's members (disjoint sets run concurrent,
+    independent sequences — reference: one controller per process set).
+    No-op unless enabled and multi-process."""
     if not enabled() or basics.num_processes() <= 1:
         return
-    global _seq
+    expected = tuple(sorted(int(r) for r in ranks)) if ranks else \
+        tuple(range(basics.size()))
     with _lock:
-        s = _seq
-        _seq += 1
+        s = _seqs.get(expected, 0)
+        _seqs[expected] = s + 1
+    # Short stable id for the participant set's key stream.
+    setid = "-".join(map(str, expected))
+    if len(setid) > 40:
+        import hashlib
+        setid = hashlib.sha1(setid.encode()).hexdigest()[:16]
+    base = f"{_ns()}/{setid}/{s}"
     kv = _client()
     me = basics.rank()
     mine = json.dumps(sig, sort_keys=True)
-    kv.put(f"{_ns()}/{s}/{me}", mine)
-    n = basics.size()
+    kv.put(f"{base}/{me}", mine)
     deadline = time.monotonic() + _TIMEOUT_S
     while True:
-        keys = kv.keys(f"{_ns()}/{s}/")
-        if len(keys) >= n:
+        keys = kv.keys(f"{base}/")
+        have = {int(k.rsplit("/", 1)[1]) for k in keys}
+        if all(r in have for r in expected):
             break
         if time.monotonic() > deadline:
-            missing = sorted(
-                set(range(n))
-                - {int(k.rsplit("/", 1)[1]) for k in keys})
+            missing = sorted(set(expected) - have)
             raise HorovodTpuError(
                 f"collective consistency check: ranks {missing} did not "
                 f"submit collective #{s} within {_TIMEOUT_S}s (this rank "
                 f"submitted {mine}) — peers are running a different "
                 f"program or have stalled")
         time.sleep(_POLL_S)
-    per_rank = {}
-    for key in keys:
-        r = int(key.rsplit("/", 1)[1])
-        per_rank[r] = kv.get(key)
-    distinct = set(per_rank.values())
-    if len(distinct) > 1:
+    per_rank = {r: kv.get(f"{base}/{r}") for r in expected}
+    if len(set(per_rank.values())) > 1:
         dump = "\n".join(f"  rank {r}: {v}"
                          for r, v in sorted(per_rank.items()))
         raise HorovodTpuError(
             f"collective consistency check FAILED at collective #{s} — "
             f"ranks submitted different collectives:\n{dump}")
+    if s >= _GC_LAG:
+        try:
+            kv.delete(f"{_ns()}/{setid}/{s - _GC_LAG}/{me}")
+        except Exception:  # noqa: BLE001 — GC is best-effort
+            pass
 
 
 __all__ = ["check", "enabled", "reset"]

@@ -51,6 +51,10 @@ def main():
     out = hvd.allgather(jnp.full((1, 2), float(rank)))
     results["allgather"] = np.asarray(out).tolist()
 
+    # Ragged allgather: rank r contributes r+1 rows.
+    out = hvd.allgather(jnp.full((rank + 1, 1), float(rank)))
+    results["allgather_ragged"] = np.asarray(out).ravel().tolist()
+
     # alltoall: rank r receives chunk r from every sender s (= value s).
     out = hvd.alltoall(jnp.full((n,), float(rank)))
     results["alltoall"] = np.asarray(out).tolist()

@@ -7,6 +7,7 @@ import json
 import time
 
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 import horovod_tpu as hvd
@@ -172,3 +173,68 @@ def test_collectives_register_with_inspector():
         assert si.pending_ops() == []
     finally:
         stall_mod._inspector = None
+
+
+class TestCheckpointManager:
+    """Durable checkpointing (reference: rank-0 saves in the examples /
+    keras callbacks; SURVEY §5 checkpoint/resume) via orbax."""
+
+    def test_save_restore_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        from horovod_tpu.utils import checkpoint as ckpt
+
+        state = {"params": {"w": jnp.arange(6.0).reshape(2, 3)},
+                 "step": jnp.asarray(5)}
+        with ckpt.CheckpointManager(str(tmp_path / "c"),
+                                    max_to_keep=2) as mgr:
+            assert mgr.save(1, state)
+            mgr.save(2, {"params": {"w": state["params"]["w"] * 2},
+                         "step": jnp.asarray(9)})
+            assert mgr.latest_step() == 2
+            out = mgr.restore_latest(template=state)
+            np.testing.assert_allclose(
+                np.asarray(out["params"]["w"]),
+                np.arange(6.0).reshape(2, 3) * 2)
+            old = mgr.restore(1, template=state)
+            np.testing.assert_allclose(
+                np.asarray(old["params"]["w"]),
+                np.arange(6.0).reshape(2, 3))
+
+    def test_max_to_keep_prunes(self, tmp_path):
+        import jax.numpy as jnp
+
+        from horovod_tpu.utils import checkpoint as ckpt
+
+        with ckpt.CheckpointManager(str(tmp_path / "c"),
+                                    max_to_keep=2) as mgr:
+            for s in range(4):
+                mgr.save(s, {"x": jnp.asarray(float(s))})
+            assert mgr.latest_step() == 3
+            assert len(mgr.all_steps()) <= 2
+
+    def test_restore_latest_empty_returns_none(self, tmp_path):
+        from horovod_tpu.utils import checkpoint as ckpt
+
+        with ckpt.CheckpointManager(str(tmp_path / "empty")) as mgr:
+            assert mgr.restore_latest() is None
+
+    def test_one_shot_helpers(self, tmp_path):
+        import jax.numpy as jnp
+
+        from horovod_tpu.utils import checkpoint as ckpt
+
+        state = {"step": jnp.asarray(7)}
+        assert ckpt.save_checkpoint(str(tmp_path / "o"), state, step=0)
+        out = ckpt.restore_checkpoint(str(tmp_path / "o"), template=state)
+        assert int(out["step"]) == 7
+
+
+def test_standalone_keras_namespace():
+    """Reference exposes horovod.keras alongside horovod.tensorflow.keras."""
+    pytest.importorskip("tensorflow")
+    import horovod_tpu.keras as hvd_keras
+
+    assert callable(hvd_keras.DistributedOptimizer)
+    assert hasattr(hvd_keras.callbacks, "BroadcastGlobalVariablesCallback")
+    assert callable(hvd_keras.init)

@@ -27,6 +27,9 @@ def _launch(np_, out_dir, timeout=240):
     # Workers must see exactly one local CPU device each so the global
     # mesh is one-device-per-process.
     env.pop("XLA_FLAGS", None)
+    # The consistency checker must be TRANSPARENT for correct programs —
+    # including ragged allgather and concurrent disjoint process sets.
+    env["HOROVOD_COLLECTIVE_CONSISTENCY_CHECK"] = "1"
     return subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
          "python", WORKER],
@@ -55,6 +58,8 @@ class TestCrossProcessCollectives:
             assert res["broadcast"] == [100.0]
             # concat in rank order
             assert res["allgather"] == [[0.0, 0.0], [1.0, 1.0]]
+            # ragged: rank 0 one row, rank 1 two rows
+            assert res["allgather_ragged"] == [0.0, 1.0, 1.0]
             # rank r's received chunk from sender s = s
             assert res["alltoall"] == [0.0, 1.0]
             # summed tensor rows, one per rank
@@ -84,6 +89,8 @@ class TestCrossProcessCollectives:
             assert res["allreduce_avg"] == [avg] * 3
             assert res["broadcast"] == [100.0]
             assert res["allgather"] == [[float(s)] * 2 for s in range(n)]
+            assert res["allgather_ragged"] == [
+                float(s) for s in range(n) for _ in range(s + 1)]
             # mesh/rank order: received chunk s comes from global rank s.
             assert res["alltoall"] == [float(s) for s in range(n)]
             assert res["reducescatter"] == [float(total)] * 2

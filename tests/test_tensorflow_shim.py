@@ -305,3 +305,26 @@ class TestTensorFlowKerasElasticState:
         state = hvd_tf.elastic.TensorFlowKerasState(m, epoch=2)
         state.sync()
         assert state.epoch == 2
+
+    def test_optimizer_state_roundtrip(self):
+        # Regression: Keras 3 exposes optimizer.variables as a property.
+        tf = pytest.importorskip("tensorflow")
+        import horovod_tpu.tensorflow as hvd_tf
+
+        m = self._model(tf)
+        opt = tf.keras.optimizers.SGD(0.1, momentum=0.9)
+        with tf.GradientTape() as t:
+            loss = tf.reduce_sum(m(tf.ones((2, 3))) ** 2)
+        opt.apply_gradients(zip(t.gradient(loss, m.trainable_variables),
+                                m.trainable_variables))
+        state = hvd_tf.elastic.TensorFlowKerasState(m, optimizer=opt,
+                                                    epoch=1)
+        snap = [v.copy() for v in state._opt_vars]
+        with tf.GradientTape() as t:
+            loss = tf.reduce_sum(m(tf.ones((2, 3))) ** 2)
+        opt.apply_gradients(zip(t.gradient(loss, m.trainable_variables),
+                                m.trainable_variables))
+        state.restore()
+        for got, want in zip(state._opt_variables(), snap):
+            np.testing.assert_allclose(got, want)
+        state.sync()
