@@ -48,25 +48,64 @@ def run(
     kwargs: Optional[dict] = None,
     np: int = 1,
     hosts: Optional[str] = None,
+    hostfile: Optional[str] = None,
+    min_np: Optional[int] = None,
+    max_np: Optional[int] = None,
+    slots: Optional[int] = None,
+    host_discovery_script: Optional[str] = None,
+    ssh_port: Optional[int] = None,
+    ssh_identity_file: Optional[str] = None,
+    network_interfaces: Optional[str] = None,
+    output_filename: Optional[str] = None,
+    use_gloo: Optional[bool] = None,
+    use_mpi: Optional[bool] = None,
     verbose: int = 0,
     extra_env: Optional[dict] = None,
     start_timeout: float = 120.0,
 ) -> List[Any]:
-    """Run `func(*args, **kwargs)` on `np` workers; return results by rank.
+    """Run `func(*args, **kwargs)` on `np` workers; return results by rank
+    (reference: horovod.run — the full flag surface is accepted;
+    `use_gloo`/`use_mpi` are drop-in no-ops since the single backend is
+    XLA collectives).
+
+    `host_discovery_script` (+ min_np/max_np/slots) routes through the
+    elastic driver, mirroring the reference's elastic run() path.
 
     `start_timeout` bounds elastic host discovery; static worker startup is
     bounded by the jax.distributed bootstrap's own timeout.  With remote
     `hosts`, the pickled function file must be visible on every host
     (shared filesystem), as must the repo itself.
     """
-    host_list = (hosts_mod.parse_hosts(hosts) if hosts
-                 else [hosts_mod.HostInfo("localhost", np)])
+    if use_mpi:
+        logger.warning("use_mpi ignored: the single backend is XLA "
+                       "collectives (see README)")
+    if host_discovery_script:
+        from .executor import ElasticExecutor
+
+        ex = ElasticExecutor(
+            host_discovery_script, min_np=min_np or np, max_np=max_np,
+            slots=slots or 1, verbose=verbose, extra_env=extra_env,
+            start_timeout=start_timeout, ssh_port=ssh_port,
+            ssh_identity_file=ssh_identity_file,
+            network_interfaces=network_interfaces,
+            output_filename=output_filename)
+        return ex.run(func, args, kwargs)
+    if slots is not None:
+        logger.warning("run(): `slots` only applies with "
+                       "host_discovery_script; ignored for static hosts")
+
+    if hosts:
+        host_list = hosts_mod.parse_hosts(hosts)
+    elif hostfile:
+        host_list = hosts_mod.parse_hostfile(hostfile)
+    else:
+        host_list = [hosts_mod.HostInfo("localhost", np)]
     from .exec_run import _is_local
     if any(not _is_local(h.hostname) for h in host_list):
         logger.warning(
             "run() with remote hosts requires the function pickle (tempfile)"
             " and repo to be on a shared filesystem visible to all hosts")
-    slots = hosts_mod.get_host_assignments(host_list, np)
+    assignments = hosts_mod.get_host_assignments(host_list, np)
 
     with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as f:
         pickle.dump((func, args, kwargs or {}), f)
@@ -86,6 +125,8 @@ def run(
     settings = Settings(
         num_proc=np, hosts=host_list, verbose=verbose, extra_env=env,
         start_timeout=start_timeout,
+        ssh_port=ssh_port, ssh_identity_file=ssh_identity_file,
+        nics=network_interfaces, output_filename=output_filename,
         command=[sys.executable, "-c", _WORKER_SNIPPET],
     )
 
@@ -101,7 +142,7 @@ def run(
                 results[r] = pickle.loads(base64.b64decode(val))
 
     try:
-        rc = exec_run(settings, slots, result_hook=collect)
+        rc = exec_run(settings, assignments, result_hook=collect)
     finally:
         os.unlink(func_file)
     if rc != 0:

@@ -303,13 +303,23 @@ class ElasticExecutor:
 
     def __init__(self, discovery_script: str, min_np: int = 1,
                  max_np: Optional[int] = None, slots: int = 1,
-                 verbose: int = 0, extra_env: Optional[dict] = None):
+                 verbose: int = 0, extra_env: Optional[dict] = None,
+                 start_timeout: float = 120.0,
+                 ssh_port: Optional[int] = None,
+                 ssh_identity_file: Optional[str] = None,
+                 network_interfaces: Optional[str] = None,
+                 output_filename: Optional[str] = None):
         self._script = discovery_script
         self._min_np = min_np
         self._max_np = max_np
         self._slots = slots
         self._verbose = verbose
         self._extra_env = dict(extra_env or {})
+        self._start_timeout = start_timeout
+        self._ssh_port = ssh_port
+        self._ssh_identity_file = ssh_identity_file
+        self._nics = network_interfaces
+        self._output_filename = output_filename
 
     def run(self, fn: Callable, args: tuple = (),
             kwargs: Optional[dict] = None) -> List[Any]:
@@ -335,6 +345,10 @@ class ElasticExecutor:
             host_discovery_script=self._script,
             slots_per_host=self._slots,
             elastic=True, verbose=self._verbose, extra_env=env,
+            start_timeout=self._start_timeout,
+            ssh_port=self._ssh_port,
+            ssh_identity_file=self._ssh_identity_file,
+            nics=self._nics, output_filename=self._output_filename,
             command=[sys.executable, "-c", _WORKER_SNIPPET],
         )
         results: List[Any] = []

@@ -6,11 +6,18 @@ durable checkpoints to the frameworks — examples save on rank 0
 estimators persist to a `Store`.  The elastic in-memory
 commit/restore/sync protocol lives in `horovod_tpu.elastic`.
 
-TPU-native implementation: orbax (the JAX-ecosystem checkpointer)
-persists arbitrary pytrees (params / optimizer state / batch stats)
-with the Horovod convention baked in — **rank 0 writes, every rank
-reads, then re-broadcasts** so restored state is bitwise identical on
-all ranks even if the filesystem is not shared-consistent.
+Two storage paths behind one API, chosen by the runtime mode:
+
+- **Single process** (one controller, any number of local devices):
+  orbax — the JAX-ecosystem checkpointer, async-capable, tensor-store
+  format.
+- **Multi process** (`jax.distributed` active): orbax's save/restore are
+  *collective* operations (every process must participate in its
+  multihost barriers), which conflicts with the Horovod convention of
+  rank-0-only durable writes.  Here rank 0 snapshots the pytree to host
+  numpy and writes one pickle per step; restore reads on rank 0 and
+  broadcasts, so every rank reaches the broadcast whether or not its
+  filesystem has the files — no deadlock, no divergence.
 
     from horovod_tpu.utils import checkpoint as ckpt
 
@@ -23,75 +30,129 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Any, Optional
+import pickle
+import re
+import shutil
+from typing import Any, Callable, List, Optional
 
 from ..common import basics
 
 logger = logging.getLogger("horovod_tpu.checkpoint")
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _to_host(tree: Any) -> Any:
+    import jax
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x))
+        if hasattr(x, "dtype") else x, tree)
 
 
 class CheckpointManager:
     """Rank-0-writes / all-ranks-consistent checkpoint manager."""
 
     def __init__(self, directory: str, max_to_keep: Optional[int] = 3):
-        import orbax.checkpoint as ocp
-
         self._dir = os.path.abspath(directory)
-        options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, create=True)
-        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+        self._keep = max_to_keep
+        self._orbax = None
+        if not self._multiprocess():
+            import orbax.checkpoint as ocp
 
-    # -- write -----------------------------------------------------------
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
-        """Persist `state` (a pytree) at `step`.  Only rank 0 writes
-        (the Horovod convention — every example and keras callback in
-        the reference guards on `hvd.rank() == 0`); other ranks no-op
-        and return False."""
-        import orbax.checkpoint as ocp
-
-        if basics.is_initialized() and basics.rank() != 0:
-            return False
-        self._mgr.save(step, args=ocp.args.StandardSave(state),
-                       force=force)
-        self._mgr.wait_until_finished()
-        return True
-
-    # -- read ------------------------------------------------------------
-    def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
-
-    def all_steps(self):
-        return list(self._mgr.all_steps())
-
-    def _read(self, step: int, template: Any) -> Any:
-        import orbax.checkpoint as ocp
-
-        if template is not None:
-            return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(template))
-        return self._mgr.restore(step)
+            options = ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True)
+            self._orbax = ocp.CheckpointManager(self._dir, options=options)
+        elif basics.rank() == 0:
+            os.makedirs(self._dir, exist_ok=True)
 
     @staticmethod
     def _multiprocess() -> bool:
         return basics.is_initialized() and basics.num_processes() > 1
 
-    def restore(self, step: int, template: Any = None) -> Any:
-        """Restore the pytree at `step`; `template` (a matching pytree
-        of arrays) restores into the right shardings/dtypes.
+    # -- write -----------------------------------------------------------
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Persist `state` (a pytree) at `step`.  Only rank 0 writes
+        durable data (the Horovod convention — every example and keras
+        callback in the reference guards on `hvd.rank() == 0`); other
+        ranks no-op and return False."""
+        if self._orbax is not None:
+            import orbax.checkpoint as ocp
 
-        Multi-process: ONLY rank 0 touches the filesystem (the files may
-        live on rank 0's local disk — save() writes there only); every
-        rank, read success or not, reaches the broadcast, so the ranks
-        neither deadlock nor diverge."""
-        if not self._multiprocess():
-            return self._read(step, template)
+            self._orbax.save(step, args=ocp.args.StandardSave(state),
+                             force=force)
+            self._orbax.wait_until_finished()
+            return True
+        if basics.rank() != 0:
+            return False
+        host = _to_host(state)
+        final = os.path.join(self._dir, f"step_{step}")
+        tmp = final + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+            pickle.dump(host, f)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)  # atomic publish
+        self._prune()
+        return True
+
+    def _prune(self) -> None:
+        if self._keep is None:
+            return
+        steps = self._pickle_steps()
+        for s in steps[: max(0, len(steps) - self._keep)]:
+            shutil.rmtree(os.path.join(self._dir, f"step_{s}"),
+                          ignore_errors=True)
+
+    def _pickle_steps(self) -> List[int]:
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return []
+        steps = [int(m.group(1)) for n in names
+                 if (m := _STEP_RE.match(n))]
+        return sorted(steps)
+
+    # -- read ------------------------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        if self._orbax is not None:
+            return self._orbax.latest_step()
+        steps = self._pickle_steps()
+        return steps[-1] if steps else None
+
+    def all_steps(self) -> List[int]:
+        if self._orbax is not None:
+            return list(self._orbax.all_steps())
+        return self._pickle_steps()
+
+    def _read(self, step: int, template: Any) -> Any:
+        if self._orbax is not None:
+            import orbax.checkpoint as ocp
+
+            if template is not None:
+                return self._orbax.restore(
+                    step, args=ocp.args.StandardRestore(template))
+            return self._orbax.restore(step)
+        with open(os.path.join(self._dir, f"step_{step}", "state.pkl"),
+                  "rb") as f:
+            return pickle.load(f)
+
+    def _restore_bcast(self, choose_step: Callable[[], Optional[int]],
+                       template: Any) -> Optional[Any]:
+        """Rank 0 reads (or records the failure); EVERY rank reaches the
+        broadcast, so ranks neither deadlock nor diverge even when the
+        files exist only on rank 0's disk."""
         from ..ops.functions import broadcast_object
 
         out = None
         err = None
         if basics.rank() == 0:
             try:
-                out = self._read(step, template)
+                step = choose_step()
+                if step is not None:
+                    out = self._read(step, template)
             except Exception as e:  # noqa: BLE001 — surface on ALL ranks
                 err = f"{type(e).__name__}: {e}"
         out, err = broadcast_object((out, err), root_rank=0)
@@ -99,30 +160,25 @@ class CheckpointManager:
             raise RuntimeError(f"checkpoint restore failed on rank 0: {err}")
         return out
 
+    def restore(self, step: int, template: Any = None) -> Any:
+        """Restore the pytree at `step`; `template` (a matching pytree
+        of arrays) restores into the right shardings/dtypes (orbax
+        path)."""
+        if not self._multiprocess():
+            return self._read(step, template)
+        return self._restore_bcast(lambda: step, template)
+
     def restore_latest(self, template: Any = None) -> Optional[Any]:
         if not self._multiprocess():
             step = self.latest_step()
             if step is None:
                 return None
             return self._read(step, template)
-        from ..ops.functions import broadcast_object
-
-        out = None
-        err = None
-        if basics.rank() == 0:
-            try:
-                step = self.latest_step()
-                if step is not None:
-                    out = self._read(step, template)
-            except Exception as e:  # noqa: BLE001
-                err = f"{type(e).__name__}: {e}"
-        out, err = broadcast_object((out, err), root_rank=0)
-        if err is not None:
-            raise RuntimeError(f"checkpoint restore failed on rank 0: {err}")
-        return out
+        return self._restore_bcast(self.latest_step, template)
 
     def close(self) -> None:
-        self._mgr.close()
+        if self._orbax is not None:
+            self._orbax.close()
 
     def __enter__(self) -> "CheckpointManager":
         return self

@@ -87,19 +87,28 @@ def check(sig: Dict[str, Any], ranks=None) -> None:
     No-op unless enabled and multi-process."""
     if not enabled() or basics.num_processes() <= 1:
         return
-    expected = tuple(sorted(int(r) for r in ranks)) if ranks else \
-        tuple(range(basics.size()))
+    # One submission per PROCESS (a process drives local_size device
+    # ranks but issues each eager collective once): the barrier expects
+    # the process indices owning at least one participating device.
+    devs = basics.global_devices()
+    if ranks:
+        member_ranks = tuple(sorted(int(r) for r in ranks))
+        expected = tuple(sorted({devs[r].process_index
+                                 for r in member_ranks}))
+    else:
+        member_ranks = tuple(range(basics.size()))
+        expected = tuple(range(basics.num_processes()))
     with _lock:
-        s = _seqs.get(expected, 0)
-        _seqs[expected] = s + 1
+        s = _seqs.get(member_ranks, 0)
+        _seqs[member_ranks] = s + 1
     # Short stable id for the participant set's key stream.
-    setid = "-".join(map(str, expected))
+    setid = "-".join(map(str, member_ranks))
     if len(setid) > 40:
         import hashlib
         setid = hashlib.sha1(setid.encode()).hexdigest()[:16]
     base = f"{_ns()}/{setid}/{s}"
     kv = _client()
-    me = basics.rank()
+    me = basics.process_index()
     mine = json.dumps(sig, sort_keys=True)
     kv.put(f"{base}/{me}", mine)
     deadline = time.monotonic() + _TIMEOUT_S
@@ -111,15 +120,15 @@ def check(sig: Dict[str, Any], ranks=None) -> None:
         if time.monotonic() > deadline:
             missing = sorted(set(expected) - have)
             raise HorovodTpuError(
-                f"collective consistency check: ranks {missing} did not "
-                f"submit collective #{s} within {_TIMEOUT_S}s (this rank "
-                f"submitted {mine}) — peers are running a different "
-                f"program or have stalled")
+                f"collective consistency check: processes {missing} did "
+                f"not submit collective #{s} within {_TIMEOUT_S}s (this "
+                f"process submitted {mine}) — peers are running a "
+                f"different program or have stalled")
         time.sleep(_POLL_S)
-    per_rank = {r: kv.get(f"{base}/{r}") for r in expected}
-    if len(set(per_rank.values())) > 1:
-        dump = "\n".join(f"  rank {r}: {v}"
-                         for r, v in sorted(per_rank.items()))
+    per_proc = {p: kv.get(f"{base}/{p}") for p in expected}
+    if len(set(per_proc.values())) > 1:
+        dump = "\n".join(f"  rank {p}: {v}"
+                         for p, v in sorted(per_proc.items()))
         raise HorovodTpuError(
             f"collective consistency check FAILED at collective #{s} — "
             f"ranks submitted different collectives:\n{dump}")

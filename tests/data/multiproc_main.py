@@ -74,6 +74,16 @@ def main():
     results["ps_sum"] = np.asarray(out).tolist()
 
     out_dir = os.environ["HVD_TEST_OUT"]
+
+    # Durable checkpoint under jax.distributed: rank 0 writes the host
+    # snapshot; restore broadcasts so every rank gets rank 0's state.
+    from horovod_tpu.utils import checkpoint as ckpt_mod
+    mgr = ckpt_mod.CheckpointManager(os.path.join(out_dir, "ckpt"))
+    wrote = mgr.save(1, {"w": jnp.full((3,), 1.0 + rank)})
+    assert wrote == (rank == 0)
+    restored = mgr.restore_latest()
+    results["ckpt"] = np.asarray(restored["w"]).tolist()
+
     with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
         json.dump(results, f)
 

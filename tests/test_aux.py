@@ -179,6 +179,10 @@ class TestCheckpointManager:
     """Durable checkpointing (reference: rank-0 saves in the examples /
     keras callbacks; SURVEY §5 checkpoint/resume) via orbax."""
 
+    @pytest.fixture(autouse=True)
+    def _require_orbax(self):
+        pytest.importorskip("orbax.checkpoint")
+
     def test_save_restore_roundtrip(self, tmp_path):
         import jax.numpy as jnp
 

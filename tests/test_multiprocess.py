@@ -67,6 +67,9 @@ class TestCrossProcessCollectives:
         # Singleton process sets at np=2: each rank reduces alone.
         assert results[0]["ps_sum"] == [1.0]
         assert results[1]["ps_sum"] == [2.0]
+        # Checkpoint: rank 0 wrote; both ranks restored rank 0's state.
+        for rank in (0, 1):
+            assert results[rank]["ckpt"] == [1.0, 1.0, 1.0]
 
     def test_four_process_collectives(self, tmp_path):
         """np=4 (reference floor is 2 processes; SURVEY §4 says go

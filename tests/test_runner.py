@@ -315,3 +315,28 @@ class TestRunAPI:
 def _rank_times_two():
     import os
     return int(os.environ["HOROVOD_RANK"]) * 2
+
+
+class TestRunAPIFullSignature:
+    """Reference horovod.run's flag surface: hostfile, elastic routing,
+    compat no-op backend selectors."""
+
+    def test_run_with_hostfile(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("XLA_FLAGS", raising=False)
+        hf = tmp_path / "hosts"
+        hf.write_text("localhost slots=2\n")
+        from horovod_tpu.runner import run
+        assert run(_rank_times_two, np=2, hostfile=str(hf),
+                   use_gloo=True, use_mpi=False) == [0, 2]
+
+    def test_run_elastic_via_discovery_script(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("XLA_FLAGS", raising=False)
+        hosts = tmp_path / "h.txt"
+        hosts.write_text("localhost:2\n")
+        script = tmp_path / "d.sh"
+        script.write_text(f"#!/bin/sh\ncat {hosts}\n")
+        script.chmod(0o755)
+        from horovod_tpu.runner import run
+        out = run(_rank_times_two, np=2, min_np=2, slots=2,
+                  host_discovery_script=str(script))
+        assert sorted(out) == [0, 2]
