@@ -79,6 +79,13 @@ def run(
     if use_mpi:
         logger.warning("use_mpi ignored: the single backend is XLA "
                        "collectives (see README)")
+    if host_discovery_script and (hosts or hostfile):
+        raise ValueError(
+            "hosts/hostfile conflict with host_discovery_script: elastic "
+            "membership comes from the discovery script (reference: "
+            "horovodrun rejects the combination)")
+    if hosts and hostfile:
+        raise ValueError("pass either hosts or hostfile, not both")
     if host_discovery_script:
         from .executor import ElasticExecutor
 

@@ -362,11 +362,13 @@ class ElasticExecutor:
                 if raw is not None:
                     results.append(pickle.loads(base64.b64decode(raw)))
 
-        rc = elastic_run(settings, result_hook=collect)
         try:
-            os.unlink(func_file)
-        except OSError:
-            pass
+            rc = elastic_run(settings, result_hook=collect)
+        finally:
+            try:
+                os.unlink(func_file)
+            except OSError:
+                pass
         if rc != 0:
             raise HorovodTpuError(
                 f"ElasticExecutor run failed with exit code {rc}")

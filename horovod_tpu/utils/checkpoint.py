@@ -58,18 +58,22 @@ class CheckpointManager:
         self._dir = os.path.abspath(directory)
         self._keep = max_to_keep
         self._orbax = None
-        if not self._multiprocess():
-            import orbax.checkpoint as ocp
-
-            options = ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True)
-            self._orbax = ocp.CheckpointManager(self._dir, options=options)
-        elif basics.rank() == 0:
-            os.makedirs(self._dir, exist_ok=True)
 
     @staticmethod
     def _multiprocess() -> bool:
         return basics.is_initialized() and basics.num_processes() > 1
+
+    def _orbax_mgr(self):
+        """Single-process backend, created lazily: the runtime mode is
+        decided per CALL, not at construction — a manager built before
+        `hvd.init()` must still take the multi-process path afterwards."""
+        if self._orbax is None:
+            import orbax.checkpoint as ocp
+
+            options = ocp.CheckpointManagerOptions(
+                max_to_keep=self._keep, create=True)
+            self._orbax = ocp.CheckpointManager(self._dir, options=options)
+        return self._orbax
 
     # -- write -----------------------------------------------------------
     def save(self, step: int, state: Any, force: bool = False) -> bool:
@@ -77,15 +81,16 @@ class CheckpointManager:
         durable data (the Horovod convention — every example and keras
         callback in the reference guards on `hvd.rank() == 0`); other
         ranks no-op and return False."""
-        if self._orbax is not None:
+        if not self._multiprocess():
             import orbax.checkpoint as ocp
 
-            self._orbax.save(step, args=ocp.args.StandardSave(state),
-                             force=force)
-            self._orbax.wait_until_finished()
+            mgr = self._orbax_mgr()
+            mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+            mgr.wait_until_finished()
             return True
         if basics.rank() != 0:
             return False
+        os.makedirs(self._dir, exist_ok=True)
         host = _to_host(state)
         final = os.path.join(self._dir, f"step_{step}")
         tmp = final + ".tmp"
@@ -116,25 +121,40 @@ class CheckpointManager:
         return sorted(steps)
 
     # -- read ------------------------------------------------------------
-    def latest_step(self) -> Optional[int]:
-        if self._orbax is not None:
-            return self._orbax.latest_step()
+    def _local_latest(self) -> Optional[int]:
+        if not self._multiprocess():
+            return self._orbax_mgr().latest_step()
         steps = self._pickle_steps()
         return steps[-1] if steps else None
 
+    def latest_step(self) -> Optional[int]:
+        """Latest persisted step — rank-0's view broadcast to all, so
+        `if mgr.latest_step(): restore()` is collectively safe even when
+        the files exist only on rank 0's disk."""
+        if not self._multiprocess():
+            return self._local_latest()
+        from ..ops.functions import broadcast_object
+
+        mine = self._local_latest() if basics.rank() == 0 else None
+        return broadcast_object(mine, root_rank=0)
+
     def all_steps(self) -> List[int]:
-        if self._orbax is not None:
-            return list(self._orbax.all_steps())
-        return self._pickle_steps()
+        if not self._multiprocess():
+            return list(self._orbax_mgr().all_steps())
+        from ..ops.functions import broadcast_object
+
+        mine = self._pickle_steps() if basics.rank() == 0 else None
+        return broadcast_object(mine, root_rank=0)
 
     def _read(self, step: int, template: Any) -> Any:
-        if self._orbax is not None:
+        if not self._multiprocess():
             import orbax.checkpoint as ocp
 
+            mgr = self._orbax_mgr()
             if template is not None:
-                return self._orbax.restore(
+                return mgr.restore(
                     step, args=ocp.args.StandardRestore(template))
-            return self._orbax.restore(step)
+            return mgr.restore(step)
         with open(os.path.join(self._dir, f"step_{step}", "state.pkl"),
                   "rb") as f:
             return pickle.load(f)
@@ -170,11 +190,13 @@ class CheckpointManager:
 
     def restore_latest(self, template: Any = None) -> Optional[Any]:
         if not self._multiprocess():
-            step = self.latest_step()
+            step = self._local_latest()
             if step is None:
                 return None
             return self._read(step, template)
-        return self._restore_bcast(self.latest_step, template)
+        # _local_latest, NOT latest_step: the chooser runs on rank 0
+        # inside the broadcast and must not itself be collective.
+        return self._restore_bcast(self._local_latest, template)
 
     def close(self) -> None:
         if self._orbax is not None:

@@ -127,11 +127,11 @@ def check(sig: Dict[str, Any], ranks=None) -> None:
         time.sleep(_POLL_S)
     per_proc = {p: kv.get(f"{base}/{p}") for p in expected}
     if len(set(per_proc.values())) > 1:
-        dump = "\n".join(f"  rank {p}: {v}"
+        dump = "\n".join(f"  process {p}: {v}"
                          for p, v in sorted(per_proc.items()))
         raise HorovodTpuError(
             f"collective consistency check FAILED at collective #{s} — "
-            f"ranks submitted different collectives:\n{dump}")
+            f"processes submitted different collectives:\n{dump}")
     if s >= _GC_LAG:
         try:
             kv.delete(f"{_ns()}/{setid}/{s - _GC_LAG}/{me}")

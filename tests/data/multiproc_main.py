@@ -83,6 +83,8 @@ def main():
     assert wrote == (rank == 0)
     restored = mgr.restore_latest()
     results["ckpt"] = np.asarray(restored["w"]).tolist()
+    # latest_step is collectively safe (rank-0 view broadcast).
+    results["ckpt_latest"] = mgr.latest_step()
 
     with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
         json.dump(results, f)

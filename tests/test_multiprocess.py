@@ -70,6 +70,7 @@ class TestCrossProcessCollectives:
         # Checkpoint: rank 0 wrote; both ranks restored rank 0's state.
         for rank in (0, 1):
             assert results[rank]["ckpt"] == [1.0, 1.0, 1.0]
+            assert results[rank]["ckpt_latest"] == 1
 
     def test_four_process_collectives(self, tmp_path):
         """np=4 (reference floor is 2 processes; SURVEY §4 says go
@@ -219,4 +220,4 @@ class TestCollectiveConsistencyCheck:
         out = r.stdout + r.stderr
         assert r.returncode != 0
         assert "consistency check FAILED" in out, out
-        assert "rank 0:" in out and "rank 1:" in out, out
+        assert "process 0:" in out and "process 1:" in out, out

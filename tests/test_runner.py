@@ -340,3 +340,11 @@ class TestRunAPIFullSignature:
         out = run(_rank_times_two, np=2, min_np=2, slots=2,
                   host_discovery_script=str(script))
         assert sorted(out) == [0, 2]
+
+    def test_conflicting_host_sources_rejected(self):
+        from horovod_tpu.runner import run
+        with pytest.raises(ValueError, match="conflict"):
+            run(_rank_times_two, np=2, hosts="a:2",
+                host_discovery_script="/bin/true")
+        with pytest.raises(ValueError, match="not both"):
+            run(_rank_times_two, np=2, hosts="a:2", hostfile="/tmp/hf")
