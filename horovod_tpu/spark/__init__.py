@@ -11,9 +11,10 @@ task derives its Horovod env (rank = partition id, coordinator = task
 server, and `jax.distributed` does the heavy bootstrap — so the rsh/
 mpirun machinery and task-service RPC disappear entirely.
 
-The Spark Estimator API (KerasEstimator/TorchEstimator, ≈6k LoC) is NOT
-reproduced: it is a Spark-ML-DataFrame product surface orthogonal to
-distributed training; see README "Excluded components".
+The Spark Estimator API lives in `horovod_tpu.spark.keras` /
+`horovod_tpu.spark.torch` (`KerasEstimator`, `TorchEstimator`) over the
+`common/` store+backend machinery; estimators also work WITHOUT Spark
+(pandas DataFrame in, local worker processes) — see spark/common/.
 
     import horovod_tpu.spark
     results = horovod_tpu.spark.run(train_fn, args=(cfg,), num_proc=4)

@@ -1,0 +1,179 @@
+"""Estimator data/artifact store.
+
+Reference parity: `horovod/spark/common/store.py` (`Store`,
+`LocalStore`, `HDFSStore`, `DBFSLocalStore` ≈900 LoC) — the filesystem
+abstraction Spark estimators use for three things: intermediate
+training data materialized from the DataFrame, checkpoints, and logs.
+
+TPU-native redesign: the reference materializes DataFrames to Parquet
+and reads them back through Petastorm.  Here intermediate shards are
+**numpy `.npz` part files, one per worker rank** — the loader is
+`np.load` (zero extra deps, mmap-friendly) and the shard count is the
+worker count, so each worker reads exactly one file.  Checkpoints are
+single pickled blobs written atomically (tmp + rename).
+
+`Store.create(prefix)` mirrors the reference factory: local paths (and
+`file://`) get a `LocalStore`; remote schemes (`hdfs://`, `s3://`,
+`dbfs:/`) raise with a pointer to what a cluster deployment would plug
+in, since those client libraries are not in this environment.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import uuid
+from typing import List, Optional
+
+from ...common.exceptions import HorovodTpuError
+
+_REMOTE_SCHEMES = ("hdfs://", "s3://", "s3a://", "s3n://", "gs://",
+                   "dbfs:/", "abfs://", "abfss://", "wasb://",
+                   "wasbs://")
+
+
+class Store:
+    """Abstract store (reference: store.py `Store`)."""
+
+    @staticmethod
+    def create(prefix_path: Optional[str] = None, **kwargs) -> "Store":
+        if prefix_path is None:
+            return LocalStore(None, **kwargs)
+        for scheme in _REMOTE_SCHEMES:
+            if prefix_path.lower().startswith(scheme):
+                raise HorovodTpuError(
+                    f"Store.create: scheme {scheme!r} needs a remote "
+                    "filesystem client (reference: HDFSStore via pyarrow, "
+                    "DBFSLocalStore); none is available in this "
+                    "environment — pass a local path or mount the remote "
+                    "store locally")
+        if prefix_path.startswith("file://"):
+            prefix_path = prefix_path[len("file://"):]
+        return LocalStore(prefix_path, **kwargs)
+
+    # -- path layout (names follow the reference API) --
+    def get_run_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_train_data_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    # -- io --
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def list_dir(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalStore(Store):
+    """Filesystem store (reference: store.py `LocalStore`).
+
+    `prefix_path=None` creates a private temp directory owned by this
+    store (removed by `cleanup()`), the pattern the reference tests use
+    with `tempdir` fixtures.
+    """
+
+    def __init__(self, prefix_path: Optional[str] = None):
+        if prefix_path is None:
+            self._prefix = tempfile.mkdtemp(prefix="hvd_tpu_store_")
+            self._owns_prefix = True
+        else:
+            self._prefix = os.path.abspath(prefix_path)
+            self._owns_prefix = False
+            os.makedirs(self._prefix, exist_ok=True)
+
+    @property
+    def prefix_path(self) -> str:
+        return self._prefix
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self._prefix, "runs", run_id)
+
+    def get_train_data_path(self, run_id: str) -> str:
+        return os.path.join(self._prefix, "intermediate_train_data", run_id)
+
+    def get_val_data_path(self, run_id: str) -> str:
+        return os.path.join(self._prefix, "intermediate_val_data", run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), CHECKPOINT_FILE)
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def mkdirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def list_dir(self, path: str) -> List[str]:
+        return sorted(os.listdir(path)) if os.path.isdir(path) else []
+
+    def saving_runs(self) -> List[str]:
+        """Run ids with artifacts (reference: Store.get_runs analog)."""
+        return self.list_dir(os.path.join(self._prefix, "runs"))
+
+    def cleanup(self) -> None:
+        if self._owns_prefix and os.path.isdir(self._prefix):
+            shutil.rmtree(self._prefix, ignore_errors=True)
+
+
+# Part-file naming shared by writer (util.py) and the remote trainers.
+def part_name(rank: int) -> str:
+    return f"part-{rank:05d}.npz"
+
+
+# Single source of truth for the checkpoint filename used by
+# Store.get_checkpoint_path and the remote trainers' save_checkpoint.
+CHECKPOINT_FILE = "checkpoint.pkl"
+
+
+def save_checkpoint(run_path: str, payload) -> str:
+    """Atomically pickle `payload` to `<run_path>/checkpoint.pkl`
+    (shared by the keras/torch remote trainers; same tmp+rename
+    pattern as LocalStore.write_bytes)."""
+    import pickle
+
+    os.makedirs(run_path, exist_ok=True)
+    ckpt = os.path.join(run_path, CHECKPOINT_FILE)
+    tmp = f"{ckpt}.tmp.{uuid.uuid4().hex[:8]}"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f)
+    os.replace(tmp, ckpt)
+    return ckpt
+
+
+__all__ = ["Store", "LocalStore", "part_name", "CHECKPOINT_FILE",
+           "save_checkpoint"]

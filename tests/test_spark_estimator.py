@@ -1,0 +1,375 @@
+"""Spark Estimator tests (reference: test/single/test_spark.py estimator
+sections + test_spark_keras.py / test_spark_torch.py — estimator fit on
+tiny DataFrames against a local cluster; store backends against temp
+dirs).
+
+Here the "cluster" is the LocalBackend (real worker processes through
+runner/api.run on the CPU platform) and DataFrames are pandas — the
+exact degrade path the estimator layer documents.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.spark.common import (
+    EstimatorParams, LocalBackend, LocalStore, Store,
+)
+from horovod_tpu.spark.common.util import load_shard, prepare_data
+
+
+def make_df(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = 2.0 * x1 - 1.0 * x2 + 0.5
+    return pd.DataFrame({"x1": x1, "x2": x2, "y": y.astype(np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# Store
+# ---------------------------------------------------------------------------
+
+class TestStore:
+    def test_create_local(self, tmp_path):
+        s = Store.create(str(tmp_path / "store"))
+        assert isinstance(s, LocalStore)
+        assert s.prefix_path == str(tmp_path / "store")
+
+    def test_create_file_scheme(self, tmp_path):
+        s = Store.create(f"file://{tmp_path}/fs")
+        assert s.prefix_path == f"{tmp_path}/fs"
+
+    @pytest.mark.parametrize("url", ["hdfs://nn/x", "s3://b/x", "dbfs:/x",
+                                     "abfss://c@a/x", "HDFS://nn/x"])
+    def test_remote_schemes_raise(self, url):
+        with pytest.raises(HorovodTpuError, match="remote filesystem"):
+            Store.create(url)
+
+    def test_paths_and_atomic_write(self, tmp_path):
+        s = Store.create(str(tmp_path))
+        assert "intermediate_train_data" in s.get_train_data_path("r1")
+        assert s.get_checkpoint_path("r1").startswith(s.get_run_path("r1"))
+        p = os.path.join(s.get_run_path("r1"), "blob.bin")
+        s.write_bytes(p, b"abc")
+        assert s.read_bytes(p) == b"abc"
+        assert not [f for f in os.listdir(os.path.dirname(p))
+                    if ".tmp." in f]
+
+    def test_owned_tempdir_cleanup(self):
+        s = Store.create(None)
+        prefix = s.prefix_path
+        assert os.path.isdir(prefix)
+        s.cleanup()
+        assert not os.path.exists(prefix)
+
+
+# ---------------------------------------------------------------------------
+# Params machinery
+# ---------------------------------------------------------------------------
+
+class TestParams:
+    def test_constructor_and_fluent_accessors(self):
+        p = EstimatorParams(batch_size=16)
+        assert p.batch_size == 16
+        assert p.setEpochs(7) is p
+        assert p.getEpochs() == 7
+        assert p.epochs == 7
+
+    def test_camel_case_accessors_map_to_snake_params(self):
+        p = (EstimatorParams().setFeatureCols(["x1"]).setLabelCols(["y"])
+             .setBatchSize(8).setRandomSeed(3))
+        assert p.feature_cols == ["x1"]
+        assert p.getLabelCols() == ["y"]
+        assert p.batch_size == 8 and p.random_seed == 3
+
+    def test_unknown_param_raises(self):
+        with pytest.raises(TypeError, match="unknown params"):
+            EstimatorParams(nonsense=1)
+
+    def test_unknown_attribute_raises(self):
+        with pytest.raises(AttributeError):
+            EstimatorParams().setNonsense  # noqa: B018
+
+
+# ---------------------------------------------------------------------------
+# Data materialization
+# ---------------------------------------------------------------------------
+
+class TestPrepareData:
+    def test_shards_equal_size(self, tmp_path):
+        # 20 rows / 3 shards: equal 6-row shards, remainder 2 dropped —
+        # unequal shards would desynchronize per-batch collectives.
+        df = make_df(20)
+        s = Store.create(str(tmp_path))
+        meta = prepare_data(df, s, "r", 3, ["x1", "x2"], ["y"],
+                            shuffle=False)
+        assert meta == {"train_rows": 18, "val_rows": 0,
+                        "features_dim": 2, "labels_dim": 1}
+        xs = []
+        for r in range(3):
+            x, y = load_shard(s.get_train_data_path("r"), r)
+            assert x.shape == (6, 2) and y.shape == (6, 1)
+            xs.append(x)
+        got = np.concatenate(xs)[:, 0]
+        # shards partition (a subset of) the input, no duplicates
+        assert len(np.unique(got)) == 18
+        assert set(got).issubset(set(df["x1"].to_numpy()))
+
+    def test_even_split_covers_all_rows(self, tmp_path):
+        s = Store.create(str(tmp_path))
+        meta = prepare_data(make_df(24), s, "r", 3, ["x1"], ["y"])
+        assert meta["train_rows"] == 24
+
+    def test_validation_fraction_replicated(self, tmp_path):
+        s = Store.create(str(tmp_path))
+        meta = prepare_data(make_df(40), s, "r", 2, ["x1"], ["y"],
+                            validation=0.25, seed=1)
+        assert meta["val_rows"] == 10
+        xv0, _ = load_shard(s.get_val_data_path("r"), 0)
+        xv1, _ = load_shard(s.get_val_data_path("r"), 1)
+        assert np.array_equal(xv0, xv1)
+
+    def test_validation_column(self, tmp_path):
+        df = make_df(10)
+        df["is_val"] = [True] * 3 + [False] * 7
+        s = Store.create(str(tmp_path))
+        meta = prepare_data(df, s, "r", 2, ["x1"], ["y"],
+                            validation="is_val")
+        # 7 train rows → equal shards of 3, remainder dropped
+        assert meta == dict(meta, train_rows=6, val_rows=3)
+
+    def test_too_few_rows_raises(self, tmp_path):
+        with pytest.raises(HorovodTpuError, match="needs at least one row"):
+            prepare_data(make_df(2), Store.create(str(tmp_path)), "r", 4,
+                         ["x1"], ["y"])
+
+    def test_missing_column_raises(self, tmp_path):
+        with pytest.raises(HorovodTpuError, match="not in DataFrame"):
+            prepare_data(make_df(8), Store.create(str(tmp_path)), "r", 2,
+                         ["nope"], ["y"])
+
+    def test_array_valued_cells_flatten(self, tmp_path):
+        df = pd.DataFrame({
+            "img": [np.ones((2, 2), np.float32) * i for i in range(6)],
+            "y": np.arange(6, dtype=np.float32),
+        })
+        s = Store.create(str(tmp_path))
+        meta = prepare_data(df, s, "r", 2, ["img"], ["y"], shuffle=False)
+        assert meta["features_dim"] == 4
+
+    def test_integer_labels_preserved(self, tmp_path):
+        df = pd.DataFrame({"x1": np.arange(8, dtype=np.float32),
+                           "cls": np.arange(8) % 3})
+        s = Store.create(str(tmp_path))
+        prepare_data(df, s, "r", 2, ["x1"], ["cls"], shuffle=False)
+        _, y = load_shard(s.get_train_data_path("r"), 0)
+        assert y.dtype == np.int64
+
+    def test_validation_column_typo_raises(self, tmp_path):
+        with pytest.raises(HorovodTpuError, match="validation column"):
+            prepare_data(make_df(8), Store.create(str(tmp_path)), "r", 2,
+                         ["x1"], ["y"], validation="is_vall")
+
+    def test_output_frame_shape_mismatch_raises(self):
+        from horovod_tpu.spark.common.util import to_output_frame
+
+        pdf = make_df(4)
+        with pytest.raises(HorovodTpuError, match="outputs per row"):
+            to_output_frame(pdf, ["mu", "sigma"], np.zeros((4, 3)))
+
+    def test_output_frame_single_col_array_preds(self):
+        from horovod_tpu.spark.common.util import to_output_frame
+
+        out = to_output_frame(make_df(4), ["p"], np.zeros((4, 3)))
+        assert len(out["p"][0]) == 3
+
+
+class TestOptimizerRecipe:
+    def test_param_groups_preserved(self):
+        import torch
+
+        from horovod_tpu.spark.torch import (
+            _build_optimizer, _optimizer_recipe,
+        )
+
+        net = torch.nn.Sequential(torch.nn.Linear(2, 4),
+                                  torch.nn.Linear(4, 1))
+        opt = torch.optim.SGD([
+            {"params": net[0].parameters(), "lr": 0.01},
+            {"params": net[1].parameters(), "lr": 0.001, "momentum": 0.5},
+        ], lr=0.1)
+        recipe = _optimizer_recipe(opt)
+        # Simulate the worker: same architecture, fresh params.
+        net2 = torch.nn.Sequential(torch.nn.Linear(2, 4),
+                                   torch.nn.Linear(4, 1))
+        rebuilt = _build_optimizer(recipe, net2)
+        assert len(rebuilt.param_groups) == 2
+        assert rebuilt.param_groups[0]["lr"] == 0.01
+        assert rebuilt.param_groups[1]["lr"] == 0.001
+        assert rebuilt.param_groups[1]["momentum"] == 0.5
+        assert rebuilt.param_groups[0]["params"] == list(
+            net2[0].parameters())
+
+    def test_param_count_mismatch_raises(self):
+        import torch
+
+        from horovod_tpu.spark.torch import (
+            _build_optimizer, _optimizer_recipe,
+        )
+
+        net = torch.nn.Linear(2, 1)
+        recipe = _optimizer_recipe(torch.optim.SGD([net.weight], lr=0.1))
+        with pytest.raises(HorovodTpuError, match="covered 1 params"):
+            _build_optimizer(recipe, net)  # model has weight+bias = 2
+
+
+# ---------------------------------------------------------------------------
+# Estimator validation (fast, no workers)
+# ---------------------------------------------------------------------------
+
+class TestEstimatorValidation:
+    def test_missing_model_raises(self):
+        from horovod_tpu.spark.torch import TorchEstimator
+
+        with pytest.raises(HorovodTpuError, match="model is required"):
+            TorchEstimator(feature_cols=["x1"], label_cols=["y"]).fit(
+                make_df(8))
+
+    def test_missing_cols_raises(self):
+        from horovod_tpu.spark.torch import TorchEstimator
+
+        with pytest.raises(HorovodTpuError, match="feature_cols"):
+            TorchEstimator(model=object()).fit(make_df(8))
+
+    def test_torch_callbacks_raise(self):
+        import torch
+
+        from horovod_tpu.spark.torch import TorchEstimator
+
+        net = torch.nn.Linear(2, 1)
+        est = TorchEstimator(model=net,
+                             optimizer=torch.optim.SGD(net.parameters(),
+                                                       lr=0.1),
+                             loss=torch.nn.functional.mse_loss,
+                             callbacks=[object()],
+                             feature_cols=["x1"], label_cols=["y"],
+                             backend=LocalBackend(1))
+        with pytest.raises(HorovodTpuError, match="does not take callbacks"):
+            est.fit(make_df(8))
+
+    def test_cluster_spark_backend_rejects_tempdir_store(self, monkeypatch):
+        import sys
+        import types
+
+        from horovod_tpu.spark.common.backend import SparkBackend
+        from horovod_tpu.spark.common.estimator import HorovodEstimator
+
+        mod = types.ModuleType("pyspark")
+        mod.SparkContext = types.SimpleNamespace(
+            _active_spark_context=types.SimpleNamespace(
+                master="spark://cluster:7077"))
+        monkeypatch.setitem(sys.modules, "pyspark", mod)
+        with pytest.raises(HorovodTpuError, match="shared/NFS"):
+            HorovodEstimator._check_store_reachable(
+                Store.create(None), SparkBackend(2))
+        # explicit user path: accepted (their responsibility)
+        HorovodEstimator._check_store_reachable(
+            Store.create("/tmp/shared_mount_x"), SparkBackend(2))
+
+    def test_bad_torch_optimizer_raises(self):
+        import torch
+
+        from horovod_tpu.spark.torch import TorchEstimator
+
+        net = torch.nn.Linear(2, 1)
+        est = TorchEstimator(model=net, optimizer="sgd",
+                             loss=torch.nn.functional.mse_loss,
+                             feature_cols=["x1", "x2"], label_cols=["y"],
+                             backend=LocalBackend(1))
+        with pytest.raises(HorovodTpuError, match="optimizer must be"):
+            est.fit(make_df(8))
+
+
+# ---------------------------------------------------------------------------
+# End-to-end fits on real local worker processes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.integration
+class TestTorchEstimatorFit:
+    def test_fit_transform_2proc(self, tmp_path):
+        import torch
+
+        from horovod_tpu.spark.torch import TorchEstimator
+
+        torch.manual_seed(0)
+        net = torch.nn.Linear(2, 1)
+        df = make_df(64)
+        est = TorchEstimator(
+            model=net,
+            optimizer=torch.optim.SGD(net.parameters(), lr=0.1),
+            loss=torch.nn.functional.mse_loss,
+            feature_cols=["x1", "x2"], label_cols=["y"],
+            batch_size=16, epochs=8, validation=0.2, random_seed=0,
+            store=Store.create(str(tmp_path)), run_id="torchrun",
+            backend=LocalBackend(2), verbose=0)
+        model = est.fit(df)
+
+        hist = model.get_history()
+        assert len(hist["loss"]) == 8
+        assert hist["loss"][-1] < hist["loss"][0]
+        assert len(hist["val_loss"]) == 8
+
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        preds = np.asarray([float(np.ravel(v)[0]) for v in out["prediction"]])
+        # Linear data, linear model: fit should be decent after 8 epochs.
+        err = np.mean((preds - df["y"].to_numpy()) ** 2)
+        assert err < 0.5, f"mse {err}"
+
+        # Rank-0 checkpoint landed in the store's run path.
+        ckpt = est.store.get_checkpoint_path("torchrun")
+        assert os.path.exists(ckpt)
+
+        # getModel returns a torch module usable directly.
+        m = model.getModel()
+        assert isinstance(m, torch.nn.Module)
+
+
+@pytest.mark.integration
+class TestKerasEstimatorFit:
+    def test_fit_transform_2proc(self, tmp_path):
+        import tensorflow as tf
+
+        from horovod_tpu.spark.keras import KerasEstimator
+
+        tf.keras.utils.set_random_seed(0)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((2,)),
+            tf.keras.layers.Dense(1),
+        ])
+        df = make_df(64)
+        est = KerasEstimator(
+            model=model,
+            optimizer=tf.keras.optimizers.SGD(0.1),
+            loss="mse",
+            feature_cols=["x1", "x2"], label_cols=["y"],
+            batch_size=16, epochs=6, random_seed=0,
+            store=Store.create(str(tmp_path)), run_id="kerasrun",
+            backend=LocalBackend(2), verbose=0)
+        fitted = est.fit(df)
+
+        hist = fitted.get_history()
+        assert len(hist["loss"]) == 6
+        assert hist["loss"][-1] < hist["loss"][0]
+
+        out = fitted.transform(df)
+        assert "prediction" in out.columns
+        preds = np.asarray([float(np.ravel(v)[0]) for v in out["prediction"]])
+        err = np.mean((preds - df["y"].to_numpy()) ** 2)
+        assert err < 0.5, f"mse {err}"
+
+        assert os.path.exists(est.store.get_checkpoint_path("kerasrun"))
