@@ -187,6 +187,21 @@ def init(
             logger.debug("horovod_tpu.init() called twice; ignoring")
             return
 
+        # Honor an explicit JAX_PLATFORMS=cpu request in-process: the
+        # axon sitecustomize pins jax_platforms to "<tpu>,cpu" at
+        # interpreter start regardless of env, so worker processes
+        # launched with JAX_PLATFORMS=cpu (LocalBackend, test harness,
+        # sim children) would otherwise try the accelerator first — and
+        # HANG, not error, when it is wedged, defeating the fallback
+        # list.  Only cpu requests are pinned; accelerator values keep
+        # the registered platform list (and its cpu fallback) intact.
+        env_plat = os.environ.get("JAX_PLATFORMS", "")
+        if env_plat.split(",")[0] == "cpu":
+            try:
+                jax.config.update("jax_platforms", env_plat)
+            except Exception:  # noqa: BLE001 — unknown platform string
+                logger.warning("could not pin jax_platforms=%s", env_plat)
+
         coordinator_address = coordinator_address or util.getenv("COORDINATOR_ADDR")
         if coordinator_address:
             num_processes = num_processes or util.env_int("NUM_PROCESSES", 1)

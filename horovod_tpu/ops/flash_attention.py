@@ -1,0 +1,323 @@
+"""Pallas TPU flash attention (forward + backward).
+
+The reference has NO attention kernels at all — it scales batch, never
+sequence (SURVEY.md §5 "Long-context: absent").  Long context is
+first-class in this framework (`parallel/sequence.py` ring/Ulysses);
+this module supplies the missing on-chip piece: an O(T)-memory
+blockwise attention kernel so the per-shard local attention never
+materializes the [T, T] score matrix in HBM.
+
+Algorithm: standard flash attention — online softmax over K/V blocks
+with f32 running (m, l, acc) carried in VMEM scratch across the
+sequential innermost grid dimension (the canonical TPU reduction
+pattern, same as ops/pallas_kernels.py).  Backward recomputes P
+blockwise from the saved per-row logsumexp L = m + log(l) and
+accumulates dQ (grid over K blocks) and dK/dV (grid over Q blocks) in
+separate kernels, as in the flash-attention-2 formulation.
+
+Causal masking skips whole blocks strictly above the diagonal (they
+contribute nothing), so causal costs ~half the FLOPs of full.
+
+Layout: [B, T, H, D] API (matching parallel/sequence.py), kernels run
+on [B*H, T, D] with block_q = block_k = 128 lanes and D untiled (D is
+64-256 for every config here; padded to 128 lanes minimum by XLA).
+
+`interpret=True` under HOROVOD_PALLAS_INTERPRET=1 / CPU platform keeps
+the numerics CI-covered without a chip (tests/test_flash_attention.py
+checks fwd+grads against the dense oracle in parallel/sequence.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..common import util
+from .pallas_kernels import PALLAS_AVAILABLE, _interpret
+
+if PALLAS_AVAILABLE:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+_NEG = -1e30
+_BLOCK = 128  # q and k block rows (= lane width; min f32 sublane x 16)
+
+
+def flash_enabled() -> bool:
+    """HOROVOD_FLASH_ATTENTION=1 routes transformer/sequence local
+    attention through these kernels (opt-in until measured faster on
+    the target shape — the Adasum-kernel precedent)."""
+    return PALLAS_AVAILABLE and util.env_bool("FLASH_ATTENTION", False)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _causal_mask(s, qi, ki):
+    """Mask scores strictly above the diagonal (only the diagonal block
+    actually mixes masked/unmasked entries; off-diagonal blocks are
+    skipped by the callers' pl.when gates)."""
+    q_pos = qi * _BLOCK + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = ki * _BLOCK + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, num_kb):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: blocks strictly above the diagonal contribute nothing.
+    run = (ki <= qi) if causal else (ki == ki)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)          # (bk, d)
+        v = v_ref[0].astype(jnp.float32)          # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            s = _causal_mask(s, qi, ki)
+        m_prev = m_scr[...]                       # (bq, 128) lanes equal
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)         # (bq, 128)
+        p = jnp.exp(s - m_new[:, :1])              # (bq, bk)
+        corr = jnp.exp(m_prev - m_new)             # (bq, 128)
+        l_scr[...] = l_prev * corr + jnp.sum(
+            p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(ki == num_kb - 1)
+    def _finish():
+        l = l_scr[...][:, :1]
+        # Fully-masked rows (possible only with causal=False and all
+        # -inf inputs) guard: l is > 0 in every supported path.
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0, :, 0] = (m_scr[...] + jnp.log(l_scr[...]))[:, 0]
+
+
+def _fwd(q3, k3, v3, scale, causal):
+    """q3/k3/v3: (BH, T, D) with T % _BLOCK == 0.  Returns (o, lse)."""
+    bh, t, d = q3.shape
+    nq = t // _BLOCK
+    nk = t // _BLOCK
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               num_kb=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, _BLOCK, 1), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+            # trailing singleton: TPU block tiling wants the last dim of
+            # a block to be 128-divisible or equal to the array dim.
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((_BLOCK, 128), jnp.float32),
+            pltpu.VMEM((_BLOCK, 128), jnp.float32),
+            pltpu.VMEM((_BLOCK, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_scr, *, scale, causal, num_kb):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    run = (ki <= qi) if causal else (ki == ki)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]                    # (bq,)
+        delta = delta_ref[0, :, 0]                # (bq,)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki)
+        p = jnp.exp(s - lse[:, None])             # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (bq, bk)
+        ds = p * (dp - delta[:, None]) * scale
+        acc_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kb - 1)
+    def _finish():
+        dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, num_qb):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = (qi >= ki) if causal else (qi == qi)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            s = _causal_mask(s, qi, ki)
+        p = jnp.exp(s - lse[:, None])
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, d)
+
+    @pl.when(qi == num_qb - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd(res, g):
+    q3, k3, v3, o3, lse, scale, causal = res
+    do3 = g[0].astype(jnp.float32)
+    bh, t, d = q3.shape
+    nq = nk = t // _BLOCK
+    # delta_i = sum_d dO_i * O_i  (rowwise), the flash-2 correction term.
+    delta = jnp.sum(do3 * o3.astype(jnp.float32), axis=-1,
+                    keepdims=True)                           # (bh, t, 1)
+
+    qspec = pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, qi, 0))
+    kspec = pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, ki, 0))
+    rowq = pl.BlockSpec((1, _BLOCK, 1), lambda b, qi, ki: (b, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          num_kb=nk),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((_BLOCK, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+
+    # dk/dv: grid walks (kb outer, qb inner sequential).
+    qspec2 = pl.BlockSpec((1, _BLOCK, d), lambda b, ki, qi: (b, qi, 0))
+    kspec2 = pl.BlockSpec((1, _BLOCK, d), lambda b, ki, qi: (b, ki, 0))
+    rowq2 = pl.BlockSpec((1, _BLOCK, 1), lambda b, ki, qi: (b, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          num_qb=nq),
+        grid=(bh, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((_BLOCK, d), jnp.float32),
+                        pltpu.VMEM((_BLOCK, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash3(q3, k3, v3, causal):
+    o, _ = _fwd(q3, k3, v3, 1.0 / math.sqrt(q3.shape[-1]), causal)
+    return o
+
+
+def _flash3_fwd(q3, k3, v3, causal):
+    scale = 1.0 / math.sqrt(q3.shape[-1])
+    o, lse = _fwd(q3, k3, v3, scale, causal)
+    return o, (q3, k3, v3, o, lse, scale, causal)
+
+
+def _flash3_bwd(causal, res, g):
+    return _bwd(res, (g,))
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True):
+    """Flash attention on [B, T, H, D] (same convention as
+    parallel/sequence.py), differentiable, O(T) memory.
+
+    T must be a multiple of 128 (pad upstream; the transformer configs
+    here use power-of-two T).  Numerics: f32 accumulation; output in
+    q.dtype; matches `parallel.sequence.full_attention` to f32 noise.
+    """
+    if not PALLAS_AVAILABLE:
+        raise RuntimeError(
+            "flash_attention requires jax.experimental.pallas, which "
+            "failed to import in this JAX install")
+    B, T, H, D = q.shape
+    if T % _BLOCK:
+        raise ValueError(
+            f"flash_attention needs seq len % {_BLOCK} == 0, got {T}")
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    o3 = _flash3(to3(q), to3(k), to3(v), causal)
+    return o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+__all__ = ["flash_attention", "flash_enabled", "PALLAS_AVAILABLE"]

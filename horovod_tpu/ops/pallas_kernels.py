@@ -47,8 +47,11 @@ _BLOCK_ROWS = 1024
 
 
 def _interpret() -> bool:
+    # The live backend, not just the env var: the test harness switches
+    # to CPU via jax.config after import, leaving JAX_PLATFORMS=axon.
     return util.env_bool("PALLAS_INTERPRET", False) or \
-        os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+        os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or \
+        jax.default_backend() == "cpu"
 
 
 def pallas_enabled(n_elements: int) -> bool:

@@ -73,8 +73,14 @@ class LocalBackend(Backend):
     def run(self, fn, args=(), env=None, np=None):
         from ...runner.api import run as api_run
 
-        worker_env = {"JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS",
-                                                      "cpu")}
+        # Literal "cpu", NOT the parent's value: the parent env usually
+        # carries the accelerator platform, and N local estimator
+        # workers must share host CPU, never race for the one chip.
+        # XLA_FLAGS is cleared for the same reason — an inherited
+        # --xla_force_host_platform_device_count=N (the test harness
+        # sets 8) would give every worker N devices and blow up the
+        # rank numbering (rank = device index under SPMD).
+        worker_env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
         worker_env.update(env or {})
         return api_run(fn, args=args, np=np or self._num_proc,
                        extra_env=worker_env, verbose=self._verbose,

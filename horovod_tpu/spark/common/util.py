@@ -22,6 +22,9 @@ import numpy as np
 from ...common.exceptions import HorovodTpuError
 from .store import Store, part_name
 
+# Single replicated validation file (every rank reads the same data).
+VAL_FILE = "val.npz"
+
 
 def to_pandas(df):
     """Accept a pandas DataFrame or anything exposing `toPandas()`
@@ -135,8 +138,10 @@ def prepare_data(
         shard = tr_idx[r * per_shard:(r + 1) * per_shard]
         _write_npz(store, os.path.join(train_dir, part_name(r)),
                    x[shard], y[shard])
-        if len(va_idx):
-            _write_npz(store, os.path.join(val_dir, part_name(r)), xv, yv)
+    if len(va_idx):
+        # Replicated by design → ONE file all ranks read, not one
+        # identical copy per rank.
+        _write_npz(store, os.path.join(val_dir, VAL_FILE), xv, yv)
     return {
         "train_rows": int(len(tr_idx)),
         "val_rows": int(len(va_idx)),
@@ -157,6 +162,12 @@ def load_shard(data_dir: str, rank: int) -> Tuple[np.ndarray, np.ndarray]:
     """Worker-side: load this rank's part file."""
     path = os.path.join(data_dir, part_name(rank))
     with np.load(path) as z:
+        return z["x"], z["y"]
+
+
+def load_val(val_dir: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Worker-side: load the shared (replicated) validation file."""
+    with np.load(os.path.join(val_dir, VAL_FILE)) as z:
         return z["x"], z["y"]
 
 

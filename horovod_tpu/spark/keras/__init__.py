@@ -25,7 +25,7 @@ import numpy as np
 from ...common.exceptions import HorovodTpuError
 from ..common.estimator import HorovodEstimator, HorovodModel
 from ..common.store import save_checkpoint
-from ..common.util import load_shard
+from ..common.util import load_shard, load_val
 
 
 def _serialize_keras(model, optimizer, loss, metrics, custom_objects):
@@ -79,7 +79,7 @@ def _keras_remote_trainer(spec: Dict[str, Any]):
         y = y[:, 0]
     val = None
     if spec["val_dir"]:
-        xv, yv = load_shard(spec["val_dir"], hvd_k.rank())
+        xv, yv = load_val(spec["val_dir"])
         val = (xv, yv[:, 0] if yv.shape[1] == 1 else yv)
 
     cbs = [hvd_k.callbacks.BroadcastGlobalVariablesCallback(0),
@@ -98,9 +98,10 @@ def _keras_remote_trainer(spec: Dict[str, Any]):
     if hvd_k.rank() != 0:
         return None  # only rank 0 ships the trained model back
     arch_json = raw["arch_json"]
+    weights = model.get_weights()
     save_checkpoint(spec["run_path"], {"arch_json": arch_json,
-                                       "weights": model.get_weights()})
-    return {"weights": model.get_weights(),
+                                       "weights": weights})
+    return {"weights": weights,
             "arch_json": arch_json,
             "history": {k: [float(v) for v in vs]
                         for k, vs in history.history.items()}}

@@ -26,7 +26,7 @@ import numpy as np
 from ...common.exceptions import HorovodTpuError
 from ..common.estimator import HorovodEstimator, HorovodModel
 from ..common.store import save_checkpoint
-from ..common.util import load_shard
+from ..common.util import load_shard, load_val
 
 
 def _optimizer_recipe(optimizer):
@@ -40,7 +40,7 @@ def _optimizer_recipe(optimizer):
         raise HorovodTpuError("TorchEstimator: optimizer is required")
     if isinstance(optimizer, torch.optim.Optimizer):
         groups = [
-            {"n_params": len(g["params"]),
+            {"shapes": [tuple(p.shape) for p in g["params"]],
              "options": {k: v for k, v in g.items() if k != "params"}}
             for g in optimizer.param_groups
         ]
@@ -56,25 +56,35 @@ def _build_optimizer(recipe, model):
     """Rebuild on the worker against the deserialized model's params.
 
     Group structure is restored positionally: the i-th group consumes
-    the next `n_params` of `model.parameters()` — exact when the
+    the next len(shapes) of `model.parameters()` — exact when the
     original optimizer was built over the same module's parameters in
     order (the torch convention; param identity cannot cross pickling).
+    Recorded per-param shapes are checked against what each slot
+    receives, so out-of-order group construction fails loudly instead
+    of silently swapping hyperparameters.
     """
     kind, obj, groups = recipe
     params = list(model.parameters())
     if kind == "factory":
         return obj(params)
-    total = sum(g["n_params"] for g in groups)
+    total = sum(len(g["shapes"]) for g in groups)
     if total != len(params):
         raise HorovodTpuError(
             f"TorchEstimator: optimizer covered {total} params but the "
             f"model has {len(params)}; build the optimizer over exactly "
             "model.parameters() (or pass a factory callable)")
     param_groups, i = [], 0
-    for g in groups:
-        param_groups.append(
-            {"params": params[i:i + g["n_params"]], **g["options"]})
-        i += g["n_params"]
+    for gi, g in enumerate(groups):
+        take = params[i:i + len(g["shapes"])]
+        got = [tuple(p.shape) for p in take]
+        if got != [tuple(s) for s in g["shapes"]]:
+            raise HorovodTpuError(
+                f"TorchEstimator: param group {gi} shapes {g['shapes']} "
+                f"don't match model.parameters() order (got {got}); "
+                "build groups in model.parameters() order or pass a "
+                "factory callable(params) -> Optimizer")
+        param_groups.append({"params": take, **g["options"]})
+        i += len(take)
     return obj(param_groups)
 
 
@@ -111,7 +121,7 @@ def _torch_remote_trainer(spec: Dict[str, Any]):
     yt = _label_tensor(y)
     val = None
     if spec["val_dir"]:
-        xv, yv = load_shard(spec["val_dir"], hvd_t.rank())
+        xv, yv = load_val(spec["val_dir"])
         val = (torch.from_numpy(np.ascontiguousarray(xv)),
                _label_tensor(yv))
     n = len(xt)
@@ -135,7 +145,9 @@ def _torch_remote_trainer(spec: Dict[str, Any]):
         # Cross-rank epoch metric, like the reference's metric averaging.
         avg = float(hvd_t.allreduce(torch.tensor([avg]), name="epoch_loss"))
         losses.append(avg)
-        if val is not None:
+        # Val data is replicated and the forward has no collectives, so
+        # only the rank whose history is returned computes it.
+        if val is not None and hvd_t.rank() == 0:
             model.eval()
             with torch.no_grad():
                 val_losses.append(float(loss_fn(model(val[0]), val[1])))

@@ -69,6 +69,13 @@ class TestExamples:
         out = _run_example("torch_mnist.py", ["--epochs", "1"])
         assert "loss=" in out
 
+    def test_spark_estimator(self):
+        # Spawns its own 2 worker processes (LocalBackend pins them to
+        # CPU with clean XLA_FLAGS itself).
+        out = _run_example("spark_estimator.py", ["--np", "2"],
+                           timeout=420)
+        assert "ok" in out
+
     def test_transformer_lm_mesh(self):
         out = _run_example(
             "transformer_lm.py",

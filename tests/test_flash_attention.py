@@ -1,0 +1,115 @@
+"""Flash-attention kernel tests (vs the dense oracle in
+parallel/sequence.py).  Runs under the Pallas interpreter on the CPU
+platform (conftest forces JAX_PLATFORMS=cpu → interpret mode), the same
+CI pattern as tests/test_pallas_kernels.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops import flash_attention as fa
+from horovod_tpu.parallel import sequence as seq
+
+
+def qkv(B=2, T=256, H=4, D=64, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, T, H, D), dtype) for k in ks)
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense_oracle(self, causal):
+        q, k, v = qkv()
+        o_flash = fa.flash_attention(q, k, v, causal=causal)
+        o_dense = seq.full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(o_flash, o_dense, atol=2e-5, rtol=2e-5)
+
+    def test_bf16_inputs_bf16_output(self):
+        q, k, v = qkv(dtype=jnp.bfloat16)
+        o = fa.flash_attention(q, k, v)
+        assert o.dtype == jnp.bfloat16
+        o_dense = seq.full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            o.astype(np.float32), o_dense.astype(np.float32), atol=3e-2)
+
+    def test_single_block(self):
+        q, k, v = qkv(T=128)
+        np.testing.assert_allclose(
+            fa.flash_attention(q, k, v),
+            seq.full_attention(q, k, v, causal=True), atol=2e-5, rtol=2e-5)
+
+    def test_unaligned_seq_raises(self):
+        q, k, v = qkv(T=100)
+        with pytest.raises(ValueError, match="seq len"):
+            fa.flash_attention(q, k, v)
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_dense_oracle(self, causal):
+        q, k, v = qkv()
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(fn(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss(seq.full_attention), argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gd):
+            scale = float(jnp.abs(b).max())
+            np.testing.assert_allclose(
+                a, b, atol=3e-5 * max(1.0, scale), rtol=1e-4,
+                err_msg=f"d{name}")
+
+    def test_grad_through_jit(self):
+        q, k, v = qkv(T=128)
+        f = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(fa.flash_attention(q, k, v) ** 2)))
+        g = f(q, k, v)
+        assert g.shape == q.shape and bool(jnp.isfinite(g).all())
+
+
+class TestDispatch:
+    def test_full_attention_routes_to_flash_when_enabled(self, monkeypatch):
+        q, k, v = qkv(T=128)
+        calls = []
+        real = fa.flash_attention
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return real(*a, **kw)
+
+        monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "1")
+        monkeypatch.setattr(fa, "flash_attention", spy)
+        out = seq.full_attention(q, k, v, causal=True)
+        assert calls, "flash path not taken"
+        monkeypatch.delenv("HOROVOD_FLASH_ATTENTION")
+        np.testing.assert_allclose(
+            out, seq.full_attention(q, k, v, causal=True),
+            atol=2e-5, rtol=2e-5)
+
+    def test_fallback_on_offset_or_unaligned(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "1")
+        monkeypatch.setattr(fa, "flash_attention",
+                            lambda *a, **k: pytest.fail("must not dispatch"))
+        q, k, v = qkv(T=96)  # unaligned → dense path
+        seq.full_attention(q, k, v, causal=True)
+        q2, k2, v2 = qkv(T=128)
+        seq.full_attention(q2, k2, v2, causal=True, q_offset=64)
+
+    def test_ulysses_uses_flash_local_attention(self, monkeypatch):
+        # Ulysses calls full_attention on the gathered sequence; with the
+        # flag on, the local compute rides the kernel and numerics hold.
+        from horovod_tpu.common.util import force_cpu_platform  # noqa: F401
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:4])
+        if len(devs) < 4:
+            pytest.skip("needs 4 virtual devices")
+        mesh = Mesh(devs, ("sp",))
+        q, k, v = qkv(B=1, T=512, H=4, D=32)
+        dense = seq.ulysses_attention(q, k, v, mesh)
+        monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "1")
+        flash = seq.ulysses_attention(q, k, v, mesh)
+        np.testing.assert_allclose(flash, dense, atol=2e-5, rtol=2e-5)

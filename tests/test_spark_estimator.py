@@ -124,14 +124,17 @@ class TestPrepareData:
         meta = prepare_data(make_df(24), s, "r", 3, ["x1"], ["y"])
         assert meta["train_rows"] == 24
 
-    def test_validation_fraction_replicated(self, tmp_path):
+    def test_validation_fraction_single_shared_file(self, tmp_path):
+        from horovod_tpu.spark.common.util import VAL_FILE, load_val
+
         s = Store.create(str(tmp_path))
         meta = prepare_data(make_df(40), s, "r", 2, ["x1"], ["y"],
                             validation=0.25, seed=1)
         assert meta["val_rows"] == 10
-        xv0, _ = load_shard(s.get_val_data_path("r"), 0)
-        xv1, _ = load_shard(s.get_val_data_path("r"), 1)
-        assert np.array_equal(xv0, xv1)
+        # ONE shared file, not a copy per rank
+        assert s.list_dir(s.get_val_data_path("r")) == [VAL_FILE]
+        xv, yv = load_val(s.get_val_data_path("r"))
+        assert len(xv) == 10 and len(yv) == 10
 
     def test_validation_column(self, tmp_path):
         df = make_df(10)
@@ -213,6 +216,25 @@ class TestOptimizerRecipe:
         assert rebuilt.param_groups[1]["momentum"] == 0.5
         assert rebuilt.param_groups[0]["params"] == list(
             net2[0].parameters())
+
+    def test_out_of_order_groups_raise(self):
+        import torch
+
+        from horovod_tpu.spark.torch import (
+            _build_optimizer, _optimizer_recipe,
+        )
+        from horovod_tpu.common.exceptions import HorovodTpuError
+
+        net = torch.nn.Sequential(torch.nn.Linear(2, 4),
+                                  torch.nn.Linear(4, 1))
+        # Groups in REVERSE of model.parameters() order: silent
+        # positional rebind would swap the lrs — must raise instead.
+        opt = torch.optim.SGD([
+            {"params": net[1].parameters(), "lr": 0.001},
+            {"params": net[0].parameters(), "lr": 0.01},
+        ], lr=0.1)
+        with pytest.raises(HorovodTpuError, match="order"):
+            _build_optimizer(_optimizer_recipe(opt), net)
 
     def test_param_count_mismatch_raises(self):
         import torch
