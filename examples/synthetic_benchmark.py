@@ -18,13 +18,12 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
-from horovod_tpu.models import resnet_apply, resnet_init
+from horovod_tpu.models import zoo_apply, zoo_init, zoo_models
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--model", default="resnet50",
-                   choices=[f"resnet{d}" for d in (18, 34, 50, 101, 152)])
+    p.add_argument("--model", default="resnet50", choices=zoo_models())
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--num-warmup-batches", type=int, default=2)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
@@ -38,8 +37,11 @@ def main():
     args = p.parse_args()
 
     hvd.init()
-    depth = int(args.model.replace("resnet", ""))
-    v = resnet_init(jax.random.PRNGKey(0), depth, num_classes=1000)
+    init_kwargs = ({"image_size": args.image_size}
+                   if args.model == "vgg16" else {})
+    v = zoo_init(args.model, jax.random.PRNGKey(0), num_classes=1000,
+                 **init_kwargs)
+    model_apply = zoo_apply(args.model)
     cfg = v["config"]
     state = {"params": v["params"], "batch_stats": v["batch_stats"]}
 
@@ -64,7 +66,7 @@ def main():
         xb, yb = batch
 
         def loss_fn(p):
-            logits, ns = resnet_apply(
+            logits, ns = model_apply(
                 {"params": p, "batch_stats": state["batch_stats"],
                  "config": cfg},
                 xb, train=True, compute_dtype=jnp.bfloat16,

@@ -2,16 +2,26 @@
 
 - `resnet`: ResNet-18/34/50/101/152 (reference headline benchmark —
   pytorch_synthetic_benchmark.py / tf_cnn_benchmarks, SURVEY.md §6)
+- `inception`: Inception V3 (the reference's ~90%-scaling table row)
+- `vgg`: VGG-16 (the reference's bandwidth-bound ~68%-scaling row)
 - `mnist`: the pytorch_mnist.py Net (BASELINE config 1)
 - `transformer`: flagship sharded transformer (TP/SP/EP/PP-capable) —
   beyond-parity model exercising the full parallelism substrate.
+
+`zoo_init(name, key, ...)` / `zoo_apply(name)` dispatch by
+tf_cnn_benchmarks-style model names ("resnet50", "inception3",
+"vgg16").
 """
+
+import functools as _functools
 
 from .resnet import (  # noqa: F401
     resnet_init,
     resnet_apply,
     resnet50_init,
 )
+from .inception import inception3_apply, inception3_init  # noqa: F401
+from .vgg import vgg16_apply, vgg16_init  # noqa: F401
 from .mnist import (  # noqa: F401
     mnist_cnn_init,
     mnist_cnn_apply,
@@ -25,3 +35,32 @@ from .transformer import (  # noqa: F401
     transformer_pspecs,
     transformer_ref_apply,
 )
+
+
+_ZOO = {
+    **{f"resnet{d}": (_functools.partial(resnet_init, depth=d),
+                      resnet_apply)
+       for d in (18, 34, 50, 101, 152)},
+    "inception3": (inception3_init, inception3_apply),
+    "vgg16": (vgg16_init, vgg16_apply),
+}
+
+
+def zoo_models():
+    """Benchmarkable model names (tf_cnn_benchmarks naming)."""
+    return sorted(_ZOO)
+
+
+def zoo_init(name: str, key, num_classes: int = 1000, **kwargs):
+    if name not in _ZOO:
+        raise ValueError(f"unknown model {name!r}; have {zoo_models()}")
+    init, _ = _ZOO[name]
+    return init(key, num_classes=num_classes, **kwargs)
+
+
+def zoo_apply(name: str):
+    """The (variables, x, train, compute_dtype, axis_name) -> (logits,
+    new_stats) apply fn for a zoo model."""
+    if name not in _ZOO:
+        raise ValueError(f"unknown model {name!r}; have {zoo_models()}")
+    return _ZOO[name][1]

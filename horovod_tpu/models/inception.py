@@ -1,0 +1,256 @@
+"""Inception V3 — the reference's headline ~90%-scaling benchmark model.
+
+Reference parity: `docs/benchmarks.rst` / SURVEY.md §6 reports ≈90%
+scaling efficiency for Inception V3 at 128 GPUs (tf_cnn_benchmarks'
+`inception3`); it sits beside ResNet in the reference's published table.
+
+Architecture per Szegedy et al. 2015 ("Rethinking the Inception
+Architecture", the V3 used by tf_cnn_benchmarks): stem →
+3×InceptionA (35×35) → ReductionA → 4×InceptionB (17×17, factorized
+1×7/7×1) → ReductionB → 2×InceptionC (8×8) → global pool → FC.  The
+auxiliary classifier head is omitted (the benchmark configuration
+trains without aux loss).
+
+TPU-first: NHWC, every conv is conv+BN+relu (f32 BN stats), bf16
+compute, rectangular kernels via layers.conv2d's (kh, kw) form.
+Minimum input 75×75; canonical 299.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+
+# ---------------------------------------------------------------------------
+# conv+BN+relu unit (every Inception conv)
+# ---------------------------------------------------------------------------
+
+def _cbr_init(key, in_ch: int, out_ch: int, kernel, dtype):
+    p = {"conv": L.conv2d_init(key, in_ch, out_ch, kernel, dtype)}
+    p["bn"], stats = L.batchnorm_init(out_ch, dtype)
+    return p, stats
+
+
+def _cbr_apply(p, s, x, stride, padding, train, dt, axis_name):
+    y = L.conv2d_apply(p["conv"], x, stride, padding=padding,
+                       compute_dtype=dt)
+    y, ns = L.batchnorm_apply(p["bn"], s, y, train, axis_name=axis_name)
+    return jax.nn.relu(y), ns
+
+
+class _Builder:
+    """Sequentially-keyed init helper: b.cbr(name, in, out, k) registers
+    a conv-bn unit under `name` and returns its output channels."""
+
+    def __init__(self, key, dtype):
+        self._key = key
+        self._dtype = dtype
+        self.params: Dict[str, Any] = {}
+        self.stats: Dict[str, Any] = {}
+
+    def cbr(self, name: str, in_ch: int, out_ch: int, kernel) -> int:
+        self._key, sub = jax.random.split(self._key)
+        self.params[name], self.stats[name] = _cbr_init(
+            sub, in_ch, out_ch, kernel, self._dtype)
+        return out_ch
+
+
+def _apply(p, s, ns, name, x, train, dt, axis_name,
+           stride=1, padding="SAME"):
+    y, ns[name] = _cbr_apply(p[name], s[name], x, stride, padding,
+                             train, dt, axis_name)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Block definitions: (init channels math mirrors the paper / tf.slim)
+# ---------------------------------------------------------------------------
+
+def _inception_a_init(b: _Builder, pfx: str, in_ch: int,
+                      pool_ch: int) -> int:
+    b.cbr(f"{pfx}/b1x1", in_ch, 64, 1)
+    b.cbr(f"{pfx}/b5x5_1", in_ch, 48, 1)
+    b.cbr(f"{pfx}/b5x5_2", 48, 64, 5)
+    b.cbr(f"{pfx}/b3x3_1", in_ch, 64, 1)
+    b.cbr(f"{pfx}/b3x3_2", 64, 96, 3)
+    b.cbr(f"{pfx}/b3x3_3", 96, 96, 3)
+    b.cbr(f"{pfx}/pool", in_ch, pool_ch, 1)
+    return 64 + 64 + 96 + pool_ch
+
+
+def _inception_a_apply(p, s, ns, pfx, x, train, dt, ax):
+    a = _apply(p, s, ns, f"{pfx}/b1x1", x, train, dt, ax)
+    c = _apply(p, s, ns, f"{pfx}/b5x5_1", x, train, dt, ax)
+    c = _apply(p, s, ns, f"{pfx}/b5x5_2", c, train, dt, ax)
+    d = _apply(p, s, ns, f"{pfx}/b3x3_1", x, train, dt, ax)
+    d = _apply(p, s, ns, f"{pfx}/b3x3_2", d, train, dt, ax)
+    d = _apply(p, s, ns, f"{pfx}/b3x3_3", d, train, dt, ax)
+    e = L.avg_pool(x, 3, 1, padding="SAME")
+    e = _apply(p, s, ns, f"{pfx}/pool", e, train, dt, ax)
+    return jnp.concatenate([a, c, d, e], axis=-1)
+
+
+def _reduction_a_init(b: _Builder, pfx: str, in_ch: int) -> int:
+    b.cbr(f"{pfx}/b3x3", in_ch, 384, 3)
+    b.cbr(f"{pfx}/b3x3dbl_1", in_ch, 64, 1)
+    b.cbr(f"{pfx}/b3x3dbl_2", 64, 96, 3)
+    b.cbr(f"{pfx}/b3x3dbl_3", 96, 96, 3)
+    return 384 + 96 + in_ch  # + max-pooled passthrough
+
+
+def _reduction_a_apply(p, s, ns, pfx, x, train, dt, ax):
+    a = _apply(p, s, ns, f"{pfx}/b3x3", x, train, dt, ax,
+               stride=2, padding="VALID")
+    c = _apply(p, s, ns, f"{pfx}/b3x3dbl_1", x, train, dt, ax)
+    c = _apply(p, s, ns, f"{pfx}/b3x3dbl_2", c, train, dt, ax)
+    c = _apply(p, s, ns, f"{pfx}/b3x3dbl_3", c, train, dt, ax,
+               stride=2, padding="VALID")
+    d = L.max_pool(x, 3, 2, padding="VALID")
+    return jnp.concatenate([a, c, d], axis=-1)
+
+
+def _inception_b_init(b: _Builder, pfx: str, in_ch: int, mid: int) -> int:
+    b.cbr(f"{pfx}/b1x1", in_ch, 192, 1)
+    b.cbr(f"{pfx}/b7x7_1", in_ch, mid, 1)
+    b.cbr(f"{pfx}/b7x7_2", mid, mid, (1, 7))
+    b.cbr(f"{pfx}/b7x7_3", mid, 192, (7, 1))
+    b.cbr(f"{pfx}/b7x7dbl_1", in_ch, mid, 1)
+    b.cbr(f"{pfx}/b7x7dbl_2", mid, mid, (7, 1))
+    b.cbr(f"{pfx}/b7x7dbl_3", mid, mid, (1, 7))
+    b.cbr(f"{pfx}/b7x7dbl_4", mid, mid, (7, 1))
+    b.cbr(f"{pfx}/b7x7dbl_5", mid, 192, (1, 7))
+    b.cbr(f"{pfx}/pool", in_ch, 192, 1)
+    return 192 * 4
+
+
+def _inception_b_apply(p, s, ns, pfx, x, train, dt, ax):
+    a = _apply(p, s, ns, f"{pfx}/b1x1", x, train, dt, ax)
+    c = x
+    for i in (1, 2, 3):
+        c = _apply(p, s, ns, f"{pfx}/b7x7_{i}", c, train, dt, ax)
+    d = x
+    for i in (1, 2, 3, 4, 5):
+        d = _apply(p, s, ns, f"{pfx}/b7x7dbl_{i}", d, train, dt, ax)
+    e = L.avg_pool(x, 3, 1, padding="SAME")
+    e = _apply(p, s, ns, f"{pfx}/pool", e, train, dt, ax)
+    return jnp.concatenate([a, c, d, e], axis=-1)
+
+
+def _reduction_b_init(b: _Builder, pfx: str, in_ch: int) -> int:
+    b.cbr(f"{pfx}/b3x3_1", in_ch, 192, 1)
+    b.cbr(f"{pfx}/b3x3_2", 192, 320, 3)
+    b.cbr(f"{pfx}/b7x7x3_1", in_ch, 192, 1)
+    b.cbr(f"{pfx}/b7x7x3_2", 192, 192, (1, 7))
+    b.cbr(f"{pfx}/b7x7x3_3", 192, 192, (7, 1))
+    b.cbr(f"{pfx}/b7x7x3_4", 192, 192, 3)
+    return 320 + 192 + in_ch
+
+
+def _reduction_b_apply(p, s, ns, pfx, x, train, dt, ax):
+    a = _apply(p, s, ns, f"{pfx}/b3x3_1", x, train, dt, ax)
+    a = _apply(p, s, ns, f"{pfx}/b3x3_2", a, train, dt, ax,
+               stride=2, padding="VALID")
+    c = _apply(p, s, ns, f"{pfx}/b7x7x3_1", x, train, dt, ax)
+    c = _apply(p, s, ns, f"{pfx}/b7x7x3_2", c, train, dt, ax)
+    c = _apply(p, s, ns, f"{pfx}/b7x7x3_3", c, train, dt, ax)
+    c = _apply(p, s, ns, f"{pfx}/b7x7x3_4", c, train, dt, ax,
+               stride=2, padding="VALID")
+    d = L.max_pool(x, 3, 2, padding="VALID")
+    return jnp.concatenate([a, c, d], axis=-1)
+
+
+def _inception_c_init(b: _Builder, pfx: str, in_ch: int) -> int:
+    b.cbr(f"{pfx}/b1x1", in_ch, 320, 1)
+    b.cbr(f"{pfx}/b3x3_1", in_ch, 384, 1)
+    b.cbr(f"{pfx}/b3x3_2a", 384, 384, (1, 3))
+    b.cbr(f"{pfx}/b3x3_2b", 384, 384, (3, 1))
+    b.cbr(f"{pfx}/b3x3dbl_1", in_ch, 448, 1)
+    b.cbr(f"{pfx}/b3x3dbl_2", 448, 384, 3)
+    b.cbr(f"{pfx}/b3x3dbl_3a", 384, 384, (1, 3))
+    b.cbr(f"{pfx}/b3x3dbl_3b", 384, 384, (3, 1))
+    b.cbr(f"{pfx}/pool", in_ch, 192, 1)
+    return 320 + 768 + 768 + 192
+
+
+def _inception_c_apply(p, s, ns, pfx, x, train, dt, ax):
+    a = _apply(p, s, ns, f"{pfx}/b1x1", x, train, dt, ax)
+    c = _apply(p, s, ns, f"{pfx}/b3x3_1", x, train, dt, ax)
+    c = jnp.concatenate([
+        _apply(p, s, ns, f"{pfx}/b3x3_2a", c, train, dt, ax),
+        _apply(p, s, ns, f"{pfx}/b3x3_2b", c, train, dt, ax)], axis=-1)
+    d = _apply(p, s, ns, f"{pfx}/b3x3dbl_1", x, train, dt, ax)
+    d = _apply(p, s, ns, f"{pfx}/b3x3dbl_2", d, train, dt, ax)
+    d = jnp.concatenate([
+        _apply(p, s, ns, f"{pfx}/b3x3dbl_3a", d, train, dt, ax),
+        _apply(p, s, ns, f"{pfx}/b3x3dbl_3b", d, train, dt, ax)], axis=-1)
+    e = L.avg_pool(x, 3, 1, padding="SAME")
+    e = _apply(p, s, ns, f"{pfx}/pool", e, train, dt, ax)
+    return jnp.concatenate([a, c, d, e], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Full model
+# ---------------------------------------------------------------------------
+
+def inception3_init(key, num_classes: int = 1000,
+                    dtype=jnp.float32) -> Dict[str, Any]:
+    b = _Builder(key, dtype)
+    ch = b.cbr("stem/conv1", 3, 32, 3)       # s2 VALID
+    ch = b.cbr("stem/conv2", ch, 32, 3)      # VALID
+    ch = b.cbr("stem/conv3", ch, 64, 3)      # SAME, then maxpool s2
+    ch = b.cbr("stem/conv4", ch, 80, 1)      # VALID
+    ch = b.cbr("stem/conv5", ch, 192, 3)     # VALID, then maxpool s2
+    ch = _inception_a_init(b, "mixed0", ch, pool_ch=32)
+    ch = _inception_a_init(b, "mixed1", ch, pool_ch=64)
+    ch = _inception_a_init(b, "mixed2", ch, pool_ch=64)
+    ch = _reduction_a_init(b, "mixed3", ch)
+    ch = _inception_b_init(b, "mixed4", ch, mid=128)
+    ch = _inception_b_init(b, "mixed5", ch, mid=160)
+    ch = _inception_b_init(b, "mixed6", ch, mid=160)
+    ch = _inception_b_init(b, "mixed7", ch, mid=192)
+    ch = _reduction_b_init(b, "mixed8", ch)
+    ch = _inception_c_init(b, "mixed9", ch)
+    ch = _inception_c_init(b, "mixed10", ch)
+    b._key, hk = jax.random.split(b._key)
+    b.params["head"] = L.dense_init(hk, ch, num_classes, dtype)
+    return {"params": b.params, "batch_stats": b.stats,
+            "config": {"arch": "inception3"}}
+
+
+def inception3_apply(variables: Dict[str, Any], x, train: bool = True,
+                     compute_dtype=jnp.bfloat16,
+                     axis_name: Optional[str] = None
+                     ) -> Tuple[jnp.ndarray, Dict]:
+    """Forward. x: (N, H, W, 3) with H, W >= 75 (299 canonical).
+    Returns (logits_f32, new_batch_stats)."""
+    if x.shape[1] < 75 or x.shape[2] < 75:
+        raise ValueError(
+            f"inception3 needs input >= 75x75 (299 canonical), got "
+            f"{x.shape[1]}x{x.shape[2]}")
+    p, s = variables["params"], variables["batch_stats"]
+    dt, ax = compute_dtype, axis_name
+    ns: Dict[str, Any] = {}
+    y = _apply(p, s, ns, "stem/conv1", x, train, dt, ax,
+               stride=2, padding="VALID")
+    y = _apply(p, s, ns, "stem/conv2", y, train, dt, ax, padding="VALID")
+    y = _apply(p, s, ns, "stem/conv3", y, train, dt, ax)
+    y = L.max_pool(y, 3, 2, padding="VALID")
+    y = _apply(p, s, ns, "stem/conv4", y, train, dt, ax, padding="VALID")
+    y = _apply(p, s, ns, "stem/conv5", y, train, dt, ax, padding="VALID")
+    y = L.max_pool(y, 3, 2, padding="VALID")
+    for pfx in ("mixed0", "mixed1", "mixed2"):
+        y = _inception_a_apply(p, s, ns, pfx, y, train, dt, ax)
+    y = _reduction_a_apply(p, s, ns, "mixed3", y, train, dt, ax)
+    for pfx in ("mixed4", "mixed5", "mixed6", "mixed7"):
+        y = _inception_b_apply(p, s, ns, pfx, y, train, dt, ax)
+    y = _reduction_b_apply(p, s, ns, "mixed8", y, train, dt, ax)
+    for pfx in ("mixed9", "mixed10"):
+        y = _inception_c_apply(p, s, ns, pfx, y, train, dt, ax)
+    y = L.global_avg_pool(y)
+    logits = L.dense_apply(p["head"], y, compute_dtype=dt)
+    return logits.astype(jnp.float32), ns

@@ -69,11 +69,14 @@ def dense_apply(p: Params, x, compute_dtype=None):
 # Conv2D (NHWC / HWIO)
 # ---------------------------------------------------------------------------
 
-def conv2d_init(key, in_ch: int, out_ch: int, kernel: int,
+def conv2d_init(key, in_ch: int, out_ch: int, kernel,
                 dtype=jnp.float32, bias: bool = False) -> Params:
+    """`kernel`: int (square) or (kh, kw) — Inception-style asymmetric
+    1x7/7x1 factorized convs need the rectangular form."""
+    kh, kw_ = (kernel, kernel) if isinstance(kernel, int) else kernel
     kw, kb = jax.random.split(key)
-    fan_in = in_ch * kernel * kernel
-    p = {"kernel": he_normal(kw, (kernel, kernel, in_ch, out_ch),
+    fan_in = in_ch * kh * kw_
+    p = {"kernel": he_normal(kw, (kh, kw_, in_ch, out_ch),
                              fan_in, dtype)}
     if bias:
         p["bias"] = jnp.zeros((out_ch,), dtype)

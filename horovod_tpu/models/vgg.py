@@ -1,0 +1,75 @@
+"""VGG-16 — the reference's bandwidth-bound scaling benchmark.
+
+Reference parity: `docs/benchmarks.rst` / SURVEY.md §6 reports VGG-16
+scaling efficiency (~68% at 128 GPUs — parameter-heavy, fusion-bound)
+alongside ResNet/Inception; tf_cnn_benchmarks' `vgg16` is the model.
+Its 138M parameters (≈90% in the first FC layer) make it the stress
+test for gradient-fusion bandwidth, which is exactly why the reference
+keeps it in the table.
+
+TPU-first: NHWC convs, bf16 compute / f32 params, no batch norm (the
+classic architecture the reference benchmarks), dropout off by default
+(synthetic-benchmark convention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+# (stage convs, channels) — VGG-16 configuration "D".
+_STAGES = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+_FC_DIM = 4096
+
+
+def vgg16_init(key, num_classes: int = 1000, dtype=jnp.float32,
+               image_size: int = 224) -> Dict[str, Any]:
+    """138M params at 224px (≈90% in fc1 — the fusion stress test).
+    `image_size` (multiple of 32) sizes the flatten→fc1 boundary."""
+    if image_size % 32:
+        raise ValueError(f"vgg16 needs image_size % 32 == 0, "
+                         f"got {image_size}")
+    keys = jax.random.split(key, sum(n for n, _ in _STAGES) + 3)
+    params: Dict[str, Any] = {}
+    ki = 0
+    in_ch = 3
+    for si, (n_convs, ch) in enumerate(_STAGES):
+        for ci in range(n_convs):
+            params[f"conv{si}_{ci}"] = L.conv2d_init(
+                keys[ki], in_ch, ch, 3, dtype, bias=True)
+            in_ch = ch
+            ki += 1
+    spatial = image_size // 32
+    flat = spatial * spatial * in_ch
+    params["fc1"] = L.dense_init(keys[ki], flat, _FC_DIM, dtype)
+    params["fc2"] = L.dense_init(keys[ki + 1], _FC_DIM, _FC_DIM, dtype)
+    params["head"] = L.dense_init(keys[ki + 2], _FC_DIM, num_classes, dtype)
+    return {"params": params, "batch_stats": {},
+            "config": {"arch": "vgg16", "image_size": image_size}}
+
+
+def vgg16_apply(variables: Dict[str, Any], x, train: bool = True,
+                compute_dtype=jnp.bfloat16,
+                axis_name: Optional[str] = None):
+    """Forward. x: (N, H, W, 3), H/W a multiple of 32 (224 canonical).
+    Returns (logits_f32, {}) — interface-compatible with resnet_apply
+    (no batch-norm state; axis_name/train accepted for uniformity).
+    """
+    del train, axis_name  # no BN, no dropout in the benchmark config
+    p = variables["params"]
+    y = x
+    for si, (n_convs, _) in enumerate(_STAGES):
+        for ci in range(n_convs):
+            y = L.conv2d_apply(p[f"conv{si}_{ci}"], y, 1,
+                               compute_dtype=compute_dtype)
+            y = jax.nn.relu(y)
+        y = L.max_pool(y, 2, 2)
+    y = y.reshape(y.shape[0], -1)
+    y = jax.nn.relu(L.dense_apply(p["fc1"], y, compute_dtype=compute_dtype))
+    y = jax.nn.relu(L.dense_apply(p["fc2"], y, compute_dtype=compute_dtype))
+    logits = L.dense_apply(p["head"], y, compute_dtype=compute_dtype)
+    return logits.astype(jnp.float32), {}

@@ -104,8 +104,8 @@ def prepare_data(
     dropped): every rank must run the same number of optimizer steps
     per epoch or the per-batch gradient allreduces desynchronize — the
     reference enforces the same via steps_per_epoch over Petastorm
-    readers.  Validation rows are **replicated** to every shard so
-    per-epoch validation metrics need no extra collective.
+    readers.  Validation rows go to ONE shared `val.npz` (`VAL_FILE`,
+    read via `load_val`) since they are identical for every rank.
     Returns metadata {train_rows, val_rows, features_dim, labels_dim};
     train_rows is the post-truncation total actually used.
     """
@@ -191,4 +191,5 @@ def to_output_frame(pdf, output_cols: List[str], preds: np.ndarray):
     return pdf
 
 
-__all__ = ["prepare_data", "load_shard", "to_pandas", "to_output_frame"]
+__all__ = ["prepare_data", "load_shard", "load_val", "VAL_FILE",
+           "to_pandas", "to_output_frame"]

@@ -60,8 +60,9 @@ def _build_optimizer(recipe, model):
     original optimizer was built over the same module's parameters in
     order (the torch convention; param identity cannot cross pickling).
     Recorded per-param shapes are checked against what each slot
-    receives, so out-of-order group construction fails loudly instead
-    of silently swapping hyperparameters.
+    receives — a best-effort guard: out-of-order groups with
+    DISTINCT shapes fail loudly; identically-shaped groups cannot be
+    distinguished positionally (pass a factory callable to be exact).
     """
     kind, obj, groups = recipe
     params = list(model.parameters())
@@ -120,7 +121,10 @@ def _torch_remote_trainer(spec: Dict[str, Any]):
     xt = torch.from_numpy(np.ascontiguousarray(x))
     yt = _label_tensor(y)
     val = None
-    if spec["val_dir"]:
+    # Only rank 0 reports history, so only it loads/evaluates val data
+    # (keras differs: its MetricAverageCallback allreduces val metrics,
+    # so every keras worker needs the val set).
+    if spec["val_dir"] and hvd_t.rank() == 0:
         xv, yv = load_val(spec["val_dir"])
         val = (torch.from_numpy(np.ascontiguousarray(xv)),
                _label_tensor(yv))
@@ -147,7 +151,7 @@ def _torch_remote_trainer(spec: Dict[str, Any]):
         losses.append(avg)
         # Val data is replicated and the forward has no collectives, so
         # only the rank whose history is returned computes it.
-        if val is not None and hvd_t.rank() == 0:
+        if val is not None:
             model.eval()
             with torch.no_grad():
                 val_losses.append(float(loss_fn(model(val[0]), val[1])))

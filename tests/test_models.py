@@ -134,3 +134,77 @@ class TestDistributedResNetStep:
             return np.asarray(t["params"]["stem"]["kernel"])
 
         assert not np.allclose(stem_old, _leaf(new_state))
+
+
+class TestZooModels:
+    """VGG-16 / Inception V3 — the reference's other published scaling
+    table rows (docs/benchmarks.rst, SURVEY.md §6)."""
+
+    def test_zoo_dispatch_and_names(self):
+        from horovod_tpu.models import zoo_apply, zoo_init, zoo_models
+
+        names = zoo_models()
+        assert {"resnet50", "resnet101", "vgg16", "inception3"} <= set(names)
+        with pytest.raises(ValueError, match="unknown model"):
+            zoo_init("alexnet", jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="unknown model"):
+            zoo_apply("alexnet")
+
+    def test_vgg16_canonical_param_count(self):
+        from horovod_tpu.models import zoo_init
+
+        v = zoo_init("vgg16", jax.random.PRNGKey(0))
+        n = sum(x.size for x in jax.tree_util.tree_leaves(v["params"]))
+        assert n == 138_357_544  # torchvision/tf_cnn_benchmarks vgg16
+
+    def test_vgg16_forward_small(self):
+        from horovod_tpu.models import zoo_apply, zoo_init
+
+        v = zoo_init("vgg16", jax.random.PRNGKey(0), num_classes=10,
+                     image_size=32)
+        logits, ns = zoo_apply("vgg16")(
+            v, jnp.ones((2, 32, 32, 3)), train=True)
+        assert logits.shape == (2, 10) and logits.dtype == jnp.float32
+        assert ns == {}
+
+    def test_vgg16_bad_image_size(self):
+        from horovod_tpu.models import zoo_init
+
+        with pytest.raises(ValueError, match="image_size"):
+            zoo_init("vgg16", jax.random.PRNGKey(0), image_size=100)
+
+    def test_inception3_canonical_param_count(self):
+        from horovod_tpu.models import zoo_init
+
+        v = zoo_init("inception3", jax.random.PRNGKey(0))
+        n = sum(x.size for x in jax.tree_util.tree_leaves(v["params"]))
+        assert n == 23_834_568  # tf.slim inception_v3 (no aux head)
+
+    def test_inception3_forward_min_size_and_stats(self):
+        from horovod_tpu.models import zoo_apply, zoo_init
+
+        v = zoo_init("inception3", jax.random.PRNGKey(0), num_classes=10)
+        logits, ns = zoo_apply("inception3")(
+            v, jnp.ones((1, 75, 75, 3)), train=True)
+        assert logits.shape == (1, 10)
+        # every conv-bn unit reports updated stats
+        assert set(ns) == set(v["batch_stats"])
+
+    def test_vgg16_train_step_updates(self):
+        from horovod_tpu.models import zoo_apply, zoo_init
+
+        v = zoo_init("vgg16", jax.random.PRNGKey(0), num_classes=10,
+                     image_size=32)
+        apply = zoo_apply("vgg16")
+
+        def loss_fn(p):
+            logits, _ = apply({"params": p, "batch_stats": {},
+                               "config": v["config"]},
+                              jnp.ones((2, 32, 32, 3)), train=True,
+                              compute_dtype=jnp.float32)
+            return -jnp.mean(jax.nn.log_softmax(logits)[:, 0])
+
+        g = jax.grad(loss_fn)(v["params"])
+        gn = sum(float(jnp.abs(x).sum())
+                 for x in jax.tree_util.tree_leaves(g))
+        assert np.isfinite(gn) and gn > 0
