@@ -28,13 +28,17 @@ def main():
     p.add_argument("--num-warmup-batches", type=int, default=2)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
     p.add_argument("--num-iters", type=int, default=3)
-    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--image-size", type=int, default=None,
+                   help="default: 299 for inception3 (its canonical "
+                        "benchmark size), 224 otherwise")
     p.add_argument("--use-adasum", action="store_true",
                    help="Adasum gradient aggregation (reference "
                         "--use-adasum)")
     p.add_argument("--fp16-allreduce", action="store_true",
                    help="fp16 wire compression (reference --fp16-allreduce)")
     args = p.parse_args()
+    if args.image_size is None:
+        args.image_size = 299 if args.model == "inception3" else 224
 
     hvd.init()
     init_kwargs = ({"image_size": args.image_size}

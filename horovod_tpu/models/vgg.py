@@ -60,6 +60,12 @@ def vgg16_apply(variables: Dict[str, Any], x, train: bool = True,
     (no batch-norm state; axis_name/train accepted for uniformity).
     """
     del train, axis_name  # no BN, no dropout in the benchmark config
+    expect = variables["config"]["image_size"]
+    if x.shape[1] != expect or x.shape[2] != expect:
+        raise ValueError(
+            f"vgg16 was initialized for {expect}x{expect} inputs (the "
+            f"flatten->fc1 boundary is size-dependent), got "
+            f"{x.shape[1]}x{x.shape[2]}; re-init with image_size=")
     p = variables["params"]
     y = x
     for si, (n_convs, _) in enumerate(_STAGES):

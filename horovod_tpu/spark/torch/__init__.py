@@ -149,9 +149,7 @@ def _torch_remote_trainer(spec: Dict[str, Any]):
         # Cross-rank epoch metric, like the reference's metric averaging.
         avg = float(hvd_t.allreduce(torch.tensor([avg]), name="epoch_loss"))
         losses.append(avg)
-        # Val data is replicated and the forward has no collectives, so
-        # only the rank whose history is returned computes it.
-        if val is not None:
+        if val is not None:  # rank 0 only — see the load site above
             model.eval()
             with torch.no_grad():
                 val_losses.append(float(loss_fn(model(val[0]), val[1])))

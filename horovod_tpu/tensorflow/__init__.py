@@ -172,6 +172,45 @@ def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
                                  "HorovodGroupedAllreduce")
 
 
+def grouped_allgather(tensors: Sequence, name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None) -> List:
+    """Reference: hvd.grouped_allgather (tensorflow/mpi_ops.py)."""
+
+    def _fn(nps):
+        return [np.asarray(o)
+                for o in C.grouped_allgather(list(nps),
+                                             process_set=process_set)]
+
+    def _out_shape(shape):
+        # dim0 is the sum of per-rank dim0s — data-dependent in general.
+        return tf.TensorShape([None]).concatenate(shape[1:]) \
+            if shape.rank else None
+
+    return _eager_or_py_function(_fn, list(tensors),
+                                 "HorovodGroupedAllgather",
+                                 out_shape_fn=_out_shape)
+
+
+def grouped_reducescatter(tensors: Sequence, op=Average,
+                          name: Optional[str] = None,
+                          process_set: Optional[ProcessSet] = None) -> List:
+    """Reference: hvd.grouped_reducescatter (tensorflow/mpi_ops.py)."""
+
+    def _fn(nps):
+        return [np.asarray(o)
+                for o in C.grouped_reducescatter(
+                    list(nps), op=op, process_set=process_set)]
+
+    def _out_shape(shape):
+        # dim0 shrinks to this rank's 1/size slice.
+        return tf.TensorShape([None]).concatenate(shape[1:]) \
+            if shape.rank else None
+
+    return _eager_or_py_function(_fn, list(tensors),
+                                 "HorovodGroupedReducescatter",
+                                 out_shape_fn=_out_shape)
+
+
 def allgather(tensor, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None):
     """First-dim concatenation across ranks (variable dim0 supported, like

@@ -178,6 +178,39 @@ def grouped_allreduce(tensors, op=Average, name=None):
     return [_to_torch(o, t) for o, t in zip(outs, tensors)]
 
 
+def reducescatter(tensor: "torch.Tensor", op=Average,
+                  name: Optional[str] = None,
+                  process_set: Optional[ProcessSet] = None
+                  ) -> "torch.Tensor":
+    """Reference: hvd.reducescatter (torch/mpi_ops.py) — reduce across
+    ranks, return this rank's 1/size slice of dim 0."""
+    out = C.reducescatter(_to_np(tensor), op=op, name=name,
+                          process_set=process_set)
+    return _to_torch(out, tensor)
+
+
+def reducescatter_async(tensor, op=Average, name=None,
+                        process_set: Optional[ProcessSet] = None) -> int:
+    arr = C.reducescatter(_to_np(tensor), op=op, name=name,
+                          process_set=process_set)
+    return _async_dispatch(arr, tensor, inplace=False)
+
+
+def grouped_allgather(tensors, name=None):
+    outs = C.grouped_allgather([_to_np(t) for t in tensors])
+    return [_to_torch(o, t) for o, t in zip(outs, tensors)]
+
+
+def grouped_allgather_async(tensors, name=None) -> int:
+    outs = C.grouped_allgather([_to_np(t) for t in tensors])
+    return _async_dispatch(outs, list(tensors), inplace=False)
+
+
+def grouped_reducescatter(tensors, op=Average, name=None):
+    outs = C.grouped_reducescatter([_to_np(t) for t in tensors], op=op)
+    return [_to_torch(o, t) for o, t in zip(outs, tensors)]
+
+
 def synchronize(handle: int):
     """Block until the handle's collective completes; return the result
     as a torch tensor (in-place variants copy into and return the

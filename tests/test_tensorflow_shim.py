@@ -56,6 +56,20 @@ class TestTfOps:
         np.testing.assert_allclose(outs[0].numpy(), np.ones(2))
         np.testing.assert_allclose(outs[1].numpy(), [2.0, 4.0, 6.0])
 
+    def test_grouped_allgather(self):
+        ts = [tf.ones([2, 3]), tf.zeros([1, 3])]
+        outs = hvd_tf.grouped_allgather(ts)
+        assert [int(o.shape[0]) for o in outs] == [
+            2 * hvd_tf.size(), 1 * hvd_tf.size()]
+
+    def test_grouped_reducescatter(self):
+        n = hvd_tf.size()
+        ts = [tf.ones([2 * n, 2]), tf.ones([n])]
+        outs = hvd_tf.grouped_reducescatter(ts)
+        assert tuple(outs[0].shape) == (2, 2)
+        assert tuple(outs[1].shape) == (1,)
+        np.testing.assert_allclose(outs[0].numpy(), np.ones((2, 2)))
+
     def test_allgather_concatenates(self):
         t = tf.reshape(tf.range(6, dtype=tf.float32), (2, 3))
         out = hvd_tf.allgather(t)

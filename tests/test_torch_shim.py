@@ -44,6 +44,34 @@ class TestTorchOps:
         out = hvd_torch.broadcast(t, root_rank=0)
         torch.testing.assert_close(out, t)
 
+    def test_reducescatter_slices(self):
+        n = 2 * hvd_torch.size()
+        t = torch.arange(2 * n, dtype=torch.float32).reshape(n, 2)
+        out = hvd_torch.reducescatter(t)
+        # Average over identical per-rank inputs == this rank's slice.
+        assert out.shape == (n // hvd_torch.size(), 2)
+        r = hvd_torch.rank()
+        torch.testing.assert_close(out, t[2 * r:2 * r + 2])
+
+    def test_reducescatter_async_roundtrip(self):
+        n = 2 * hvd_torch.size()
+        h = hvd_torch.reducescatter_async(torch.randn(n, 2))
+        out = hvd_torch.synchronize(h)
+        assert out.shape == (2, 2)
+
+    def test_grouped_allgather(self):
+        ts = [torch.ones(2, 3), torch.zeros(1, 3)]
+        outs = hvd_torch.grouped_allgather(ts)
+        assert [o.shape[0] for o in outs] == [
+            2 * hvd_torch.size(), 1 * hvd_torch.size()]
+
+    def test_grouped_reducescatter(self):
+        n = hvd_torch.size()
+        ts = [torch.ones(2 * n, 2), torch.ones(n)]
+        outs = hvd_torch.grouped_reducescatter(ts)
+        assert outs[0].shape == (2, 2)
+        assert outs[1].shape == (1,)
+
     def test_async_handle(self):
         import time
 
