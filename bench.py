@@ -34,6 +34,10 @@ import time
 
 PROBE_TIMEOUT = float(os.environ.get("HOROVOD_BACKEND_PROBE_TIMEOUT", "120"))
 PROBE_RETRIES = 2
+# Extra patience for a *wedged* (hanging) accelerator: observed to
+# recover on its own; keep probing this long before surrendering to the
+# CPU fallback, whose numbers are not the headline metric.
+PROBE_WINDOW = float(os.environ.get("HOROVOD_BENCH_PROBE_WINDOW", "900"))
 
 
 def log(*a):
@@ -50,9 +54,18 @@ def emit(obj):
 
 def probe_accelerator() -> str:
     """Return the usable platform: 'tpu' if the accelerator initializes
-    within the timeout, else 'cpu'."""
+    within the probe window, else 'cpu'.
+
+    Hang-resilient: each probe runs in a killable subprocess; a hanging
+    (wedged-tunnel) backend keeps being re-probed for up to
+    PROBE_WINDOW seconds, since the wedge has been observed to clear on
+    its own."""
     code = "import jax; print(jax.devices()[0].platform)"
-    for attempt in range(1, PROBE_RETRIES + 1):
+    deadline = time.monotonic() + PROBE_WINDOW
+    attempt = 0
+    while True:
+        attempt += 1
+        hung = False
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
@@ -66,9 +79,16 @@ def probe_accelerator() -> str:
             log(f"probe attempt {attempt}: rc={r.returncode} "
                 f"stderr tail: {r.stderr[-500:]}")
         except subprocess.TimeoutExpired:
+            hung = True
             log(f"probe attempt {attempt}: backend init hung "
                 f">{PROBE_TIMEOUT}s, killed")
-        time.sleep(2)
+        # Fast errors exhaust PROBE_RETRIES; hangs keep retrying until
+        # the window closes.
+        if not hung and attempt >= PROBE_RETRIES:
+            break
+        if time.monotonic() + PROBE_TIMEOUT > deadline:
+            break
+        time.sleep(15 if hung else 2)
     log("accelerator unreachable; falling back to CPU host platform")
     return "cpu"
 
@@ -223,18 +243,77 @@ def sim_scaling_efficiency(timeout: float = 600.0):
     Also reports the per-step collective share: T8(dist) - T8(no dist),
     the same decomposition the reference's timeline gives per tensor.
     """
-    t1 = _run_sim(1, True, timeout)
-    t8 = _run_sim(8, True, timeout)
+    # Best-of-2 per configuration: the shared-core measurement wobbles a
+    # few percent run to run (observed 0.89-0.92 for the same build);
+    # the fastest clean run is the standard timing estimator.
+    def best(n, distributed=True):
+        ts = [_run_sim(n, distributed, timeout) for _ in range(2)]
+        ts = [t for t in ts if t is not None]
+        return min(ts) if ts else None
+
+    t1 = best(1)
+    t8 = best(8)
     if t1 is None or t8 is None:
         return None
-    log(f"sim-scaling n=1: {t1*1e3:.1f} ms/step")
-    log(f"sim-scaling n=8: {t8*1e3:.1f} ms/step")
-    t8_nodist = _run_sim(8, False, timeout)
+    log(f"sim-scaling n=1: {t1*1e3:.1f} ms/step (best of 2)")
+    log(f"sim-scaling n=8: {t8*1e3:.1f} ms/step (best of 2)")
+    t8_nodist = best(8, distributed=False)  # same estimator as t8
     if t8_nodist is not None:
         log(f"sim-scaling n=8 compute-only: {t8_nodist*1e3:.1f} ms/step "
             f"-> collective share {(t8 - t8_nodist)*1e3:.1f} ms/step "
             f"({100 * (t8 - t8_nodist) / t8:.1f}%)")
     return min(1.0, 8.0 * t1 / t8)
+
+
+# ---------------------------------------------------------------------------
+# Transformer tok/s (flagship model, single chip)
+# ---------------------------------------------------------------------------
+
+def run_transformer_bench(d_model=512, seq=1024, batch=8, layers=8) -> float:
+    """tok/s of one fwd+bwd+update step of the flagship transformer
+    (dense config) on the current device — the long-context flagship's
+    single-chip number next to the ResNet headline."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import (
+        TransformerConfig, transformer_init, transformer_ref_apply,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=8192, d_model=d_model, n_heads=d_model // 64,
+        d_head=64, d_ff=4 * d_model, n_layers=layers)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+    x, y = tokens[:, :-1], tokens[:, 1:]
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits, aux = transformer_ref_apply(p, x, cfg)
+            ll = jax.nn.log_softmax(logits.astype(jnp.float32))
+            loss = -jnp.mean(jnp.take_along_axis(
+                ll, y[..., None], axis=-1))
+            return loss + aux
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    warmup, iters = 3, 10
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    sync(loss)
+    dt = (time.perf_counter() - t0) / iters
+    return batch * seq / dt
 
 
 # ---------------------------------------------------------------------------
@@ -346,14 +425,29 @@ def run_bench(platform: str) -> dict:
     except Exception as e:  # noqa: BLE001 — keras path must not sink bench
         log(f"keras bench failed: {type(e).__name__}: {e}")
 
+    # --- transformer tok/s (flagship model, stderr-visible extra) ---
+    tfm_tok_s = None
+    if on_tpu:
+        try:
+            tfm_tok_s = run_transformer_bench()
+            log(f"transformer_tok_s: {tfm_tok_s:.0f} tok/s "
+                f"(1-chip fwd+bwd, d512 T1024 bf16)")
+        except Exception as e:  # noqa: BLE001 — extras must not sink bench
+            log(f"transformer bench failed: {type(e).__name__}: {e}")
+
     out = {
         "metric": "resnet50_synthetic_img_sec_per_chip",
         "value": round(fw_imgsec, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(fw_imgsec / raw_imgsec, 4),
+        # Makes a CPU-fallback run (wedged accelerator at bench time)
+        # unmistakable in the recorded JSON.
+        "platform": actual,
     }
     if keras_img_sec is not None:
         out["keras_img_sec"] = round(keras_img_sec, 1)
+    if tfm_tok_s is not None:
+        out["transformer_tok_s"] = round(tfm_tok_s, 0)
     return out
 
 

@@ -233,11 +233,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd(res, g):
     q3, k3, v3, o3, lse, scale, causal = res
     do3 = g[0].astype(jnp.float32)
+    dlse = g[1]                                              # (bh, t, 1)
     bh, t, d = q3.shape
     nq = nk = t // _BLOCK
-    # delta_i = sum_d dO_i * O_i  (rowwise), the flash-2 correction term.
+    # delta_i = sum_d dO_i * O_i (rowwise, the flash-2 correction term),
+    # minus the lse cotangent: dL/ds_ij = p_ij*(dp_ij - delta_i + dlse_i),
+    # so dlse folds into delta with a sign flip.
     delta = jnp.sum(do3 * o3.astype(jnp.float32), axis=-1,
                     keepdims=True)                           # (bh, t, 1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     qspec = pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, qi, 0))
     kspec = pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, ki, 0))
@@ -279,31 +284,23 @@ def _bwd(res, g):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash3(q3, k3, v3, causal):
-    o, _ = _fwd(q3, k3, v3, 1.0 / math.sqrt(q3.shape[-1]), causal)
-    return o
+    return _fwd(q3, k3, v3, 1.0 / math.sqrt(q3.shape[-1]), causal)
 
 
 def _flash3_fwd(q3, k3, v3, causal):
     scale = 1.0 / math.sqrt(q3.shape[-1])
     o, lse = _fwd(q3, k3, v3, scale, causal)
-    return o, (q3, k3, v3, o, lse, scale, causal)
+    return (o, lse), (q3, k3, v3, o, lse, scale, causal)
 
 
 def _flash3_bwd(causal, res, g):
-    return _bwd(res, (g,))
+    return _bwd(res, g)
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = True):
-    """Flash attention on [B, T, H, D] (same convention as
-    parallel/sequence.py), differentiable, O(T) memory.
-
-    T must be a multiple of 128 (pad upstream; the transformer configs
-    here use power-of-two T).  Numerics: f32 accumulation; output in
-    q.dtype; matches `parallel.sequence.full_attention` to f32 noise.
-    """
+def _check_and_to3(q, k, v):
     if not PALLAS_AVAILABLE:
         raise RuntimeError(
             "flash_attention requires jax.experimental.pallas, which "
@@ -316,8 +313,32 @@ def flash_attention(q, k, v, causal: bool = True):
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
 
-    o3 = _flash3(to3(q), to3(k), to3(v), causal)
+    return (B, T, H, D), to3(q), to3(k), to3(v)
+
+
+def flash_attention(q, k, v, causal: bool = True):
+    """Flash attention on [B, T, H, D] (same convention as
+    parallel/sequence.py), differentiable, O(T) memory.
+
+    T must be a multiple of 128 (pad upstream; the transformer configs
+    here use power-of-two T).  Numerics: f32 accumulation; output in
+    q.dtype; matches `parallel.sequence.full_attention` to f32 noise.
+    """
+    (B, T, H, D), q3, k3, v3 = _check_and_to3(q, k, v)
+    o3, _ = _flash3(q3, k3, v3, causal)
     return o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 
-__all__ = ["flash_attention", "flash_enabled", "PALLAS_AVAILABLE"]
+def flash_attention_lse(q, k, v, causal: bool = True):
+    """Like `flash_attention` but also returns the per-row logsumexp
+    (f32, [B, T, H]) — the merge weight ring attention needs to combine
+    per-pair partial results (both outputs are differentiable)."""
+    (B, T, H, D), q3, k3, v3 = _check_and_to3(q, k, v)
+    o3, lse3 = _flash3(q3, k3, v3, causal)
+    o = o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    lse = lse3.reshape(B, H, T).transpose(0, 2, 1)
+    return o, lse
+
+
+__all__ = ["flash_attention", "flash_attention_lse", "flash_enabled",
+           "PALLAS_AVAILABLE"]

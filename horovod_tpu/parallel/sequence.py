@@ -55,12 +55,72 @@ def _block_attn_update(q, k, v, o, m, l, q_pos, k_pos, scale, causal):
     return o_new, m_new, l_new
 
 
+def ring_flash_attention_shard(q, k, v, axis: str, causal: bool = True):
+    """Ring attention with the Pallas flash kernel as the per-pair block
+    engine (used when HOROVOD_FLASH_ATTENTION=1 and T_local % 128 == 0).
+
+    Each ring step runs ONE flash call on (q_local, kv_block): the
+    diagonal pair causal, strictly-past pairs dense; per-pair
+    (o, lse) partials merge by logsumexp — numerically identical to the
+    single online softmax, but the O(T_local²) score matrix never
+    materializes in HBM.  Future pairs still run (lax.cond would
+    recompile per branch inside the rolled loop) and are masked out of
+    the merge.
+    """
+    from ..ops.flash_attention import flash_attention_lse
+
+    sp = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    B, Tl, H, D = q.shape
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    o0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    lse0 = jnp.full((B, Tl, H), _NEG, jnp.float32)
+
+    def body(step, carry):
+        o, lse, kb, vb = carry
+        kv_idx = (idx - step) % sp
+        if causal:
+            # Diagonal pair needs the causal mask; strictly-past pairs
+            # are dense; future pairs are masked out of the merge.
+            # lax.cond executes exactly one kernel per step at runtime.
+            o_p, lse_p = lax.cond(
+                kv_idx == idx,
+                lambda a: flash_attention_lse(*a, causal=True),
+                lambda a: flash_attention_lse(*a, causal=False),
+                (q, kb, vb))
+            o_p = o_p.astype(jnp.float32)
+            lse_p = jnp.where(kv_idx <= idx, lse_p, _NEG)
+        else:
+            o_p, lse_p = flash_attention_lse(q, kb, vb, causal=False)
+            o_p = o_p.astype(jnp.float32)
+        lse_new = jnp.logaddexp(lse, lse_p)
+        w_old = jnp.exp(lse - lse_new)[..., None]
+        w_new = jnp.exp(lse_p - lse_new)[..., None]
+        o = o * w_old + o_p * w_new
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        return o, lse_new, kb, vb
+
+    o, lse, _, _ = lax.fori_loop(0, sp, body, (o0, lse0, k, v))
+    return o.astype(q.dtype)
+
+
 def ring_attention_shard(q, k, v, axis: str, causal: bool = True):
     """Ring attention, called inside shard_map with `axis` in scope.
 
     Per-shard shapes: q/k/v [B, T_local, H, D] (the global sequence is
     sharded over `axis`).  Returns [B, T_local, H, D] in q.dtype.
+
+    With HOROVOD_FLASH_ATTENTION=1 and 128-aligned local shards, the
+    per-pair block math runs through the Pallas flash kernel
+    (`ring_flash_attention_shard`); the XLA blockwise path below is the
+    default and the numerical oracle.
     """
+    from ..ops import flash_attention as fa
+
+    if fa.flash_enabled() and q.shape[1] % 128 == 0:
+        return ring_flash_attention_shard(q, k, v, axis, causal=causal)
     sp = lax.psum(1, axis)
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape

@@ -113,3 +113,61 @@ class TestDispatch:
         monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "1")
         flash = seq.ulysses_attention(q, k, v, mesh)
         np.testing.assert_allclose(flash, dense, atol=2e-5, rtol=2e-5)
+
+
+class TestRingFlash:
+    """Ring attention with the flash kernel as the per-pair engine."""
+
+    def _mesh(self, n=4):
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices()[:n])
+        if len(devs) < n:
+            pytest.skip(f"needs {n} virtual devices")
+        return Mesh(devs, ("sp",))
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_oracle(self, causal, monkeypatch):
+        mesh = self._mesh()
+        q, k, v = qkv(B=1, T=512, H=4, D=32)
+        oracle = seq.full_attention(q, k, v, causal=causal)
+        monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "1")
+        out = seq.ring_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(out, oracle, atol=3e-5, rtol=3e-5)
+
+    def test_dispatch_falls_back_on_unaligned_shard(self, monkeypatch):
+        # T=256 over sp=4 -> T_local=64, not 128-aligned: XLA path.
+        mesh = self._mesh()
+        q, k, v = qkv(B=1, T=256, H=4, D=32)
+        # Oracle BEFORE the env flip so it is the true dense reference.
+        oracle = seq.full_attention(q, k, v)
+        monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "1")
+        monkeypatch.setattr(
+            fa, "flash_attention_lse",
+            lambda *a, **kw: pytest.fail("must not dispatch"))
+        out = seq.ring_attention(q, k, v, mesh)
+        np.testing.assert_allclose(out, oracle, atol=3e-5, rtol=3e-5)
+
+    def test_grads_match_oracle(self, monkeypatch):
+        mesh = self._mesh()
+        q, k, v = qkv(B=1, T=512, H=2, D=32)
+        monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "1")
+        gf = jax.grad(lambda q, k, v: jnp.sum(
+            seq.ring_attention(q, k, v, mesh) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        monkeypatch.delenv("HOROVOD_FLASH_ATTENTION")
+        gd = jax.grad(lambda q, k, v: jnp.sum(
+            seq.full_attention(q, k, v) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gd):
+            scale = max(1.0, float(jnp.abs(b).max()))
+            np.testing.assert_allclose(a, b, atol=3e-5 * scale,
+                                       err_msg=f"d{name}")
+
+    def test_lse_output_matches_dense_logsumexp(self):
+        q, k, v = qkv(T=128)
+        _, lse = fa.flash_attention_lse(q, k, v, causal=False)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(q.shape[-1])
+        ref = jax.scipy.special.logsumexp(s, axis=-1)  # [B,H,T]
+        np.testing.assert_allclose(
+            lse, ref.transpose(0, 2, 1), atol=2e-5, rtol=2e-5)
