@@ -63,6 +63,7 @@ def probe_accelerator() -> str:
     code = "import jax; print(jax.devices()[0].platform)"
     deadline = time.monotonic() + PROBE_WINDOW
     attempt = 0
+    errors = 0
     while True:
         attempt += 1
         hung = False
@@ -82,10 +83,12 @@ def probe_accelerator() -> str:
             hung = True
             log(f"probe attempt {attempt}: backend init hung "
                 f">{PROBE_TIMEOUT}s, killed")
-        # Fast errors exhaust PROBE_RETRIES; hangs keep retrying until
-        # the window closes.
-        if not hung and attempt >= PROBE_RETRIES:
-            break
+        # Fast errors exhaust PROBE_RETRIES (counted separately from
+        # hangs); hangs keep retrying until the window closes.
+        if not hung:
+            errors += 1
+            if errors >= PROBE_RETRIES:
+                break
         if time.monotonic() + PROBE_TIMEOUT > deadline:
             break
         time.sleep(15 if hung else 2)

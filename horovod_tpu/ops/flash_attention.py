@@ -241,8 +241,9 @@ def _bwd(res, g):
     # so dlse folds into delta with a sign flip.
     delta = jnp.sum(do3 * o3.astype(jnp.float32), axis=-1,
                     keepdims=True)                           # (bh, t, 1)
-    if dlse is not None:
-        delta = delta - dlse.astype(jnp.float32)
+    # custom_vjp materializes an unused-lse cotangent as zeros, so this
+    # is a no-op (zeros subtraction) on the plain flash_attention path.
+    delta = delta - dlse.astype(jnp.float32)
 
     qspec = pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, qi, 0))
     kspec = pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, ki, 0))

@@ -59,13 +59,12 @@ def ring_flash_attention_shard(q, k, v, axis: str, causal: bool = True):
     """Ring attention with the Pallas flash kernel as the per-pair block
     engine (used when HOROVOD_FLASH_ATTENTION=1 and T_local % 128 == 0).
 
-    Each ring step runs ONE flash call on (q_local, kv_block): the
-    diagonal pair causal, strictly-past pairs dense; per-pair
-    (o, lse) partials merge by logsumexp — numerically identical to the
-    single online softmax, but the O(T_local²) score matrix never
-    materializes in HBM.  Future pairs still run (lax.cond would
-    recompile per branch inside the rolled loop) and are masked out of
-    the merge.
+    Each ring step runs AT MOST one flash call on (q_local, kv_block):
+    a lax.switch picks causal (diagonal pair), dense (strictly-past
+    pair), or a free zero-contribution (future pair — skipped entirely,
+    so causal costs ~half the FLOPs).  Per-pair (o, lse) partials merge
+    by logsumexp — numerically identical to the single online softmax,
+    but the O(T_local²) score matrix never materializes in HBM.
     """
     from ..ops.flash_attention import flash_attention_lse
 
@@ -81,16 +80,24 @@ def ring_flash_attention_shard(q, k, v, axis: str, causal: bool = True):
         o, lse, kb, vb = carry
         kv_idx = (idx - step) % sp
         if causal:
-            # Diagonal pair needs the causal mask; strictly-past pairs
-            # are dense; future pairs are masked out of the merge.
-            # lax.cond executes exactly one kernel per step at runtime.
-            o_p, lse_p = lax.cond(
-                kv_idx == idx,
-                lambda a: flash_attention_lse(*a, causal=True),
-                lambda a: flash_attention_lse(*a, causal=False),
+            # 0: future pair contributes nothing (lse=_NEG -> weight 0);
+            # 1: diagonal pair, causal mask; 2: past pair, dense.
+            # lax.switch executes exactly one branch per step.
+            def _skip(a):
+                qq = a[0]
+                return (jnp.zeros_like(qq),
+                        jnp.full(qq.shape[:2] + (qq.shape[2],), _NEG,
+                                 jnp.float32))
+
+            branch = jnp.where(kv_idx > idx, 0,
+                               jnp.where(kv_idx == idx, 1, 2))
+            o_p, lse_p = lax.switch(
+                branch,
+                [_skip,
+                 lambda a: flash_attention_lse(*a, causal=True),
+                 lambda a: flash_attention_lse(*a, causal=False)],
                 (q, kb, vb))
             o_p = o_p.astype(jnp.float32)
-            lse_p = jnp.where(kv_idx <= idx, lse_p, _NEG)
         else:
             o_p, lse_p = flash_attention_lse(q, kb, vb, causal=False)
             o_p = o_p.astype(jnp.float32)

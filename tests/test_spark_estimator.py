@@ -302,6 +302,22 @@ class TestEstimatorValidation:
         HorovodEstimator._check_store_reachable(
             Store.create("/tmp/shared_mount_x"), SparkBackend(2))
 
+    def test_bad_compression_raises(self):
+        import torch
+
+        from horovod_tpu.spark.torch import TorchEstimator
+
+        net = torch.nn.Linear(2, 1)
+        est = TorchEstimator(model=net,
+                             optimizer=torch.optim.SGD(net.parameters(),
+                                                       lr=0.1),
+                             loss=torch.nn.functional.mse_loss,
+                             compression="int4",
+                             feature_cols=["x1"], label_cols=["y"],
+                             backend=LocalBackend(1))
+        with pytest.raises(HorovodTpuError, match="compression must be"):
+            est.fit(make_df(8))
+
     def test_bad_torch_optimizer_raises(self):
         import torch
 
