@@ -22,7 +22,7 @@ from ...common.exceptions import HorovodTpuError
 from .backend import default_backend
 from .params import EstimatorParams, Params
 from .store import CHECKPOINT_FILE, Store  # noqa: F401  (trainer import point)
-from .util import prepare_data, to_output_frame
+from .util import VALID_COMPRESSION, prepare_data, to_output_frame
 
 
 class HorovodEstimator(EstimatorParams):
@@ -109,9 +109,25 @@ class HorovodEstimator(EstimatorParams):
                 "driver-local temp dir")
 
     # -- spec shared by all frameworks --
+    # Shared distributed-training knobs (reference: both estimators
+    # expose them — keras/estimator.py, torch/estimator.py).
+    _params = dict(EstimatorParams._params, compression=None,
+                   backward_passes_per_step=1)
+
     def _build_spec(self, store: Store, run_id: str,
                     meta: Dict[str, int]) -> Dict[str, Any]:
+        if self.compression not in VALID_COMPRESSION:
+            raise HorovodTpuError(
+                f"compression must be one of none/fp16/bf16, got "
+                f"{self.compression!r}")
+        if not isinstance(self.backward_passes_per_step, int) or \
+                self.backward_passes_per_step < 1:
+            raise HorovodTpuError(
+                f"backward_passes_per_step must be an int >= 1, got "
+                f"{self.backward_passes_per_step!r}")
         return {
+            "compression": self.compression,
+            "backward_passes_per_step": self.backward_passes_per_step,
             "train_dir": store.get_train_data_path(run_id),
             "val_dir": store.get_val_data_path(run_id) if meta["val_rows"]
             else None,

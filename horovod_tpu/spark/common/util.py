@@ -25,6 +25,19 @@ from .store import Store, part_name
 # Single replicated validation file (every rank reads the same data).
 VAL_FILE = "val.npz"
 
+# Wire-compression names the estimators accept (resolved on the worker
+# against the frontend's Compression registry).
+VALID_COMPRESSION = (None, "none", "fp16", "bf16")
+
+
+def resolve_compression(frontend, name):
+    """Map an estimator compression name to the frontend shim's
+    Compression member (`frontend` is horovod_tpu.torch or
+    horovod_tpu.tensorflow.keras — both expose the same registry)."""
+    if name in (None, "none"):
+        return frontend.Compression.none
+    return getattr(frontend.Compression, name)
+
 
 def to_pandas(df):
     """Accept a pandas DataFrame or anything exposing `toPandas()`

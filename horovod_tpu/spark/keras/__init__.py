@@ -25,7 +25,7 @@ import numpy as np
 from ...common.exceptions import HorovodTpuError
 from ..common.estimator import HorovodEstimator, HorovodModel
 from ..common.store import save_checkpoint
-from ..common.util import load_shard, load_val
+from ..common.util import load_shard, load_val, resolve_compression
 
 
 def _serialize_keras(model, optimizer, loss, metrics, custom_objects):
@@ -71,7 +71,10 @@ def _keras_remote_trainer(spec: Dict[str, Any]):
         spec["model_bytes"])
     if opt is None:
         raise HorovodTpuError("KerasEstimator: optimizer is required")
-    dist_opt = hvd_k.DistributedOptimizer(opt)
+    comp = resolve_compression(hvd_k, spec.get("compression"))
+    dist_opt = hvd_k.DistributedOptimizer(
+        opt, compression=comp,
+        backward_passes_per_step=spec.get("backward_passes_per_step", 1))
     model.compile(optimizer=dist_opt, loss=loss, metrics=metrics or None)
 
     x, y = load_shard(spec["train_dir"], hvd_k.rank())

@@ -26,7 +26,7 @@ import numpy as np
 from ...common.exceptions import HorovodTpuError
 from ..common.estimator import HorovodEstimator, HorovodModel
 from ..common.store import save_checkpoint
-from ..common.util import load_shard, load_val
+from ..common.util import load_shard, load_val, resolve_compression
 
 
 def _optimizer_recipe(optimizer):
@@ -106,9 +106,7 @@ def _torch_remote_trainer(spec: Dict[str, Any]):
 
     hvd_t.broadcast_parameters(model.state_dict(), root_rank=0)
     hvd_t.broadcast_optimizer_state(opt, root_rank=0)
-    comp = (hvd_t.Compression.fp16 if spec.get("compression") == "fp16"
-            else hvd_t.Compression.bf16 if spec.get("compression") == "bf16"
-            else hvd_t.Compression.none)
+    comp = resolve_compression(hvd_t, spec.get("compression"))
     dist_opt = hvd_t.DistributedOptimizer(
         opt, named_parameters=model.named_parameters(), compression=comp,
         backward_passes_per_step=spec["backward_passes_per_step"])
@@ -206,26 +204,10 @@ class TorchEstimator(HorovodEstimator):
         torch_model = est.fit(df)
     """
 
-    _params = dict(HorovodEstimator._params, output_cols=None,
-                   compression=None, backward_passes_per_step=1)
+    _params = dict(HorovodEstimator._params, output_cols=None)
 
     def _remote_trainer(self):
         return _torch_remote_trainer
-
-    def _build_spec(self, store, run_id, meta):
-        spec = super()._build_spec(store, run_id, meta)
-        if self.compression not in (None, "none", "fp16", "bf16"):
-            raise HorovodTpuError(
-                f"compression must be one of none/fp16/bf16, got "
-                f"{self.compression!r}")
-        if not isinstance(self.backward_passes_per_step, int) or \
-                self.backward_passes_per_step < 1:
-            raise HorovodTpuError(
-                f"backward_passes_per_step must be an int >= 1, got "
-                f"{self.backward_passes_per_step!r}")
-        spec["compression"] = self.compression
-        spec["backward_passes_per_step"] = self.backward_passes_per_step
-        return spec
 
     def _serialize_model(self) -> bytes:
         import torch

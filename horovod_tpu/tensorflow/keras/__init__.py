@@ -33,27 +33,68 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          backward_passes_per_step: int = 1,
                          process_set: Optional[ProcessSet] = None):
     """Wrap a Keras optimizer so every `apply_gradients` first averages
-    gradients across ranks (reference: create_distributed_optimizer)."""
+    gradients across ranks (reference: create_distributed_optimizer).
+
+    `backward_passes_per_step > 1` locally accumulates gradients in
+    non-trainable slots and only every Nth call allreduces the average
+    and applies it (the reference's LocalGradientAggregationHelper,
+    horovod/tensorflow/gradient_aggregation.py) — tf.Variable counter +
+    tf.cond so it works inside model.fit's compiled train step."""
     cls = optimizer.__class__
 
     class _DistributedKerasOptimizer(cls):
         _hvd_op = op
         _hvd_compression = compression
         _hvd_process_set = process_set
+        _hvd_bpps = int(backward_passes_per_step)
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             gv = list(grads_and_vars)
             grads = [g for g, _ in gv]
             tvars = [v for _, v in gv]
-            reduced = _allreduce_grads(
-                grads, self._hvd_op, self._hvd_compression,
-                self._hvd_process_set, True)
-            self._hvd_inner = True
-            try:
-                return super().apply_gradients(
-                    zip(reduced, tvars), *args, **kwargs)
-            finally:
-                self._hvd_inner = False
+            if self._hvd_bpps == 1:
+                reduced = _allreduce_grads(
+                    grads, self._hvd_op, self._hvd_compression,
+                    self._hvd_process_set, True)
+                self._hvd_inner = True
+                try:
+                    return super().apply_gradients(
+                        zip(reduced, tvars), *args, **kwargs)
+                finally:
+                    self._hvd_inner = False
+
+            # -- local accumulation path --
+            if getattr(self, "_hvd_accum_vars", None) is None:
+                # First trace: create the aggregation slots.
+                self._hvd_accum_vars = [
+                    tf.Variable(tf.zeros_like(v), trainable=False)
+                    for v in tvars]
+                self._hvd_counter = tf.Variable(
+                    0, dtype=tf.int64, trainable=False)
+            for acc, g in zip(self._hvd_accum_vars, grads):
+                acc.assign_add(tf.cast(tf.convert_to_tensor(g), acc.dtype))
+            count = self._hvd_counter.assign_add(1)
+            outer = self
+
+            def _sync():
+                local = [acc / tf.cast(outer._hvd_bpps, acc.dtype)
+                         for acc in outer._hvd_accum_vars]
+                reduced = _allreduce_grads(
+                    local, outer._hvd_op, outer._hvd_compression,
+                    outer._hvd_process_set, True)
+                outer._hvd_inner = True
+                try:
+                    super(_DistributedKerasOptimizer,
+                          outer).apply_gradients(
+                        zip(reduced, tvars), *args, **kwargs)
+                finally:
+                    outer._hvd_inner = False
+                for acc in outer._hvd_accum_vars:
+                    acc.assign(tf.zeros_like(acc))
+                return tf.constant(True)
+
+            return tf.cond(tf.equal(count % outer._hvd_bpps, 0),
+                           _sync, lambda: tf.constant(False))
 
         def apply(self, grads, trainable_variables=None, **kwargs):
             if getattr(self, "_hvd_inner", False):

@@ -163,6 +163,36 @@ class TestKerasOptimizer:
         opt.apply_gradients([(tf.constant([2.0, 2.0]), v)])
         np.testing.assert_allclose(v.numpy(), [0.0, 0.0])
 
+    def test_backward_passes_per_step_accumulates(self, monkeypatch):
+        import horovod_tpu.tensorflow.keras as K
+
+        calls = []
+        orig = K._allreduce_grads
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(K, "_allreduce_grads", spy)
+        tf.keras.utils.set_random_seed(0)
+        m = tf.keras.Sequential([tf.keras.layers.Input((2,)),
+                                 tf.keras.layers.Dense(1)])
+        opt = hvd_keras.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1), backward_passes_per_step=2)
+        m.compile(optimizer=opt, loss="mse")
+        x = np.random.randn(8, 2).astype("float32")
+        y = np.random.randn(8).astype("float32")
+        w0 = m.get_weights()[0].copy()
+        m.train_on_batch(x, y)      # accumulate only
+        w1 = m.get_weights()[0].copy()
+        m.train_on_batch(x, y)      # sync + apply
+        w2 = m.get_weights()[0].copy()
+        m.train_on_batch(x, y)
+        m.train_on_batch(x, y)
+        assert len(calls) == 2      # one allreduce per 2 batches
+        np.testing.assert_array_equal(w0, w1)
+        assert not np.allclose(w1, w2)
+
     def test_model_fit_trains(self):
         # Reference: test_tensorflow2_keras train_model assertion — one
         # fit epoch under the wrapped optimizer reduces the loss.
