@@ -48,22 +48,26 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
         _hvd_process_set = process_set
         _hvd_bpps = int(backward_passes_per_step)
 
-        def apply_gradients(self, grads_and_vars, *args, **kwargs):
-            gv = list(grads_and_vars)
-            grads = [g for g, _ in gv]
-            tvars = [v for _, v in gv]
-            if self._hvd_bpps == 1:
-                reduced = _allreduce_grads(
-                    grads, self._hvd_op, self._hvd_compression,
-                    self._hvd_process_set, True)
+        def _hvd_reduce_then(self, grads, tvars, apply_fn):
+            """Allreduce-and-apply now (bpps==1), or accumulate and do
+            so every Nth call (shared by both public entry points).
+
+            `apply_fn(reduced)` runs the wrapped optimizer's own update
+            with the inner-flag set so it is not re-intercepted."""
+
+            def _apply_inner(reduced):
                 self._hvd_inner = True
                 try:
-                    return super().apply_gradients(
-                        zip(reduced, tvars), *args, **kwargs)
+                    apply_fn(reduced)
                 finally:
                     self._hvd_inner = False
 
-            # -- local accumulation path --
+            if self._hvd_bpps == 1:
+                _apply_inner(_allreduce_grads(
+                    grads, self._hvd_op, self._hvd_compression,
+                    self._hvd_process_set, True))
+                return tf.constant(True)
+
             if getattr(self, "_hvd_accum_vars", None) is None:
                 # First trace: create the aggregation slots.
                 self._hvd_accum_vars = [
@@ -74,39 +78,48 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
             for acc, g in zip(self._hvd_accum_vars, grads):
                 acc.assign_add(tf.cast(tf.convert_to_tensor(g), acc.dtype))
             count = self._hvd_counter.assign_add(1)
-            outer = self
 
             def _sync():
-                local = [acc / tf.cast(outer._hvd_bpps, acc.dtype)
-                         for acc in outer._hvd_accum_vars]
-                reduced = _allreduce_grads(
-                    local, outer._hvd_op, outer._hvd_compression,
-                    outer._hvd_process_set, True)
-                outer._hvd_inner = True
-                try:
-                    super(_DistributedKerasOptimizer,
-                          outer).apply_gradients(
-                        zip(reduced, tvars), *args, **kwargs)
-                finally:
-                    outer._hvd_inner = False
-                for acc in outer._hvd_accum_vars:
+                local = [acc / tf.cast(self._hvd_bpps, acc.dtype)
+                         for acc in self._hvd_accum_vars]
+                _apply_inner(_allreduce_grads(
+                    local, self._hvd_op, self._hvd_compression,
+                    self._hvd_process_set, True))
+                for acc in self._hvd_accum_vars:
                     acc.assign(tf.zeros_like(acc))
                 return tf.constant(True)
 
-            return tf.cond(tf.equal(count % outer._hvd_bpps, 0),
-                           _sync, lambda: tf.constant(False))
+            def _skip():
+                # Iteration-keyed LR schedules must count every batch
+                # (reference: gradient_aggregation.py's non-aggregation
+                # branch does the same assign_add).
+                self.iterations.assign_add(1)
+                return tf.constant(False)
+
+            return tf.cond(tf.equal(count % self._hvd_bpps, 0),
+                           _sync, _skip)
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            grads = [g for g, _ in gv]
+            tvars = [v for _, v in gv]
+            return self._hvd_reduce_then(
+                grads, tvars,
+                lambda reduced: super(
+                    _DistributedKerasOptimizer, self).apply_gradients(
+                        zip(reduced, tvars), *args, **kwargs))
 
         def apply(self, grads, trainable_variables=None, **kwargs):
             if getattr(self, "_hvd_inner", False):
                 return super().apply(grads, trainable_variables, **kwargs)
-            reduced = _allreduce_grads(
-                list(grads), self._hvd_op, self._hvd_compression,
-                self._hvd_process_set, True)
-            self._hvd_inner = True
-            try:
-                return super().apply(reduced, trainable_variables, **kwargs)
-            finally:
-                self._hvd_inner = False
+            grads = list(grads)
+            tvars = (list(trainable_variables)
+                     if trainable_variables is not None else None)
+            return self._hvd_reduce_then(
+                grads, tvars if tvars is not None else grads,
+                lambda reduced: super(
+                    _DistributedKerasOptimizer, self).apply(
+                        reduced, tvars, **kwargs))
 
     _DistributedKerasOptimizer.__name__ = (
         name or "Distributed" + cls.__name__)

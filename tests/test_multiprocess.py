@@ -76,8 +76,15 @@ class TestCrossProcessCollectives:
         """np=4 (reference floor is 2 processes; SURVEY §4 says go
         beyond): mesh order, every collective, and process-set subsets
         that span non-adjacent processes."""
-        n = 4
-        r = _launch(n, tmp_path, timeout=420)
+        self._run_n_process(4, tmp_path, timeout=420)
+
+    def test_eight_process_collectives(self, tmp_path):
+        """np=8: contiguous-rank/mesh-order assumptions at the size the
+        virtual-device tests simulate, with real processes."""
+        self._run_n_process(8, tmp_path, timeout=560)
+
+    def _run_n_process(self, n, tmp_path, timeout):
+        r = _launch(n, tmp_path, timeout=timeout)
         assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
         results = {}
         for rank in range(n):
@@ -85,7 +92,7 @@ class TestCrossProcessCollectives:
             assert path.exists(), \
                 f"rank {rank} wrote no result:\n{r.stdout}\n{r.stderr}"
             results[rank] = json.loads(path.read_text())
-        total = sum(range(1, n + 1))  # 10
+        total = sum(range(1, n + 1))  # sum of each rank's (rank+1)
         for rank, res in results.items():
             assert res["size"] == n
             assert res["allreduce_sum"] == [1.0 * total, 2.0 * total]
@@ -98,10 +105,12 @@ class TestCrossProcessCollectives:
             # mesh/rank order: received chunk s comes from global rank s.
             assert res["alltoall"] == [float(s) for s in range(n)]
             assert res["reducescatter"] == [float(total)] * 2
-        # Process sets spanning non-adjacent processes: evens=[0,2] sum
-        # (1+3)=4, odds=[1,3] sum (2+4)=6 — computed concurrently.
+        # Process sets spanning non-adjacent processes (evens/odds),
+        # computed concurrently: each rank sums (r+1) within its set.
+        even_sum = float(sum(r + 1 for r in range(0, n, 2)))
+        odd_sum = float(sum(r + 1 for r in range(1, n, 2)))
         for rank in range(n):
-            expected = 4.0 if rank % 2 == 0 else 6.0
+            expected = even_sum if rank % 2 == 0 else odd_sum
             assert results[rank]["ps_sum"] == [expected], results[rank]
 
 
