@@ -58,9 +58,31 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class Int8Compressor(Compressor):
+    """Marker for the cooperative int8 wire format: int8 cannot be a
+    pre-collective cast (per-rank scales don't sum), so the quantized
+    ring allreduce (ops/quantized.py, EQuARX-style) implements the
+    whole collective.  `allreduce_gradients` routes int8 buckets there
+    BEFORE compress() is reached; any other path (TF/torch shims, eager
+    collectives) cannot deliver int8 semantics and raises instead of
+    silently sending uncompressed f32."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError(
+            "Compression.int8 is only supported on the in-jit gradient "
+            "path (hvd.data_parallel / allreduce_gradients with "
+            "axis_name); use Compression.fp16/bf16 here")
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
 class Compression:
     """Namespace matching ``hvd.Compression``."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

@@ -1,0 +1,122 @@
+"""int8-wire quantized allreduce (EQuARX-style, PAPERS.md:
+"EQuARX: Efficient Quantized AllReduce in XLA").
+
+The reference's `Compression.fp16` halves wire bytes by casting before
+the collective.  int8 cannot work that way — summing int8 payloads
+quantized with different per-rank scales is meaningless and overflows —
+so this module implements the collective itself: a **ring
+reduce-scatter → allgather** over `ppermute` where every hop transmits
+int8 payloads + f32 blockwise scales (wire ≈ 1/4 of f32, ~1/2 of bf16
+for large tensors), dequantizing into an f32 accumulator at each hop.
+
+Precision: blockwise max-abs scaling (128-element blocks); each of the
+n-1 reduce hops requantizes the partial sum, so worst-case relative
+error grows ~linearly in ring size — fine for gradient averaging (the
+EQuARX regime), not for exact-sum semantics.  Tests bound the error
+against the exact psum.
+
+Usage: inside shard_map via `quantized_allreduce_shard(x, axis)`, at
+mesh level via `quantized_allreduce(x, mesh)`, or end-to-end through
+`hvd.data_parallel` with `Compression.int8`
+(parallel/data_parallel.py routes int8 buckets here).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+_BLOCK = 128  # quantization block (elements); lane-width aligned
+
+
+def _quant(v: jax.Array):
+    """v: (L,) f32 with L % _BLOCK == 0 → (q int8 (L,), scales f32
+    (L/_BLOCK,))."""
+    blocks = v.reshape(-1, _BLOCK)
+    scale = jnp.max(jnp.abs(blocks), axis=1) / 127.0
+    scale = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale[:, None]), -127, 127)
+    return q.astype(jnp.int8).reshape(-1), scale
+
+
+def _dequant(q: jax.Array, scale: jax.Array):
+    blocks = q.astype(jnp.float32).reshape(-1, _BLOCK)
+    return (blocks * scale[:, None]).reshape(-1)
+
+
+def quantized_allreduce_shard(x: jax.Array, axis: str,
+                              average: bool = False) -> jax.Array:
+    """Sum (or average) `x` across `axis` with int8 ring transport.
+
+    Called inside shard_map with `axis` in scope; any shape/float dtype
+    (computation in f32, result cast back).
+    """
+    n = lax.psum(1, axis)
+    if n == 1:
+        return x
+    idx = lax.axis_index(axis)
+    shape, dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    # Pad so each of the n chunks is a whole number of blocks.
+    chunk = -(-flat.size // (n * _BLOCK)) * _BLOCK
+    flat = jnp.pad(flat, (0, n * chunk - flat.size))
+    acc = flat.reshape(n, chunk)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # --- ring reduce-scatter: n-1 hops of (int8 chunk + f32 scales) ---
+    def body(s, acc):
+        send_idx = (idx - s) % n
+        v = lax.dynamic_slice(acc, (send_idx, 0), (1, chunk))[0]
+        q, sc = _quant(v)
+        q = lax.ppermute(q, axis, perm)
+        sc = lax.ppermute(sc, axis, perm)
+        recv_idx = (idx - s - 1) % n
+        mine = lax.dynamic_slice(acc, (recv_idx, 0), (1, chunk))[0]
+        upd = mine + _dequant(q, sc)
+        return lax.dynamic_update_slice(acc, upd[None], (recv_idx, 0))
+
+    acc = lax.fori_loop(0, n - 1, body, acc)
+
+    # Rank i now owns the fully-reduced chunk (i + 1) % n.
+    own_idx = (idx + 1) % n
+    own = lax.dynamic_slice(acc, (own_idx, 0), (1, chunk))[0]
+    q, sc = _quant(own)
+
+    # --- allgather phase (int8 wire) ---
+    qg = lax.all_gather(q, axis)            # (n, chunk) int8
+    scg = lax.all_gather(sc, axis)          # (n, chunk/_BLOCK) f32
+    # Chunk c was reduced by rank (c - 1) % n.
+    order = jnp.array([(c - 1) % n for c in range(n)])
+    chunks = jax.vmap(_dequant)(jnp.take(qg, order, axis=0),
+                                jnp.take(scg, order, axis=0))
+    out = chunks.reshape(-1)[: math.prod(shape)].reshape(shape)
+    if average:
+        out = out / n
+    return out.astype(dtype)
+
+
+def quantized_allreduce(stacked: jax.Array, mesh: Mesh, axis: str = None,
+                        average: bool = False) -> jax.Array:
+    """Mesh-level wrapper over per-rank contributions: `stacked` has
+    shape (n, *shape) with row r being rank r's tensor (the PerRank
+    convention of the eager collectives); returns (n, *shape) with
+    every row the quantized-ring sum/average."""
+    axis = axis or mesh.axis_names[0]
+
+    def _fn(x):
+        return quantized_allreduce_shard(x[0], axis,
+                                         average=average)[None]
+
+    fn = shard_map(_fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+                   check_vma=False)
+    return fn(stacked)
+
+
+__all__ = ["quantized_allreduce", "quantized_allreduce_shard"]

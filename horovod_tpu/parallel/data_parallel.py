@@ -42,6 +42,20 @@ def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
     )
 
 
+def _buckets_by_size(tensors, threshold_bytes):
+    """Greedy size-capped bucket index lists (fusion-buffer analog)."""
+    buckets = [[]]
+    cur_bytes = 0
+    for i, t in enumerate(tensors):
+        nbytes = t.size * t.dtype.itemsize
+        if buckets[-1] and cur_bytes + nbytes > threshold_bytes:
+            buckets.append([])
+            cur_bytes = 0
+        buckets[-1].append(i)
+        cur_bytes += nbytes
+    return buckets
+
+
 def allreduce_gradients(
     grads: Any,
     op: C.ReduceOp = C.Average,
@@ -64,6 +78,43 @@ def allreduce_gradients(
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
+    from ..ops.compression import Int8Compressor
+    if compression is Int8Compressor:
+        # Cooperative wire format: the quantized ring allreduce IS the
+        # collective (ops/quantized.py).  In-jit only — it needs the
+        # mesh axis in scope.
+        if axis_name is None:
+            raise ValueError(
+                "Compression.int8 requires the in-jit path (axis_name; "
+                "e.g. inside hvd.data_parallel) — the quantized ring "
+                "collective needs the mesh axis in scope")
+        if process_set is not None:
+            raise ValueError(
+                "Compression.int8 does not support process_set subsets; "
+                "use fp16/bf16 compression for subset reductions")
+        if op not in (C.Average, C.Sum):
+            raise ValueError(
+                f"Compression.int8 supports op=Average or Sum, got {op}")
+        from ..ops.quantized import quantized_allreduce_shard
+
+        # Same size-capped bucketing as the exact path (fusion
+        # threshold / autotuner apply here too) so the ring collectives
+        # can overlap remaining backward compute.
+        buckets = _buckets_by_size(leaves, fusion_threshold_bytes)
+        out = [None] * len(leaves)
+        for idxs in buckets:
+            flat = jnp.concatenate(
+                [leaves[i].astype(jnp.float32).reshape(-1) for i in idxs])
+            reduced = quantized_allreduce_shard(
+                flat, axis_name, average=(op is C.Average))
+            offset = 0
+            for i in idxs:
+                n = leaves[i].size
+                out[i] = (reduced[offset:offset + n]
+                          .reshape(leaves[i].shape)
+                          .astype(leaves[i].dtype))
+                offset += n
+        return jax.tree_util.tree_unflatten(treedef, out)
     compressed, ctxs = [], []
     for leaf in leaves:
         c, ctx = compression.compress(leaf)
@@ -71,15 +122,7 @@ def allreduce_gradients(
         ctxs.append(ctx)
     # Greedy size-capped buckets (fusion threshold analog); dtype grouping
     # within a bucket is grouped_allreduce's job.
-    buckets = [[]]
-    cur_bytes = 0
-    for i, c in enumerate(compressed):
-        nbytes = c.size * c.dtype.itemsize
-        if buckets[-1] and cur_bytes + nbytes > fusion_threshold_bytes:
-            buckets.append([])
-            cur_bytes = 0
-        buckets[-1].append(i)
-        cur_bytes += nbytes
+    buckets = _buckets_by_size(compressed, fusion_threshold_bytes)
     out = [None] * len(leaves)
     for idxs in buckets:
         group = [compressed[i] for i in idxs]

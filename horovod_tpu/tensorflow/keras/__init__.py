@@ -58,15 +58,17 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
             def _apply_inner(reduced):
                 self._hvd_inner = True
                 try:
-                    apply_fn(reduced)
+                    return apply_fn(reduced)
                 finally:
                     self._hvd_inner = False
 
             if self._hvd_bpps == 1:
-                _apply_inner(_allreduce_grads(
+                # Preserve the wrapped optimizer's return value (Keras
+                # contract: apply_gradients returns the iteration
+                # counter).
+                return _apply_inner(_allreduce_grads(
                     grads, self._hvd_op, self._hvd_compression,
                     self._hvd_process_set, True))
-                return tf.constant(True)
 
             if getattr(self, "_hvd_accum_vars", None) is None:
                 # First trace: create the aggregation slots.
@@ -87,15 +89,17 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                     self._hvd_process_set, True))
                 for acc in self._hvd_accum_vars:
                     acc.assign(tf.zeros_like(acc))
-                return tf.constant(True)
+                return tf.convert_to_tensor(self.iterations)
 
             def _skip():
                 # Iteration-keyed LR schedules must count every batch
                 # (reference: gradient_aggregation.py's non-aggregation
                 # branch does the same assign_add).
                 self.iterations.assign_add(1)
-                return tf.constant(False)
+                return tf.convert_to_tensor(self.iterations)
 
+            # Both branches return the iteration counter, matching the
+            # Keras apply_gradients contract.
             return tf.cond(tf.equal(count % self._hvd_bpps, 0),
                            _sync, _skip)
 

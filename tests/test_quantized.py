@@ -1,0 +1,116 @@
+"""Quantized (int8-wire) allreduce tests — ops/quantized.py, the
+EQuARX-style ring collective, plus its Compression.int8 routing in
+allreduce_gradients."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.quantized import (
+    _dequant, _quant, quantized_allreduce,
+)
+
+
+@pytest.fixture()
+def mesh8():
+    devs = np.array(jax.devices()[:8])
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return Mesh(devs, ("r",))
+
+
+class TestQuantPrimitives:
+    def test_roundtrip_error_bounded_by_half_step(self):
+        v = jnp.asarray(np.random.default_rng(0).normal(
+            size=(1024,)).astype(np.float32)) * 10
+        q, sc = _quant(v)
+        assert q.dtype == jnp.int8
+        back = _dequant(q, sc)
+        # error <= scale/2 per element, blockwise
+        step = np.repeat(np.asarray(sc), 128)
+        assert np.all(np.abs(np.asarray(back - v)) <= step / 2 + 1e-6)
+
+    def test_zero_block_is_exact(self):
+        v = jnp.zeros((256,), jnp.float32)
+        q, sc = _quant(v)
+        np.testing.assert_array_equal(np.asarray(_dequant(q, sc)), 0.0)
+
+
+class TestQuantizedAllreduce:
+    def test_sum_close_to_exact(self, mesh8):
+        rng = np.random.default_rng(1)
+        contribs = rng.normal(size=(8, 1000)).astype(np.float32)
+        out = np.asarray(quantized_allreduce(jnp.asarray(contribs), mesh8))
+        exact = contribs.sum(0)
+        # identical on every rank
+        for r in range(1, 8):
+            np.testing.assert_array_equal(out[r], out[0])
+        # n-1 requantization hops: error ~ n * blockmax/254
+        bound = 8 * np.abs(contribs).max() / 100
+        assert np.abs(out[0] - exact).max() < bound
+
+    def test_average_and_odd_sizes(self, mesh8):
+        rng = np.random.default_rng(2)
+        contribs = rng.normal(size=(8, 777)).astype(np.float32)
+        out = np.asarray(quantized_allreduce(
+            jnp.asarray(contribs), mesh8, average=True))
+        exact = contribs.mean(0)
+        assert np.abs(out[0] - exact).max() < 0.05
+
+    def test_dtype_preserved(self, mesh8):
+        contribs = jnp.ones((8, 256), jnp.bfloat16)
+        out = quantized_allreduce(contribs, mesh8)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out[0], dtype=np.float32), 8.0, rtol=0.02)
+
+
+class TestInt8GradientPath:
+    def test_data_parallel_int8_matches_exact_closely(self, mesh8):
+        import optax
+
+        hvd.init()
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, 32))
+        y = jax.random.normal(jax.random.PRNGKey(2), (64, 16))
+
+        def fresh():
+            k = jax.random.PRNGKey(0)
+            w = {"w": jax.random.normal(k, (32, 16)),
+                 "b": jnp.zeros((16,))}
+            opt = optax.sgd(0.1)
+            return w, opt, opt.init(w)
+
+        def make_step(opt, comp):
+            def step(params, opt_state, batch):
+                xb, yb = batch
+
+                def loss_fn(p):
+                    return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                if comp is None:
+                    grads = hvd.allreduce(grads)
+                else:
+                    grads = hvd.allreduce_gradients(
+                        grads, compression=comp,
+                        axis_name=hvd.GLOBAL_AXIS)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state, loss
+            return step
+
+        sb = hvd.shard_batch((x, y))
+        w1, opt1, s1 = fresh()
+        pe, _, _ = hvd.data_parallel(make_step(opt1, None))(w1, s1, sb)
+        w2, opt2, s2 = fresh()
+        pq, _, _ = hvd.data_parallel(
+            make_step(opt2, hvd.Compression.int8))(w2, s2, sb)
+        assert float(jnp.abs(pq["w"] - pe["w"]).max()) < 5e-3
+
+    def test_int8_outside_jit_raises(self):
+        hvd.init()
+        with pytest.raises(ValueError, match="in-jit path"):
+            hvd.allreduce_gradients(
+                {"g": jnp.ones((4,))}, compression=hvd.Compression.int8)
