@@ -42,7 +42,7 @@ def run_torch(df, np_workers):
         loss=torch.nn.functional.mse_loss,
         feature_cols=["a", "b"], label_cols=["y"],
         batch_size=32, epochs=10, validation=0.2, random_seed=0,
-        backend=LocalBackend(np_workers))
+        backend=LocalBackend(np_workers, start_timeout=300))
     model = est.fit(df)
     return model, model.get_history()["loss"]
 
@@ -62,7 +62,7 @@ def run_keras(df, np_workers):
         model=m, optimizer=tf.keras.optimizers.Adam(0.01), loss="mse",
         feature_cols=["a", "b"], label_cols=["y"],
         batch_size=32, epochs=10, validation=0.2, random_seed=0,
-        backend=LocalBackend(np_workers))
+        backend=LocalBackend(np_workers, start_timeout=300))
     model = est.fit(df)
     return model, model.get_history()["loss"]
 

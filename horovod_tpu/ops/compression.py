@@ -58,25 +58,49 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
-class Int8Compressor(Compressor):
-    """Marker for the cooperative int8 wire format: int8 cannot be a
-    pre-collective cast (per-rank scales don't sum), so the quantized
-    ring allreduce (ops/quantized.py, EQuARX-style) implements the
-    whole collective.  `allreduce_gradients` routes int8 buckets there
-    BEFORE compress() is reached; any other path (TF/torch shims, eager
-    collectives) cannot deliver int8 semantics and raises instead of
-    silently sending uncompressed f32."""
+class _CooperativeCompressor(Compressor):
+    """Base for 1-byte wire formats that cannot be a pre-collective
+    cast: the reduction would accumulate in the wire dtype (e4m3
+    saturates at ±448 → NaN; int8 scales don't sum), so the quantized
+    ring collective (ops/quantized.py) implements the whole op with f32
+    accumulation per hop.  `allreduce_gradients` routes these BEFORE
+    compress() is reached; any other path raises instead of silently
+    mis-summing."""
 
-    @staticmethod
-    def compress(tensor):
+    wire: str = None
+
+    @classmethod
+    def compress(cls, tensor):
         raise NotImplementedError(
-            "Compression.int8 is only supported on the in-jit gradient "
-            "path (hvd.data_parallel / allreduce_gradients with "
-            "axis_name); use Compression.fp16/bf16 here")
+            f"Compression.{cls.wire} is only supported on the in-jit "
+            "gradient path (hvd.data_parallel / allreduce_gradients "
+            "with axis_name); use Compression.fp16/bf16 here")
 
     @staticmethod
     def decompress(tensor, ctx):
         return tensor
+
+
+class FP8E4M3Compressor(_CooperativeCompressor):
+    """1-byte fp8 ring wire (e4m3: 3 mantissa bits, ±448 range)."""
+
+    wire = "fp8_e4m3"
+
+
+class FP8E5M2Compressor(_CooperativeCompressor):
+    """1-byte fp8 ring wire (e5m2: bf16-like range, 2 mantissa bits)."""
+
+    wire = "fp8_e5m2"
+
+
+class Int8Compressor(_CooperativeCompressor):
+    """1-byte int8 ring wire (blockwise max-abs scales, EQuARX-style —
+    the most robust of the 1-byte formats for arbitrary gradient
+    magnitudes)."""
+
+    wire = "int8"
+
+
 
 
 class Compression:
@@ -86,3 +110,5 @@ class Compression:
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+    fp8_e4m3 = FP8E4M3Compressor
+    fp8_e5m2 = FP8E5M2Compressor

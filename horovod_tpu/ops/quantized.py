@@ -1,29 +1,34 @@
-"""int8-wire quantized allreduce (EQuARX-style, PAPERS.md:
+"""Quantized/low-bit-wire allreduce (EQuARX-style, PAPERS.md:
 "EQuARX: Efficient Quantized AllReduce in XLA").
 
 The reference's `Compression.fp16` halves wire bytes by casting before
-the collective.  int8 cannot work that way — summing int8 payloads
-quantized with different per-rank scales is meaningless and overflows —
-so this module implements the collective itself: a **ring
-reduce-scatter → allgather** over `ppermute` where every hop transmits
-int8 payloads + f32 blockwise scales (wire ≈ 1/4 of f32, ~1/2 of bf16
-for large tensors), dequantizing into an f32 accumulator at each hop.
+the collective — safe because fp16/bf16 can absorb the summation.
+1-byte formats cannot work that way: int8 payloads quantized with
+different per-rank scales don't sum, and fp8 e4m3 saturates at ±448 so
+accumulating partial sums IN the wire dtype produces NaN.  This module
+therefore implements the collective itself: a **ring reduce-scatter →
+allgather** over `ppermute` where every hop transmits a 1-byte payload
+(wire ≈ 1/4 of f32) and the ACCUMULATION always happens in f32.
 
-Precision: blockwise max-abs scaling (128-element blocks); each of the
-n-1 reduce hops requantizes the partial sum, so worst-case relative
-error grows ~linearly in ring size — fine for gradient averaging (the
-EQuARX regime), not for exact-sum semantics.  Tests bound the error
-against the exact psum.
+Wire codecs (both ship f32 blockwise scales per 128 elements — fp8
+needs the normalization too or later hops' partial sums overflow):
+  - "int8": blockwise max-abs scaled int8 (relative step ~1/127);
+  - "fp8_e4m3"/"fp8_e5m2": blockwise-normalized fp8 payload
+    (relative step ~1/16 / ~1/8).
 
-Usage: inside shard_map via `quantized_allreduce_shard(x, axis)`, at
-mesh level via `quantized_allreduce(x, mesh)`, or end-to-end through
-`hvd.data_parallel` with `Compression.int8`
-(parallel/data_parallel.py routes int8 buckets here).
+Precision: each of the n-1 reduce hops re-encodes the f32 partial sum,
+so worst-case error grows ~linearly in ring size — fine for gradient
+averaging (the EQuARX regime), not for exact-sum semantics.  Tests
+bound the error against the exact psum.
+
+Usage: inside shard_map via `quantized_allreduce_shard(x, axis,
+wire=...)`, at mesh level via `quantized_allreduce(x, mesh)`, or
+end-to-end through `hvd.data_parallel` with `Compression.int8` /
+`Compression.fp8_*` (parallel/data_parallel.py routes those buckets
+here).
 """
 
 from __future__ import annotations
-
-import functools
 
 import math
 
@@ -51,13 +56,44 @@ def _dequant(q: jax.Array, scale: jax.Array):
     return (blocks * scale[:, None]).reshape(-1)
 
 
+def _fp8_encode(v: jax.Array, dt):
+    """Blockwise-normalized fp8: scale each block by its max-abs so the
+    payload sits in [-1, 1] — partial sums on later ring hops would
+    otherwise exceed e4m3's ±448 finite range and NaN."""
+    blocks = v.reshape(-1, _BLOCK)
+    scale = jnp.max(jnp.abs(blocks), axis=1)
+    scale = jnp.where(scale > 0, scale, 1.0)
+    q = (blocks / scale[:, None]).astype(dt)
+    return q.reshape(-1), scale
+
+
+def _fp8_decode(q: jax.Array, scale: jax.Array):
+    blocks = q.astype(jnp.float32).reshape(-1, _BLOCK)
+    return (blocks * scale[:, None]).reshape(-1)
+
+
+def _codec(wire: str):
+    """(encode: f32 vec -> tuple of wire arrays, decode: tuple -> f32)."""
+    if wire == "int8":
+        return (lambda v: _quant(v)), (lambda p: _dequant(*p))
+    if wire in ("fp8_e4m3", "fp8_e5m2"):
+        dt = (jnp.float8_e4m3fn if wire == "fp8_e4m3"
+              else jnp.float8_e5m2)
+        return ((lambda v: _fp8_encode(v, dt)),
+                (lambda p: _fp8_decode(*p)))
+    raise ValueError(f"unknown wire codec {wire!r}")
+
+
 def quantized_allreduce_shard(x: jax.Array, axis: str,
-                              average: bool = False) -> jax.Array:
-    """Sum (or average) `x` across `axis` with int8 ring transport.
+                              average: bool = False,
+                              wire: str = "int8") -> jax.Array:
+    """Sum (or average) `x` across `axis` with 1-byte ring transport
+    (`wire`: "int8" | "fp8_e4m3" | "fp8_e5m2") and f32 accumulation.
 
     Called inside shard_map with `axis` in scope; any shape/float dtype
     (computation in f32, result cast back).
     """
+    encode, decode = _codec(wire)
     n = lax.psum(1, axis)
     if n == 1:
         return x
@@ -70,16 +106,14 @@ def quantized_allreduce_shard(x: jax.Array, axis: str,
     acc = flat.reshape(n, chunk)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # --- ring reduce-scatter: n-1 hops of (int8 chunk + f32 scales) ---
+    # --- ring reduce-scatter: n-1 hops of 1-byte payload (+scales) ---
     def body(s, acc):
         send_idx = (idx - s) % n
         v = lax.dynamic_slice(acc, (send_idx, 0), (1, chunk))[0]
-        q, sc = _quant(v)
-        q = lax.ppermute(q, axis, perm)
-        sc = lax.ppermute(sc, axis, perm)
+        payload = tuple(lax.ppermute(p, axis, perm) for p in encode(v))
         recv_idx = (idx - s - 1) % n
         mine = lax.dynamic_slice(acc, (recv_idx, 0), (1, chunk))[0]
-        upd = mine + _dequant(q, sc)
+        upd = mine + decode(payload)
         return lax.dynamic_update_slice(acc, upd[None], (recv_idx, 0))
 
     acc = lax.fori_loop(0, n - 1, body, acc)
@@ -87,15 +121,14 @@ def quantized_allreduce_shard(x: jax.Array, axis: str,
     # Rank i now owns the fully-reduced chunk (i + 1) % n.
     own_idx = (idx + 1) % n
     own = lax.dynamic_slice(acc, (own_idx, 0), (1, chunk))[0]
-    q, sc = _quant(own)
+    payload = encode(own)
 
-    # --- allgather phase (int8 wire) ---
-    qg = lax.all_gather(q, axis)            # (n, chunk) int8
-    scg = lax.all_gather(sc, axis)          # (n, chunk/_BLOCK) f32
+    # --- allgather phase (1-byte wire) ---
+    gathered = tuple(lax.all_gather(p, axis) for p in payload)
     # Chunk c was reduced by rank (c - 1) % n.
     order = jnp.array([(c - 1) % n for c in range(n)])
-    chunks = jax.vmap(_dequant)(jnp.take(qg, order, axis=0),
-                                jnp.take(scg, order, axis=0))
+    chunks = jax.vmap(lambda *p: decode(p))(
+        *(jnp.take(g, order, axis=0) for g in gathered))
     out = chunks.reshape(-1)[: math.prod(shape)].reshape(shape)
     if average:
         out = out / n
@@ -103,7 +136,8 @@ def quantized_allreduce_shard(x: jax.Array, axis: str,
 
 
 def quantized_allreduce(stacked: jax.Array, mesh: Mesh, axis: str = None,
-                        average: bool = False) -> jax.Array:
+                        average: bool = False,
+                        wire: str = "int8") -> jax.Array:
     """Mesh-level wrapper over per-rank contributions: `stacked` has
     shape (n, *shape) with row r being rank r's tensor (the PerRank
     convention of the eager collectives); returns (n, *shape) with
@@ -111,8 +145,8 @@ def quantized_allreduce(stacked: jax.Array, mesh: Mesh, axis: str = None,
     axis = axis or mesh.axis_names[0]
 
     def _fn(x):
-        return quantized_allreduce_shard(x[0], axis,
-                                         average=average)[None]
+        return quantized_allreduce_shard(x[0], axis, average=average,
+                                         wire=wire)[None]
 
     fn = shard_map(_fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
                    check_vma=False)

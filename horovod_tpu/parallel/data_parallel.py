@@ -78,23 +78,26 @@ def allreduce_gradients(
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
-    from ..ops.compression import Int8Compressor
-    if compression is Int8Compressor:
+    from ..ops.compression import _CooperativeCompressor
+    if isinstance(compression, type) and \
+            issubclass(compression, _CooperativeCompressor):
+        wire = compression.wire
         # Cooperative wire format: the quantized ring allreduce IS the
         # collective (ops/quantized.py).  In-jit only — it needs the
         # mesh axis in scope.
         if axis_name is None:
             raise ValueError(
-                "Compression.int8 requires the in-jit path (axis_name; "
-                "e.g. inside hvd.data_parallel) — the quantized ring "
+                f"Compression.{wire} requires the in-jit path (axis_name;"
+                " e.g. inside hvd.data_parallel) — the quantized ring "
                 "collective needs the mesh axis in scope")
         if process_set is not None:
             raise ValueError(
-                "Compression.int8 does not support process_set subsets; "
-                "use fp16/bf16 compression for subset reductions")
+                f"Compression.{wire} does not support process_set "
+                "subsets; use fp16/bf16 compression for subset "
+                "reductions")
         if op not in (C.Average, C.Sum):
             raise ValueError(
-                f"Compression.int8 supports op=Average or Sum, got {op}")
+                f"Compression.{wire} supports op=Average or Sum, got {op}")
         from ..ops.quantized import quantized_allreduce_shard
 
         # Same size-capped bucketing as the exact path (fusion
@@ -106,7 +109,7 @@ def allreduce_gradients(
             flat = jnp.concatenate(
                 [leaves[i].astype(jnp.float32).reshape(-1) for i in idxs])
             reduced = quantized_allreduce_shard(
-                flat, axis_name, average=(op is C.Average))
+                flat, axis_name, average=(op is C.Average), wire=wire)
             offset = 0
             for i in idxs:
                 n = leaves[i].size

@@ -118,7 +118,8 @@ class HorovodEstimator(EstimatorParams):
                     meta: Dict[str, int]) -> Dict[str, Any]:
         if self.compression not in VALID_COMPRESSION:
             raise HorovodTpuError(
-                f"compression must be one of none/fp16/bf16, got "
+                f"compression must be one of "
+                f"{[c for c in VALID_COMPRESSION if c]}, got "
                 f"{self.compression!r}")
         if not isinstance(self.backward_passes_per_step, int) or \
                 self.backward_passes_per_step < 1:

@@ -361,6 +361,21 @@ def test_fp16_compression_roundtrip():
     np.testing.assert_allclose(np.asarray(d), np.asarray(x), rtol=1e-3)
 
 
+def test_one_byte_wire_formats_raise_on_cast_path():
+    # int8/fp8 are cooperative ring formats (quantized ring allreduce,
+    # f32 accumulate per hop) — a pre-collective cast would mis-sum
+    # (e4m3 saturates at ±448), so the cast path refuses loudly.
+    from horovod_tpu import Compression
+
+    for comp in (Compression.int8, Compression.fp8_e4m3,
+                 Compression.fp8_e5m2):
+        with pytest.raises(NotImplementedError, match="in-jit"):
+            comp.compress(jnp.ones((4,)))
+    with pytest.raises(ValueError, match="in-jit path"):
+        hvd.allreduce_gradients({"g": jnp.ones((4,))},
+                                compression=Compression.fp8_e4m3)
+
+
 # ---------------------------------------------------------------------------
 # Regression tests for review findings
 # ---------------------------------------------------------------------------

@@ -73,7 +73,7 @@ class TestExamples:
         # Spawns its own 2 worker processes (LocalBackend pins them to
         # CPU with clean XLA_FLAGS itself).
         out = _run_example("spark_estimator.py", ["--np", "2"],
-                           timeout=420)
+                           timeout=560)
         assert "ok" in out
 
     def test_transformer_lm_mesh(self):

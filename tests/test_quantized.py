@@ -68,6 +68,47 @@ class TestQuantizedAllreduce:
             np.asarray(out[0], dtype=np.float32), 8.0, rtol=0.02)
 
 
+class TestFp8Wire:
+    def test_fp8_ring_large_magnitudes_no_nan(self, mesh8):
+        # The scenario a wire-dtype psum would NaN on: 8 ranks of
+        # magnitude ~100 sums to ~800 > e4m3's ±448 — the ring
+        # accumulates in f32, so the result is finite and close.
+        contribs = np.full((8, 512), 100.0, np.float32)
+        out = np.asarray(quantized_allreduce(
+            jnp.asarray(contribs), mesh8, wire="fp8_e4m3"))
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out[0], 800.0, rtol=0.05)
+
+    # e4m3: 3 mantissa bits (rel step ~1/16); e5m2: 2 (~1/8) — both
+    # coarser than int8's 1/127, so looser bounds than the int8 tests.
+    @pytest.mark.parametrize("wire,bound",
+                             [("fp8_e4m3", 0.15), ("fp8_e5m2", 0.3)])
+    def test_fp8_ring_close_to_exact(self, mesh8, wire, bound):
+        rng = np.random.default_rng(3)
+        contribs = rng.normal(size=(8, 640)).astype(np.float32)
+        out = np.asarray(quantized_allreduce(
+            jnp.asarray(contribs), mesh8, wire=wire, average=True))
+        exact = contribs.mean(0)
+        assert np.abs(out[0] - exact).max() < bound
+
+    def test_dp_gradient_path_fp8(self, mesh8):
+        hvd.init()
+
+        def f(grads):
+            return hvd.allreduce_gradients(
+                grads, compression=hvd.Compression.fp8_e4m3,
+                axis_name=hvd.GLOBAL_AXIS)
+
+        out = hvd.data_parallel(
+            lambda s, o, b: (f({"g": jnp.full((256,), 100.0)}), o,
+                             jnp.float32(0)))(
+            {"x": jnp.zeros(())}, {}, hvd.shard_batch(
+                (jnp.zeros((8, 1)),)))
+        g = np.asarray(out[0]["g"])
+        assert np.isfinite(g).all()
+        np.testing.assert_allclose(g, 100.0, rtol=0.05)
+
+
 class TestInt8GradientPath:
     def test_data_parallel_int8_matches_exact_closely(self, mesh8):
         import optax
