@@ -36,6 +36,11 @@ def main():
                         "--use-adasum)")
     p.add_argument("--fp16-allreduce", action="store_true",
                    help="fp16 wire compression (reference --fp16-allreduce)")
+    p.add_argument("--compression", default=None,
+                   choices=["fp16", "bf16", "int8", "fp8_e4m3",
+                            "fp8_e5m2"],
+                   help="gradient wire compression; int8/fp8 use the "
+                        "quantized ring collective (ops/quantized.py)")
     args = p.parse_args()
     if args.image_size is None:
         args.image_size = 299 if args.model == "inception3" else 224
@@ -49,13 +54,26 @@ def main():
     cfg = v["config"]
     state = {"params": v["params"], "batch_stats": v["batch_stats"]}
 
-    compression = (hvd.Compression.fp16 if args.fp16_allreduce
-                   else hvd.Compression.none)
+    from horovod_tpu.ops.compression import _CooperativeCompressor
+
+    if args.compression:
+        compression = getattr(hvd.Compression, args.compression)
+    else:
+        compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                       else hvd.Compression.none)
+    cooperative = (isinstance(compression, type) and
+                   issubclass(compression, _CooperativeCompressor))
+    if args.use_adasum and cooperative:
+        p.error("--use-adasum bypasses gradient allreduce (it reduces "
+                "deltas), so 1-byte ring compression does not apply; "
+                "pick one")
     op = hvd.Adasum if args.use_adasum else hvd.Average
+    # 1-byte ring formats need the mesh axis (in-jit path).
+    axis_kw = {"axis_name": hvd.GLOBAL_AXIS} if cooperative else {}
     opt = hvd.DistributedOptimizer(
         optax.sgd(0.01 * (1 if args.use_adasum else hvd.size()),
                   momentum=0.9),
-        op=op, compression=compression)
+        op=op, compression=compression, **axis_kw)
     opt_state = opt.init(state["params"])
     state["params"] = hvd.broadcast_parameters(state["params"], root_rank=0)
 

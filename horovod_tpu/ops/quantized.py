@@ -59,17 +59,13 @@ def _dequant(q: jax.Array, scale: jax.Array):
 def _fp8_encode(v: jax.Array, dt):
     """Blockwise-normalized fp8: scale each block by its max-abs so the
     payload sits in [-1, 1] — partial sums on later ring hops would
-    otherwise exceed e4m3's ±448 finite range and NaN."""
+    otherwise exceed e4m3's ±448 finite range and NaN.  Decoding is
+    `_dequant` (payload * blockwise scale), shared with int8."""
     blocks = v.reshape(-1, _BLOCK)
     scale = jnp.max(jnp.abs(blocks), axis=1)
     scale = jnp.where(scale > 0, scale, 1.0)
     q = (blocks / scale[:, None]).astype(dt)
     return q.reshape(-1), scale
-
-
-def _fp8_decode(q: jax.Array, scale: jax.Array):
-    blocks = q.astype(jnp.float32).reshape(-1, _BLOCK)
-    return (blocks * scale[:, None]).reshape(-1)
 
 
 def _codec(wire: str):
@@ -79,8 +75,7 @@ def _codec(wire: str):
     if wire in ("fp8_e4m3", "fp8_e5m2"):
         dt = (jnp.float8_e4m3fn if wire == "fp8_e4m3"
               else jnp.float8_e5m2)
-        return ((lambda v: _fp8_encode(v, dt)),
-                (lambda p: _fp8_decode(*p)))
+        return (lambda v: _fp8_encode(v, dt)), (lambda p: _dequant(*p))
     raise ValueError(f"unknown wire codec {wire!r}")
 
 

@@ -65,6 +65,15 @@ class TestExamples:
              "--use-adasum", "--fp16-allreduce"])
         assert "Total img/sec" in out
 
+    def test_synthetic_benchmark_int8_ring(self):
+        out = _run_example(
+            "synthetic_benchmark.py",
+            ["--model", "resnet18", "--batch-size", "2",
+             "--image-size", "32", "--num-warmup-batches", "1",
+             "--num-batches-per-iter", "1", "--num-iters", "1",
+             "--compression", "int8"])
+        assert "Total img/sec" in out
+
     def test_torch_mnist(self):
         out = _run_example("torch_mnist.py", ["--epochs", "1"])
         assert "loss=" in out
