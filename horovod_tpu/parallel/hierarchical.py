@@ -34,11 +34,18 @@ def enabled() -> bool:
     return util.env_bool("HIERARCHICAL_ALLREDUCE", False)
 
 
-def hierarchical_reduce_leaf(x, dcn_axis: str, ici_axis: str, average: bool):
+def hierarchical_reduce_leaf(x, dcn_axis: str, ici_axis: str, average: bool,
+                             dcn_wire: str = None):
     """One leaf: flatten → psum_scatter(ICI) → psum(DCN) → all_gather(ICI).
 
     Padding makes any size divisible by the ICI axis; the pad rides the
     collectives as zeros and is sliced off before reshaping back.
+
+    `dcn_wire` ("int8" | "fp8_e4m3" | "fp8_e5m2") swaps the DCN leg —
+    the slow inter-slice tier, exactly where wire bytes dominate — for
+    the quantized ring collective (ops/quantized.py): each element
+    crosses DCN once per 1/ici_size shard AND at 1 byte instead of 4.
+    The fast ICI legs stay exact.  Env: HOROVOD_HIERARCHICAL_DCN_WIRE.
     """
     n_ici = lax.axis_size(ici_axis)
     n_dcn = lax.axis_size(dcn_axis)
@@ -47,7 +54,12 @@ def hierarchical_reduce_leaf(x, dcn_axis: str, ici_axis: str, average: bool):
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     s = lax.psum_scatter(flat, ici_axis, tiled=True)   # 1/n_ici shard, ICI sum
-    s = lax.psum(s, dcn_axis)                          # cross-slice, DCN
+    if dcn_wire:
+        from ..ops.quantized import quantized_allreduce_shard
+
+        s = quantized_allreduce_shard(s, dcn_axis, wire=dcn_wire)
+    else:
+        s = lax.psum(s, dcn_axis)                      # cross-slice, DCN
     g = lax.all_gather(s, ici_axis, tiled=True)        # reassemble over ICI
     if pad:
         g = g[: x.size]
@@ -62,6 +74,7 @@ def hierarchical_allreduce(
     dcn_axis: str = "dcn",
     ici_axis: Optional[str] = None,
     average: bool = True,
+    dcn_wire: Optional[str] = None,
 ):
     """Hierarchical allreduce of a pytree (gradients), fused: all leaves
     of one dtype are concatenated into a single flat buffer so the three
@@ -70,6 +83,8 @@ def hierarchical_allreduce(
     from ..common.basics import GLOBAL_AXIS
 
     ici_axis = ici_axis or GLOBAL_AXIS
+    if dcn_wire is None:
+        dcn_wire = util.getenv("HIERARCHICAL_DCN_WIRE") or None
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
         return tree
@@ -81,7 +96,11 @@ def hierarchical_allreduce(
         flats = [jnp.ravel(leaves[i]) for i in idxs]
         sizes = [f.size for f in flats]
         buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
-        red = hierarchical_reduce_leaf(buf, dcn_axis, ici_axis, average)
+        # Quantized wire is float-only: integer leaves (counters etc.)
+        # must keep summing exactly over the DCN psum.
+        leaf_wire = dcn_wire if jnp.issubdtype(dt, jnp.floating) else None
+        red = hierarchical_reduce_leaf(buf, dcn_axis, ici_axis, average,
+                                       dcn_wire=leaf_wire)
         off = 0
         for i, sz in zip(idxs, sizes):
             out[i] = red[off: off + sz].reshape(jnp.shape(leaves[i]))
@@ -98,8 +117,12 @@ def maybe_hierarchical(x, axes, op_name: str):
     if not enabled() or op_name not in ("Average", "Sum"):
         return None
     dcn_axis, ici_axis = axes
+    dcn_wire = None
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        dcn_wire = util.getenv("HIERARCHICAL_DCN_WIRE") or None
     return hierarchical_reduce_leaf(
-        x, dcn_axis, ici_axis, average=(op_name == "Average"))
+        x, dcn_axis, ici_axis, average=(op_name == "Average"),
+        dcn_wire=dcn_wire)
 
 
 __all__ = [

@@ -145,3 +145,59 @@ def test_process_set_rejected_on_slice_local_axis(hmesh):
             _run(f, hmesh, vals)
     finally:
         hvd.remove_process_set(ps)
+
+
+@pytest.mark.parametrize("wire", ["int8", "fp8_e4m3"])
+def test_quantized_dcn_wire(hmesh, wire):
+    """1-byte wire on the slow DCN leg only (ICI legs stay exact):
+    close to the exact hierarchical average, finite even at magnitudes
+    a raw fp8 cast would overflow on."""
+    rng = np.random.RandomState(3)
+    vals = [rng.normal(size=(300,)).astype(np.float32) * 50
+            for _ in range(N)]
+
+    def f(x):
+        out = hierarchical.hierarchical_allreduce(
+            {"g": x[0]}, "dcn", hvd.GLOBAL_AXIS, average=True,
+            dcn_wire=wire)
+        return out["g"]
+
+    out = np.asarray(_run(f, hmesh, vals))
+    exact = np.mean(np.stack(vals), axis=0)
+    assert np.isfinite(out).all()
+    # one quantized DCN hop on 1/4 shards: error ~ blockmax/127 scale
+    assert np.abs(out - exact).max() < np.abs(np.stack(vals)).max() / 25
+
+
+def test_dcn_wire_env_routing(hmesh, monkeypatch):
+    # Random per-block values make quantization error OBSERVABLE, so
+    # this fails if the env var stops routing to the quantized leg
+    # (constant inputs would quantize exactly and hide a regression).
+    rng = np.random.RandomState(7)
+    vals = [rng.normal(size=(256,)).astype(np.float32) * 30
+            for _ in range(N)]
+
+    def f(x):
+        out = hierarchical.hierarchical_allreduce(
+            {"g": x[0]}, "dcn", hvd.GLOBAL_AXIS, average=True)
+        return out["g"]
+
+    exact = np.asarray(_run(f, hmesh, vals))  # env unset: exact psum
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_DCN_WIRE", "int8")
+    quant = np.asarray(_run(f, hmesh, vals))
+    err = np.abs(quant - exact).max()
+    assert 1e-6 < err < 1.0, err  # quantized path ran, and stayed close
+
+
+def test_dcn_wire_skips_integer_leaves(hmesh, monkeypatch):
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_DCN_WIRE", "int8")
+    vals = [np.full((64,), 1000, np.int32) for _ in range(N)]
+
+    def f(x):
+        out = hierarchical.hierarchical_allreduce(
+            {"count": x[0]}, "dcn", hvd.GLOBAL_AXIS, average=False)
+        return out["count"]
+
+    out = np.asarray(_run(f, hmesh, vals))
+    # integer state must sum EXACTLY (quantized wire would wobble it)
+    np.testing.assert_array_equal(out, 1000 * N)
