@@ -34,6 +34,7 @@ def run_torch(df, np_workers):
     from horovod_tpu.spark.common import LocalBackend
     from horovod_tpu.spark.torch import TorchEstimator
 
+    torch.manual_seed(0)  # model INIT must be seeded too, not just training
     net = torch.nn.Sequential(torch.nn.Linear(2, 16), torch.nn.ReLU(),
                               torch.nn.Linear(16, 1))
     est = TorchEstimator(
@@ -41,7 +42,7 @@ def run_torch(df, np_workers):
         optimizer=torch.optim.Adam(net.parameters(), lr=0.01),
         loss=torch.nn.functional.mse_loss,
         feature_cols=["a", "b"], label_cols=["y"],
-        batch_size=32, epochs=10, validation=0.2, random_seed=0,
+        batch_size=32, epochs=20, validation=0.2, random_seed=0,
         backend=LocalBackend(np_workers, start_timeout=300))
     model = est.fit(df)
     return model, model.get_history()["loss"]
@@ -53,6 +54,7 @@ def run_keras(df, np_workers):
     from horovod_tpu.spark.common import LocalBackend
     from horovod_tpu.spark.keras import KerasEstimator
 
+    tf.keras.utils.set_random_seed(0)  # seed the model init too
     m = tf.keras.Sequential([
         tf.keras.layers.Input((2,)),
         tf.keras.layers.Dense(16, activation="relu"),
@@ -61,7 +63,7 @@ def run_keras(df, np_workers):
     est = KerasEstimator(
         model=m, optimizer=tf.keras.optimizers.Adam(0.01), loss="mse",
         feature_cols=["a", "b"], label_cols=["y"],
-        batch_size=32, epochs=10, validation=0.2, random_seed=0,
+        batch_size=32, epochs=20, validation=0.2, random_seed=0,
         backend=LocalBackend(np_workers, start_timeout=300))
     model = est.fit(df)
     return model, model.get_history()["loss"]

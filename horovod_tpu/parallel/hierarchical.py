@@ -29,6 +29,18 @@ from ..common import util
 from ..common.exceptions import HorovodTpuError
 
 
+def _env_dcn_wire(dtype, op_name: str = "Average"):
+    """Env-driven wire for a leaf: only float dtypes (integers must sum
+    exactly) and only averaging semantics (quantized transport is
+    documented as not-for-exact-sums; explicit hierarchical_allreduce
+    calls can still pass dcn_wire= deliberately)."""
+    if op_name != "Average":
+        return None
+    if not jnp.issubdtype(dtype, jnp.floating):
+        return None
+    return util.getenv("HIERARCHICAL_DCN_WIRE") or None
+
+
 def enabled() -> bool:
     """Env switch, reference name kept (HOROVOD_HIERARCHICAL_ALLREDUCE)."""
     return util.env_bool("HIERARCHICAL_ALLREDUCE", False)
@@ -83,8 +95,7 @@ def hierarchical_allreduce(
     from ..common.basics import GLOBAL_AXIS
 
     ici_axis = ici_axis or GLOBAL_AXIS
-    if dcn_wire is None:
-        dcn_wire = util.getenv("HIERARCHICAL_DCN_WIRE") or None
+    env_wire = dcn_wire is None
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
         return tree
@@ -98,7 +109,12 @@ def hierarchical_allreduce(
         buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
         # Quantized wire is float-only: integer leaves (counters etc.)
         # must keep summing exactly over the DCN psum.
-        leaf_wire = dcn_wire if jnp.issubdtype(dt, jnp.floating) else None
+        if env_wire:
+            leaf_wire = _env_dcn_wire(
+                dt, "Average" if average else "Sum")
+        else:
+            leaf_wire = dcn_wire if jnp.issubdtype(dt, jnp.floating) \
+                else None
         red = hierarchical_reduce_leaf(buf, dcn_axis, ici_axis, average,
                                        dcn_wire=leaf_wire)
         off = 0
@@ -117,12 +133,9 @@ def maybe_hierarchical(x, axes, op_name: str):
     if not enabled() or op_name not in ("Average", "Sum"):
         return None
     dcn_axis, ici_axis = axes
-    dcn_wire = None
-    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
-        dcn_wire = util.getenv("HIERARCHICAL_DCN_WIRE") or None
     return hierarchical_reduce_leaf(
         x, dcn_axis, ici_axis, average=(op_name == "Average"),
-        dcn_wire=dcn_wire)
+        dcn_wire=_env_dcn_wire(jnp.asarray(x).dtype, op_name))
 
 
 __all__ = [

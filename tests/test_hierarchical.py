@@ -201,3 +201,31 @@ def test_dcn_wire_skips_integer_leaves(hmesh, monkeypatch):
     out = np.asarray(_run(f, hmesh, vals))
     # integer state must sum EXACTLY (quantized wire would wobble it)
     np.testing.assert_array_equal(out, 1000 * N)
+
+
+def test_dcn_wire_on_auto_dispatch_path(hmesh, monkeypatch):
+    """The production entry point: hvd.allreduce with the 2-axis tuple
+    plus BOTH env flags routes the DCN leg through the quantized ring
+    (Average only; Sum keeps exact semantics)."""
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "1")
+    rng = np.random.RandomState(11)
+    vals = [rng.normal(size=(256,)).astype(np.float32) * 30
+            for _ in range(N)]
+
+    def favg(x):
+        return hvd.allreduce(x[0], axis_name=("dcn", hvd.GLOBAL_AXIS))
+
+    exact = np.asarray(_run(favg, hmesh, vals))
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_DCN_WIRE", "int8")
+    quant = np.asarray(_run(favg, hmesh, vals))
+    err = np.abs(quant - exact).max()
+    assert 1e-6 < err < 1.0, err  # wire engaged, close to exact
+
+    def fsum(x):
+        return hvd.allreduce(x[0], op=hvd.Sum,
+                             axis_name=("dcn", hvd.GLOBAL_AXIS))
+
+    # op=Sum: exact-sum semantics preserved — wire must NOT engage.
+    s = np.asarray(_run(fsum, hmesh, vals))
+    np.testing.assert_allclose(s, np.sum(np.stack(vals), 0), rtol=1e-5,
+                               atol=1e-4)
