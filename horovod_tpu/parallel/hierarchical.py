@@ -29,12 +29,12 @@ from ..common import util
 from ..common.exceptions import HorovodTpuError
 
 
-def _env_dcn_wire(dtype, op_name: str = "Average"):
+def _env_dcn_wire(dtype, average: bool):
     """Env-driven wire for a leaf: only float dtypes (integers must sum
     exactly) and only averaging semantics (quantized transport is
     documented as not-for-exact-sums; explicit hierarchical_allreduce
     calls can still pass dcn_wire= deliberately)."""
-    if op_name != "Average":
+    if not average:
         return None
     if not jnp.issubdtype(dtype, jnp.floating):
         return None
@@ -110,8 +110,7 @@ def hierarchical_allreduce(
         # Quantized wire is float-only: integer leaves (counters etc.)
         # must keep summing exactly over the DCN psum.
         if env_wire:
-            leaf_wire = _env_dcn_wire(
-                dt, "Average" if average else "Sum")
+            leaf_wire = _env_dcn_wire(dt, average)
         else:
             leaf_wire = dcn_wire if jnp.issubdtype(dt, jnp.floating) \
                 else None
@@ -133,9 +132,10 @@ def maybe_hierarchical(x, axes, op_name: str):
     if not enabled() or op_name not in ("Average", "Sum"):
         return None
     dcn_axis, ici_axis = axes
+    average = op_name == "Average"
     return hierarchical_reduce_leaf(
-        x, dcn_axis, ici_axis, average=(op_name == "Average"),
-        dcn_wire=_env_dcn_wire(jnp.asarray(x).dtype, op_name))
+        x, dcn_axis, ici_axis, average=average,
+        dcn_wire=_env_dcn_wire(jnp.asarray(x).dtype, average))
 
 
 __all__ = [
