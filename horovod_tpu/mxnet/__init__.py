@@ -152,6 +152,28 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     return _like(tensor, out)
 
 
+def reducescatter(tensor, op=C.Average, name: Optional[str] = None,
+                  priority: int = 0,
+                  process_set: Optional[ProcessSet] = None):
+    """Reference: hvd.reducescatter (mxnet/mpi_ops.py) — reduce across
+    ranks, return this rank's 1/size slice of dim 0."""
+    out = C.reducescatter(_to_np(tensor), op=op, name=name,
+                          process_set=process_set)
+    return _like(tensor, out)
+
+
+def grouped_reducescatter(tensors, op=C.Average,
+                          name: Optional[str] = None, priority: int = 0):
+    outs = C.grouped_reducescatter([_to_np(t) for t in tensors], op=op)
+    return [_like(t, o) for t, o in zip(tensors, outs)]
+
+
+def grouped_allgather(tensors, name: Optional[str] = None,
+                      priority: int = 0):
+    outs = C.grouped_allgather([_to_np(t) for t in tensors])
+    return [_like(t, o) for t, o in zip(tensors, outs)]
+
+
 # ---------------------------------------------------------------------------
 # Parameter broadcast (reference: horovod/mxnet/__init__.py
 # broadcast_parameters)
@@ -271,6 +293,9 @@ def DistributedTrainer(params, optimizer, optimizer_params=None,
 
 
 __all__ = [
+    "reducescatter",
+    "grouped_reducescatter",
+    "grouped_allgather",
     "init", "shutdown", "size", "rank", "local_size", "local_rank",
     "cross_size", "cross_rank",
     "allreduce", "allreduce_", "grouped_allreduce", "grouped_allreduce_",

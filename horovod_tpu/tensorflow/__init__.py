@@ -211,6 +211,39 @@ def grouped_reducescatter(tensors: Sequence, op=Average,
                                  out_shape_fn=_out_shape)
 
 
+def size_op(process_set: Optional[ProcessSet] = None,
+            name: Optional[str] = None):
+    """Graph-mode tensor variant (reference: tensorflow/mpi_ops.py
+    size_op — runtime-evaluated for elastic).  Under SPMD the world
+    size is compiled into the program, so a constant is the honest
+    equivalent; elastic re-init re-traces with the new size."""
+    n = len(process_set.ranks) if process_set is not None else size()
+    return tf.constant(n, dtype=tf.int32, name=name)
+
+
+def rank_op(name: Optional[str] = None):
+    """Graph-mode rank tensor (reference: mpi_ops.py rank_op)."""
+    return tf.constant(rank(), dtype=tf.int32, name=name)
+
+
+def local_rank_op(name: Optional[str] = None):
+    return tf.constant(local_rank(), dtype=tf.int32, name=name)
+
+
+def local_size_op(name: Optional[str] = None):
+    return tf.constant(local_size(), dtype=tf.int32, name=name)
+
+
+def process_set_included_op(process_set: ProcessSet,
+                            name: Optional[str] = None):
+    """1 if this process participates in `process_set` else 0
+    (reference: mpi_ops.py process_set_included_op).  Uses the same
+    membership predicate the collectives use, which accounts for every
+    local device this process drives."""
+    return tf.constant(int(process_set.included()), dtype=tf.int32,
+                       name=name)
+
+
 def allgather(tensor, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None):
     """First-dim concatenation across ranks (variable dim0 supported, like

@@ -65,6 +65,23 @@ class TestMxnetOps:
         out = hvd_mx.broadcast(t, root_rank=0)
         np.testing.assert_allclose(out.asnumpy(), 7.0)
 
+    def test_reducescatter(self):
+        t = FakeNDArray(np.ones((2 * N, 3), np.float32))
+        out = hvd_mx.reducescatter(t)
+        # average of identical inputs -> this rank's 1/N slice
+        assert out.asnumpy().shape == (2, 3)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+    def test_grouped_reducescatter_and_allgather(self):
+        ts = [FakeNDArray(np.ones((N, 2), np.float32)),
+              FakeNDArray(np.ones((2 * N,), np.float32))]
+        outs = hvd_mx.grouped_reducescatter(ts)
+        assert outs[0].asnumpy().shape == (1, 2)
+        assert outs[1].asnumpy().shape == (2,)
+        gs = hvd_mx.grouped_allgather(
+            [FakeNDArray(np.ones((1, 2), np.float32))])
+        assert gs[0].asnumpy().shape == (N, 2)
+
     def test_alltoall(self):
         t = FakeNDArray(np.arange(N, dtype=np.float32))
         out = hvd_mx.alltoall(t)

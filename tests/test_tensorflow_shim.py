@@ -56,6 +56,18 @@ class TestTfOps:
         np.testing.assert_allclose(outs[0].numpy(), np.ones(2))
         np.testing.assert_allclose(outs[1].numpy(), [2.0, 4.0, 6.0])
 
+    def test_graph_mode_op_variants(self):
+        assert int(hvd_tf.size_op().numpy()) == hvd_tf.size()
+        assert int(hvd_tf.rank_op().numpy()) == hvd_tf.rank()
+        assert int(hvd_tf.local_size_op().numpy()) == hvd_tf.local_size()
+        ps = hvd_tf.add_process_set([0, 1])
+        try:
+            assert int(hvd_tf.size_op(ps).numpy()) == 2
+            included = int(hvd_tf.process_set_included_op(ps).numpy())
+            assert included == int(hvd_tf.rank() in (0, 1))
+        finally:
+            hvd_tf.remove_process_set(ps)
+
     def test_grouped_allgather(self):
         ts = [tf.ones([2, 3]), tf.zeros([1, 3])]
         outs = hvd_tf.grouped_allgather(ts)
