@@ -163,14 +163,18 @@ def reducescatter(tensor, op=C.Average, name: Optional[str] = None,
 
 
 def grouped_reducescatter(tensors, op=C.Average,
-                          name: Optional[str] = None, priority: int = 0):
-    outs = C.grouped_reducescatter([_to_np(t) for t in tensors], op=op)
+                          name: Optional[str] = None, priority: int = 0,
+                          process_set: Optional[ProcessSet] = None):
+    outs = C.grouped_reducescatter([_to_np(t) for t in tensors], op=op,
+                                   process_set=process_set)
     return [_like(t, o) for t, o in zip(tensors, outs)]
 
 
 def grouped_allgather(tensors, name: Optional[str] = None,
-                      priority: int = 0):
-    outs = C.grouped_allgather([_to_np(t) for t in tensors])
+                      priority: int = 0,
+                      process_set: Optional[ProcessSet] = None):
+    outs = C.grouped_allgather([_to_np(t) for t in tensors],
+                               process_set=process_set)
     return [_like(t, o) for t, o in zip(tensors, outs)]
 
 

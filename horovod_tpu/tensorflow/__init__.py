@@ -214,9 +214,12 @@ def grouped_reducescatter(tensors: Sequence, op=Average,
 def size_op(process_set: Optional[ProcessSet] = None,
             name: Optional[str] = None):
     """Graph-mode tensor variant (reference: tensorflow/mpi_ops.py
-    size_op — runtime-evaluated for elastic).  Under SPMD the world
-    size is compiled into the program, so a constant is the honest
-    equivalent; elastic re-init re-traces with the new size."""
+    size_op).  Under SPMD the world size is compiled into the program,
+    so this is a CONSTANT baked into any tf.function trace that
+    captures it.  After an elastic resize, rebuild such tf.functions
+    (the reference's runtime-evaluated op has no SPMD analog —
+    `TensorFlowKerasState.sync` rebuilds the model-side state, and
+    size-dependent step functions must be re-created alongside it)."""
     n = len(process_set.ranks) if process_set is not None else size()
     return tf.constant(n, dtype=tf.int32, name=name)
 

@@ -22,6 +22,40 @@ from ..elastic import (  # noqa: F401
 from ..ops.functions import broadcast_object
 
 
+class TensorFlowState(ObjectState):
+    """Elastic state over raw tf.Variables (reference:
+    tensorflow/elastic.py `TensorFlowState` — the non-Keras form used
+    with custom training loops).
+
+    Pass the variables to track (or none to track nothing but the
+    ObjectState scalars); save/restore snapshot host-side numpy copies;
+    sync broadcasts rank 0's values.
+    """
+
+    def __init__(self, variables=None, **kwargs):
+        self.variables = list(variables) if variables is not None else []
+        self._values = None
+        super().__init__(**kwargs)
+
+    def save(self) -> None:
+        self._values = [v.numpy() for v in self.variables]
+        super().save()
+
+    def restore(self) -> None:
+        if self._values is not None:
+            for var, val in zip(self.variables, self._values):
+                var.assign(val)
+        super().restore()
+
+    def sync(self) -> None:
+        if self.variables:
+            synced = broadcast_object(
+                [v.numpy() for v in self.variables], root_rank=0)
+            for var, val in zip(self.variables, synced):
+                var.assign(val)
+        super().sync()
+
+
 class TensorFlowKerasState(ObjectState):
     """Elastic state for a Keras model (+ optimizer variables + scalars).
 
@@ -78,4 +112,5 @@ class TensorFlowKerasState(ObjectState):
         super().sync()
 
 
-__all__ = ["TensorFlowKerasState", "broadcast_object"]
+__all__ = ["TensorFlowState",
+    "TensorFlowKerasState", "broadcast_object"]

@@ -331,6 +331,29 @@ class TestSyncBatchNormalization:
         assert np.isfinite(out.numpy()).all()
 
 
+class TestTensorFlowElasticState:
+    """Reference: tensorflow/elastic.py TensorFlowState (raw variables,
+    custom training loops)."""
+
+    def test_save_restore_and_sync(self):
+        tf = pytest.importorskip("tensorflow")
+        import horovod_tpu.tensorflow as hvd_tf
+
+        v1 = tf.Variable([1.0, 2.0])
+        v2 = tf.Variable(3.0)
+        state = hvd_tf.elastic.TensorFlowState(
+            variables=[v1, v2], step=5)
+        v1.assign([9.0, 9.0])
+        v2.assign(0.0)
+        state.step = 11
+        state.restore()
+        np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+        assert float(v2.numpy()) == 3.0
+        assert state.step == 5
+        state.sync()  # size 1: values unchanged, no error
+        np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+
+
 class TestTensorFlowKerasElasticState:
     """Reference: horovod/tensorflow/elastic.py TensorFlowKerasState."""
 
