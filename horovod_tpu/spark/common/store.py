@@ -7,10 +7,11 @@ training data materialized from the DataFrame, checkpoints, and logs.
 
 TPU-native redesign: the reference materializes DataFrames to Parquet
 and reads them back through Petastorm.  Here intermediate shards are
-**numpy `.npz` part files, one per worker rank** — the loader is
-`np.load` (zero extra deps, mmap-friendly) and the shard count is the
-worker count, so each worker reads exactly one file.  Checkpoints are
-single pickled blobs written atomically (tmp + rename).
+**raw `.npy` pairs, one per worker rank** — readable fully via
+`np.load` or memory-mapped via `ShardDataLoader` (zero extra deps),
+and the shard count is the worker count so each worker reads exactly
+its own pair.  Checkpoints are single pickled blobs written atomically
+(tmp + rename).
 
 `Store.create(prefix)` mirrors the reference factory: local paths (and
 `file://`) get a `LocalStore`; remote schemes (`hdfs://`, `s3://`,
@@ -150,9 +151,10 @@ class LocalStore(Store):
             shutil.rmtree(self._prefix, ignore_errors=True)
 
 
-# Part-file naming shared by writer (util.py) and the remote trainers.
+# Shard base name shared by writer (util.py) and the remote trainers;
+# actual files are <base>.x.npy / <base>.y.npy (see util.shard_paths).
 def part_name(rank: int) -> str:
-    return f"part-{rank:05d}.npz"
+    return f"part-{rank:05d}"
 
 
 # Single source of truth for the checkpoint filename used by

@@ -22,8 +22,9 @@ import numpy as np
 from ...common.exceptions import HorovodTpuError
 from .store import Store, part_name
 
-# Single replicated validation file (every rank reads the same data).
-VAL_FILE = "val.npz"
+# Single replicated validation shard (every rank reads the same data);
+# files are val.x.npy / val.y.npy (see shard_paths).
+VAL_BASE = "val"
 
 # Wire-compression names the estimators accept (resolved on the worker
 # against the frontend's Compression registry).
@@ -117,8 +118,12 @@ def prepare_data(
     dropped): every rank must run the same number of optimizer steps
     per epoch or the per-batch gradient allreduces desynchronize — the
     reference enforces the same via steps_per_epoch over Petastorm
-    readers.  Validation rows go to ONE shared `val.npz` (`VAL_FILE`,
-    read via `load_val`) since they are identical for every rank.
+    readers.  Validation rows go to ONE shared val shard (read via
+    `load_val`) since they are identical for every rank.
+
+    Shards are raw `.npy` pairs (`<base>.x.npy` / `<base>.y.npy`) so
+    workers can memory-map them (`ShardDataLoader`) instead of
+    decompressing a zip into RAM.
     Returns metadata {train_rows, val_rows, features_dim, labels_dim};
     train_rows is the post-truncation total actually used.
     """
@@ -149,12 +154,12 @@ def prepare_data(
     tr_idx = tr_idx[:per_shard * num_shards]
     for r in range(num_shards):
         shard = tr_idx[r * per_shard:(r + 1) * per_shard]
-        _write_npz(store, os.path.join(train_dir, part_name(r)),
-                   x[shard], y[shard])
+        _write_shard(store, os.path.join(train_dir, part_name(r)),
+                     x[shard], y[shard])
     if len(va_idx):
-        # Replicated by design → ONE file all ranks read, not one
+        # Replicated by design → ONE shard all ranks read, not one
         # identical copy per rank.
-        _write_npz(store, os.path.join(val_dir, VAL_FILE), xv, yv)
+        _write_shard(store, os.path.join(val_dir, VAL_BASE), xv, yv)
     return {
         "train_rows": int(len(tr_idx)),
         "val_rows": int(len(va_idx)),
@@ -163,25 +168,35 @@ def prepare_data(
     }
 
 
-def _write_npz(store: Store, path: str, x: np.ndarray, y: np.ndarray):
+def shard_paths(data_dir: str, rank) -> Tuple[str, str]:
+    """(features, labels) .npy paths for a shard base: an int rank maps
+    to its part file; a string is used as the base directly (val)."""
+    base = part_name(rank) if isinstance(rank, int) else rank
+    base = os.path.join(data_dir, base)
+    return f"{base}.x.npy", f"{base}.y.npy"
+
+
+def _write_shard(store: Store, base_path: str, x: np.ndarray,
+                 y: np.ndarray):
     import io
 
-    buf = io.BytesIO()
-    np.savez(buf, x=x, y=y)
-    store.write_bytes(path, buf.getvalue())
+    for suffix, arr in ((".x.npy", x), (".y.npy", y)):
+        buf = io.BytesIO()
+        np.save(buf, arr)
+        store.write_bytes(base_path + suffix, buf.getvalue())
 
 
 def load_shard(data_dir: str, rank: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Worker-side: load this rank's part file."""
-    path = os.path.join(data_dir, part_name(rank))
-    with np.load(path) as z:
-        return z["x"], z["y"]
+    """Worker-side: load this rank's shard fully into memory (use
+    `ShardDataLoader` to iterate it memory-mapped instead)."""
+    xp, yp = shard_paths(data_dir, rank)
+    return np.load(xp), np.load(yp)
 
 
 def load_val(val_dir: str) -> Tuple[np.ndarray, np.ndarray]:
-    """Worker-side: load the shared (replicated) validation file."""
-    with np.load(os.path.join(val_dir, VAL_FILE)) as z:
-        return z["x"], z["y"]
+    """Worker-side: load the shared (replicated) validation shard."""
+    xp, yp = shard_paths(val_dir, VAL_BASE)
+    return np.load(xp), np.load(yp)
 
 
 def to_output_frame(pdf, output_cols: List[str], preds: np.ndarray):
@@ -204,5 +219,5 @@ def to_output_frame(pdf, output_cols: List[str], preds: np.ndarray):
     return pdf
 
 
-__all__ = ["prepare_data", "load_shard", "load_val", "VAL_FILE",
-           "to_pandas", "to_output_frame"]
+__all__ = ["prepare_data", "load_shard", "load_val", "shard_paths",
+           "VAL_BASE", "to_pandas", "to_output_frame"]

@@ -26,7 +26,8 @@ import numpy as np
 from ...common.exceptions import HorovodTpuError
 from ..common.estimator import HorovodEstimator, HorovodModel
 from ..common.store import save_checkpoint
-from ..common.util import load_shard, load_val, resolve_compression
+from ..common.data_loader import ShardDataLoader
+from ..common.util import load_val, resolve_compression
 
 
 def _optimizer_recipe(optimizer):
@@ -119,9 +120,13 @@ def _torch_remote_trainer(spec: Dict[str, Any]):
             return t[:, 0].long()
         return t
 
-    x, y = load_shard(spec["train_dir"], hvd_t.rank())
-    xt = torch.from_numpy(np.ascontiguousarray(x))
-    yt = _label_tensor(y)
+    # Memory-mapped minibatch iteration (reference: data_loaders/ over
+    # Petastorm).  prepare_data guarantees equal shard sizes, so every
+    # rank sees the same batch count (collectives stay in lockstep);
+    # drop_last=False keeps the partial final batch training.
+    loader = ShardDataLoader(
+        spec["train_dir"], hvd_t.rank(), spec["batch_size"],
+        shuffle=spec["shuffle"], seed=spec["seed"], drop_last=False)
     val = None
     # Only rank 0 reports history, so only it loads/evaluates val data
     # (keras differs: its MetricAverageCallback allreduces val metrics,
@@ -130,19 +135,14 @@ def _torch_remote_trainer(spec: Dict[str, Any]):
         xv, yv = load_val(spec["val_dir"])
         val = (torch.from_numpy(np.ascontiguousarray(xv)),
                _label_tensor(yv))
-    n = len(xt)
-    bs = spec["batch_size"]
     losses, val_losses = [], []
     for epoch in range(spec["epochs"]):
-        order = (torch.randperm(n) if spec["shuffle"]
-                 else torch.arange(n))
         epoch_loss, batches = 0.0, 0
         model.train()
-        for i in range(0, n, bs):
-            idx = order[i:i + bs]
+        for xb, yb in loader.epoch(epoch):
             dist_opt.zero_grad()
-            out = model(xt[idx])
-            loss = loss_fn(out, yt[idx])
+            out = model(torch.from_numpy(xb))
+            loss = loss_fn(out, _label_tensor(yb))
             loss.backward()
             dist_opt.step()
             epoch_loss += float(loss.detach())
