@@ -363,6 +363,12 @@ def run_keras_bench() -> float:
 # ---------------------------------------------------------------------------
 
 def run_bench(platform: str) -> dict:
+    # Experiment hook: extra XLA flags (e.g. latency-hiding scheduler
+    # sweeps) without editing the harness.
+    extra_flags = os.environ.get("HOROVOD_BENCH_XLA_FLAGS")
+    if extra_flags:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + extra_flags).strip()
     if platform == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
