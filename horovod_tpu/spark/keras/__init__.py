@@ -25,7 +25,8 @@ import numpy as np
 from ...common.exceptions import HorovodTpuError
 from ..common.estimator import HorovodEstimator, HorovodModel
 from ..common.store import save_checkpoint
-from ..common.util import load_shard, load_val, resolve_compression
+from ..common.data_loader import ShardDataLoader
+from ..common.util import load_val, resolve_compression
 
 
 def _serialize_keras(model, optimizer, loss, metrics, custom_objects):
@@ -77,20 +78,34 @@ def _keras_remote_trainer(spec: Dict[str, Any]):
         backward_passes_per_step=spec.get("backward_passes_per_step", 1))
     model.compile(optimizer=dist_opt, loss=loss, metrics=metrics or None)
 
-    x, y = load_shard(spec["train_dir"], hvd_k.rank())
-    if y.shape[1] == 1:
-        y = y[:, 0]
+    # Memory-mapped minibatch feeding (reference: data_loaders/ over
+    # Petastorm): a generator over the rank's shard with seeded
+    # per-epoch shuffles; steps_per_epoch bounds each keras epoch.
+    loader = ShardDataLoader(
+        spec["train_dir"], hvd_k.rank(), spec["batch_size"],
+        shuffle=spec["shuffle"], seed=spec["seed"], drop_last=False)
+
+    def squeeze(yb):
+        return yb[:, 0] if yb.shape[1] == 1 else yb
+
+    def gen():
+        epoch = 0
+        while True:
+            for xb, yb in loader.epoch(epoch):
+                yield xb, squeeze(yb)
+            epoch += 1
+
     val = None
     if spec["val_dir"]:
         xv, yv = load_val(spec["val_dir"])
-        val = (xv, yv[:, 0] if yv.shape[1] == 1 else yv)
+        val = (xv, squeeze(yv))
 
     cbs = [hvd_k.callbacks.BroadcastGlobalVariablesCallback(0),
            hvd_k.callbacks.MetricAverageCallback()]
     cbs.extend(spec.get("callbacks") or [])
     history = model.fit(
-        x, y, batch_size=spec["batch_size"], epochs=spec["epochs"],
-        shuffle=spec["shuffle"], validation_data=val,
+        gen(), steps_per_epoch=len(loader), epochs=spec["epochs"],
+        validation_data=val, validation_batch_size=spec["batch_size"],
         verbose=spec["verbose"] if hvd_k.rank() == 0 else 0,
         callbacks=cbs)
 
