@@ -24,6 +24,15 @@ Reported fields:
   scaling_eff_sim8 — simulated 8-device scaling efficiency: per-chip
                  throughput at n=8 over n=1 on the CPU host mesh (stand-in
                  for the >=90% pod-scale north star, BASELINE.md).
+                 Median of >=3 paired runs; spread reported alongside.
+  provenance   — "live" when the headline number was measured in this
+                 run; "cached" when the accelerator was unreachable for
+                 the whole probe window and the record carries the
+                 last-known-good ON-CHIP measurement from
+                 BENCH_CACHE.json (with its capture timestamp and
+                 staleness) instead of silently degrading to a CPU
+                 number.  A wedged chip degrades the record's
+                 freshness, not its existence.
 """
 
 import json
@@ -35,11 +44,43 @@ import time
 PROBE_TIMEOUT = float(os.environ.get("HOROVOD_BACKEND_PROBE_TIMEOUT", "120"))
 PROBE_RETRIES = 2
 # Extra patience for a *wedged* (hanging) accelerator: observed to
-# recover on its own; keep probing this long before surrendering to the
-# CPU fallback, whose numbers are not the headline metric.  10 min
-# keeps worst-case total bench time (probe + CPU fallback + sim
-# scaling) under ~30 min so an unattended runner's timeout isn't hit.
-PROBE_WINDOW = float(os.environ.get("HOROVOD_BENCH_PROBE_WINDOW", "600"))
+# recover on its own; keep probing this long before surrendering.  The
+# surrender path now emits the cached last-known-good on-chip record,
+# so the window is patience, not the difference between having a TPU
+# record and not.  Worst-case unattended budget: 15 min probe + ~5 min
+# CPU fallback bench + ~7 min median-of-3 sim scaling ≈ 27 min (r03
+# verdict task 1 explicitly asked for the window NOT to shrink;
+# override via HOROVOD_BENCH_PROBE_WINDOW if a runner needs a tighter
+# bound).
+PROBE_WINDOW = float(os.environ.get("HOROVOD_BENCH_PROBE_WINDOW", "900"))
+
+# Last-known-good ON-CHIP results, refreshed every time the bench runs
+# live on the accelerator.  Committed so a wedged-chip round still
+# carries an on-chip record (provenance-marked).
+CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_CACHE.json")
+
+
+def load_cache():
+    try:
+        with open(CACHE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def store_cache(result: dict) -> None:
+    """Persist a live on-chip result as the new last-known-good."""
+    entry = dict(result)
+    entry["captured_utc"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    entry["captured_unix"] = int(time.time())
+    try:
+        with open(CACHE_PATH, "w") as f:
+            json.dump(entry, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        log(f"could not persist bench cache: {e}")
 
 
 def log(*a):
@@ -236,8 +277,10 @@ def _run_sim(n: int, distributed: bool, timeout: float):
     return json.loads(r.stdout.strip().splitlines()[-1])["step_time_s"]
 
 
-def sim_scaling_efficiency(timeout: float = 600.0):
-    """Simulated scaling efficiency on the virtual CPU mesh.
+def sim_scaling_efficiency(timeout: float = 600.0,
+                           runs: "int | None" = None):
+    """Simulated scaling efficiency on the virtual CPU mesh —
+    gate-quality estimator.
 
     The n virtual devices share the host's physical cores, so the ideal
     n=8 step (global batch 8x) takes 8x the n=1 step's wall time; any
@@ -245,29 +288,46 @@ def sim_scaling_efficiency(timeout: float = 600.0):
     8*T1/T8 (clamped to 1.0) — the shared-core analog of per-chip
     throughput retention on real hardware.
 
+    Robustness (the r03 verdict's gate requirement): the per-chip batch
+    is pinned at 16 (see run_sim_child), and the ratio is measured as
+    the MEDIAN of `runs` >= 3 PAIRED (t1, t8) samples — pairing
+    adjacent-in-time runs cancels slow host-load drift, the median
+    rejects a single loaded-host outlier.  Returns
+    (median_eff, spread, per_run_effs); spread is max-min across runs.
+
     Also reports the per-step collective share: T8(dist) - T8(no dist),
     the same decomposition the reference's timeline gives per tensor.
     """
-    # Best-of-2 per configuration: the shared-core measurement wobbles a
-    # few percent run to run (observed 0.89-0.92 for the same build);
-    # the fastest clean run is the standard timing estimator.
-    def best(n, distributed=True):
-        ts = [_run_sim(n, distributed, timeout) for _ in range(2)]
-        ts = [t for t in ts if t is not None]
-        return min(ts) if ts else None
-
-    t1 = best(1)
-    t8 = best(8)
-    if t1 is None or t8 is None:
+    if runs is None:
+        runs = int(os.environ.get("HOROVOD_BENCH_SIM_RUNS", "3"))
+    effs, t1s, t8s = [], [], []
+    for i in range(runs):
+        t1 = _run_sim(1, True, timeout)
+        t8 = _run_sim(8, True, timeout)
+        if t1 is None or t8 is None:
+            log(f"sim-scaling pair {i}: child failed, skipping pair")
+            continue
+        eff = min(1.0, 8.0 * t1 / t8)
+        log(f"sim-scaling pair {i}: n1={t1*1e3:.1f} ms n8={t8*1e3:.1f} ms "
+            f"-> eff {eff:.4f}")
+        effs.append(eff)
+        t1s.append(t1)
+        t8s.append(t8)
+    if not effs:
         return None
-    log(f"sim-scaling n=1: {t1*1e3:.1f} ms/step (best of 2)")
-    log(f"sim-scaling n=8: {t8*1e3:.1f} ms/step (best of 2)")
-    t8_nodist = best(8, distributed=False)  # same estimator as t8
-    if t8_nodist is not None:
+    t8_nodist = _run_sim(8, False, timeout)
+    if t8_nodist is not None and t8s:
+        t8m = sorted(t8s)[len(t8s) // 2]
         log(f"sim-scaling n=8 compute-only: {t8_nodist*1e3:.1f} ms/step "
-            f"-> collective share {(t8 - t8_nodist)*1e3:.1f} ms/step "
-            f"({100 * (t8 - t8_nodist) / t8:.1f}%)")
-    return min(1.0, 8.0 * t1 / t8)
+            f"-> collective share {(t8m - t8_nodist)*1e3:.1f} ms/step "
+            f"({100 * (t8m - t8_nodist) / t8m:.1f}%)")
+    s = sorted(effs)
+    median = s[len(s) // 2] if len(s) % 2 else \
+        0.5 * (s[len(s) // 2 - 1] + s[len(s) // 2])
+    spread = max(effs) - min(effs)
+    log(f"sim-scaling: median {median:.4f}, spread {spread:.4f} "
+        f"over {len(effs)} paired runs")
+    return median, spread, effs
 
 
 # ---------------------------------------------------------------------------
@@ -325,15 +385,9 @@ def run_transformer_bench(d_model=512, seq=1024, batch=8, layers=8) -> float:
 # Keras-path measurement (BASELINE config 3: TF2 Keras DistributedOptimizer)
 # ---------------------------------------------------------------------------
 
-def run_keras_bench() -> float:
-    """img/sec of the Keras frontend path: a small convnet trained
-    through hvd.tensorflow.keras.DistributedOptimizer (TF executes on
-    host CPU; the collective rides the XLA core).  Measures the bridge
-    overhead the TF/Keras shim adds per step."""
+def _keras_model_and_data():
     import numpy as np
     import tensorflow as tf
-
-    import horovod_tpu.tensorflow.keras as hvd_k
 
     tf.random.set_seed(0)
     batch = 64
@@ -347,17 +401,41 @@ def run_keras_bench() -> float:
         tf.keras.layers.Flatten(),
         tf.keras.layers.Dense(10),
     ])
-    opt = hvd_k.DistributedOptimizer(tf.keras.optimizers.SGD(0.01))
-    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
-    model.compile(optimizer=opt, loss=loss_fn)
-    warmup, iters = 2, 8
+    return model, x, y, batch
+
+
+def _time_keras(model, x, y, batch, warmup=2, iters=8) -> float:
     for _ in range(warmup):
         model.train_on_batch(x, y)
     t0 = time.perf_counter()
     for _ in range(iters):
         model.train_on_batch(x, y)
-    dt = time.perf_counter() - t0
-    return batch * iters / dt
+    return batch * iters / (time.perf_counter() - t0)
+
+
+def run_keras_bench():
+    """(distributed_img_sec, plain_img_sec) of the Keras frontend path:
+    a small convnet trained through
+    hvd.tensorflow.keras.DistributedOptimizer, next to the IDENTICAL
+    model/compile WITHOUT horovod on the same host — the denominator
+    that makes the bridge overhead falsifiable (r03 verdict task 5;
+    reference: pytorch_synthetic_benchmark.py's per-rank + total img/s
+    reporting discipline)."""
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow.keras as hvd_k
+
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    model, x, y, batch = _keras_model_and_data()
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.01), loss=loss_fn)
+    plain = _time_keras(model, x, y, batch)
+
+    model, x, y, batch = _keras_model_and_data()
+    opt = hvd_k.DistributedOptimizer(tf.keras.optimizers.SGD(0.01))
+    model.compile(optimizer=opt, loss=loss_fn)
+    dist = _time_keras(model, x, y, batch)
+    return dist, plain
 
 
 # ---------------------------------------------------------------------------
@@ -428,11 +506,12 @@ def run_bench(platform: str) -> dict:
     log(f"raw jax:   {t_raw*1e3:.1f} ms/step, {raw_imgsec:.1f} img/s/chip")
 
     # --- Keras frontend path (BASELINE config 3) ---
-    keras_img_sec = None
+    keras_img_sec = keras_plain = None
     try:
-        keras_img_sec = run_keras_bench()
-        log(f"keras_img_sec: {keras_img_sec:.1f} img/s "
-            f"(TF-on-CPU frontend through DistributedOptimizer)")
+        keras_img_sec, keras_plain = run_keras_bench()
+        log(f"keras_img_sec: {keras_img_sec:.1f} img/s through "
+            f"DistributedOptimizer vs plain-Keras {keras_plain:.1f} img/s "
+            f"-> keras_vs_baseline {keras_img_sec / keras_plain:.4f}")
     except Exception as e:  # noqa: BLE001 — keras path must not sink bench
         log(f"keras bench failed: {type(e).__name__}: {e}")
 
@@ -457,6 +536,8 @@ def run_bench(platform: str) -> dict:
     }
     if keras_img_sec is not None:
         out["keras_img_sec"] = round(keras_img_sec, 1)
+        if keras_plain:
+            out["keras_vs_baseline"] = round(keras_img_sec / keras_plain, 4)
     if tfm_tok_s is not None:
         out["transformer_tok_s"] = round(tfm_tok_s, 0)
     return out
@@ -505,19 +586,51 @@ def main():
     except Exception as e:  # noqa: BLE001
         log(f"bench failed: {type(e).__name__}: {e}")
 
+    if result is not None and result.get("platform") == "tpu":
+        # A live on-chip run is the new last-known-good.
+        result["provenance"] = "live"
+        store_cache({k: v for k, v in result.items() if k != "provenance"})
+    elif result is not None:
+        # The bench RAN but only on the CPU host platform — accelerator
+        # unreachable (wedged tunnel).  The record still carries the
+        # last-known-good ON-CHIP measurement, provenance-marked, with
+        # this run's live CPU numbers attached as diagnostics.  (r03
+        # verdict task 1: a wedged chip degrades the record's freshness,
+        # not its existence.)  A bench that CRASHED (result None) is NOT
+        # papered over: it falls through to the error record + exit 1.
+        cached = load_cache()
+        if cached is not None and cached.get("platform") == "tpu":
+            live_cpu = result
+            result = {k: v for k, v in cached.items()
+                      if k != "captured_unix"}
+            result["provenance"] = "cached"
+            age_h = (time.time() - cached.get(
+                "captured_unix", time.time())) / 3600.0
+            result["stale_hours"] = round(age_h, 1)
+            log(f"accelerator unreachable: emitting last-known-good "
+                f"on-chip record from {cached.get('captured_utc')} "
+                f"({age_h:.1f} h old)")
+            result["live_cpu_img_sec_per_chip"] = live_cpu.get("value")
+        else:
+            result["provenance"] = "live"
+
     if result is None:
         emit({"metric": "resnet50_synthetic_img_sec_per_chip", "value": 0,
               "unit": "img/sec/chip", "vs_baseline": 0,
               "error": "benchmark failed; see stderr"})
         sys.exit(1)
 
-    eff = None
+    # Sim scaling always runs live on the CPU host mesh (chip-independent).
     try:
         eff = sim_scaling_efficiency()
     except Exception as e:  # noqa: BLE001
         log(f"sim scaling failed: {type(e).__name__}: {e}")
+        eff = None
     if eff is not None:
-        result["scaling_eff_sim8"] = round(eff, 4)
+        median, spread, effs = eff
+        result["scaling_eff_sim8"] = round(median, 4)
+        result["scaling_eff_sim8_spread"] = round(spread, 4)
+        result["scaling_eff_sim8_runs"] = [round(e, 4) for e in effs]
 
     emit(result)
 

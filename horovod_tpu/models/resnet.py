@@ -119,6 +119,50 @@ def resnet_init(key, depth: int = 50, num_classes: int = 1000,
                        "sizes": tuple(sizes)}}
 
 
+def _stem_space_to_depth_apply(p_stem, x, compute_dtype):
+    """Conv0 space-to-depth (the MLPerf-era TPU stem transform): fold a
+    2×2 space-to-depth into the 7×7/s2 SAME stem conv, turning it into a
+    4×4/s1 conv on [B, H/2, W/2, 12].
+
+    The C=3 input channel is the MXU's worst case (the contraction dim
+    gets padded to the tile size, so most of the systolic array idles on
+    the stem); 4× the channels at 1/4 the spatial positions is the same
+    arithmetic in an MXU-shaped layout.  Exact algebraic equivalence —
+    the kernel is re-tiled in-graph from the SAME 7×7 weights (padded to
+    8×8 with a zero tap), so checkpoints and init are unchanged:
+        K'[r, s, (di·2+dj)·C+c, o] = K[2r+di, 2s+dj, c, o].
+    Tested against the plain stem in tests/test_models.py.
+    """
+    from jax import lax
+
+    k7 = p_stem["kernel"]
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        k7 = k7.astype(compute_dtype)
+    B, H, W, C = x.shape
+    O = k7.shape[-1]
+    k = jnp.pad(k7, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    k = (k.reshape(4, 2, 4, 2, C, O)
+         .transpose(0, 2, 1, 3, 4, 5)
+         .reshape(4, 4, 4 * C, O))
+    xs = (x.reshape(B, H // 2, 2, W // 2, 2, C)
+          .transpose(0, 1, 3, 2, 4, 5)
+          .reshape(B, H // 2, W // 2, 4 * C))
+    # Original SAME pad for k=7,s=2 is (2,3) rows: 1 block low, 1.5
+    # blocks high — the half block rides the zero 8th kernel tap.
+    return lax.conv_general_dilated(
+        xs, k, window_strides=(1, 1), padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _use_space_to_depth(x) -> bool:
+    from ..common.util import env_bool
+
+    return (env_bool("CONV0_SPACE_TO_DEPTH", False)
+            and x.ndim == 4 and x.shape[1] % 2 == 0
+            and x.shape[2] % 2 == 0)
+
+
 def resnet_apply(variables: Dict[str, Any], x, train: bool = True,
                  compute_dtype=jnp.bfloat16,
                  axis_name: Optional[str] = None):
@@ -127,12 +171,19 @@ def resnet_apply(variables: Dict[str, Any], x, train: bool = True,
     `axis_name` turns every batch-norm into a synchronized (cross-rank)
     batch-norm when running inside shard_map — the TPU-native form of
     horovod's SyncBatchNormalization.
+
+    HOROVOD_CONV0_SPACE_TO_DEPTH=1 rewrites the stem conv through the
+    2×2 space-to-depth transform (`_stem_space_to_depth_apply`) —
+    numerically equivalent, MXU-friendlier layout.
     """
     p, s = variables["params"], variables["batch_stats"]
     cfg = variables["config"]
     bottleneck, sizes = cfg["bottleneck"], cfg["sizes"]
     ns: Dict[str, Any] = {}
-    y = L.conv2d_apply(p["stem"], x, 2, compute_dtype=compute_dtype)
+    if _use_space_to_depth(x):
+        y = _stem_space_to_depth_apply(p["stem"], x, compute_dtype)
+    else:
+        y = L.conv2d_apply(p["stem"], x, 2, compute_dtype=compute_dtype)
     y, ns["bn_stem"] = L.batchnorm_apply(p["bn_stem"], s["bn_stem"], y,
                                          train, axis_name=axis_name)
     y = jax.nn.relu(y)

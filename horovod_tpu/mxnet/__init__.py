@@ -271,14 +271,14 @@ def DistributedTrainer(params, optimizer, optimizer_params=None,
                        gradient_predivide_factor: float = 1.0):
     """Gluon trainer whose `_allreduce_grads` averages over ranks
     (reference: DistributedTrainer(mx.gluon.Trainer)).  Requires the
-    real mxnet package; constructed lazily so the module imports
-    without it."""
+    real mxnet package (or a duck-typed gluon, as the tests inject);
+    constructed lazily so the module imports without it."""
     if mx is None:
         raise ImportError(
             "horovod_tpu.mxnet.DistributedTrainer requires mxnet; "
             "use DistributedOptimizer for the engine-level API")
 
-    class _Trainer(mx.gluon.Trainer):  # pragma: no cover — needs mxnet
+    class _Trainer(mx.gluon.Trainer):
         def __init__(self):
             # Scale LR down by size like the reference: gradients are
             # summed by _allreduce_grads and rescaled here.

@@ -18,6 +18,7 @@ from .hierarchical import (  # noqa: F401
     hierarchical_allreduce,
 )
 from .sequence import (  # noqa: F401
+    dense_attention_oracle,
     full_attention,
     ring_attention,
     ring_attention_shard,
@@ -51,9 +52,10 @@ def transformer_dryrun(n_devices: int) -> None:
 
     def run(tag, mesh_kwargs, cfg_kwargs, batch=8, seqlen=32):
         mesh = create_hybrid_mesh(devices=devices, **mesh_kwargs)
-        cfg = TransformerConfig(
-            vocab_size=128, d_model=64, n_heads=4, d_head=16, d_ff=128,
-            n_layers=4, **cfg_kwargs)
+        base = dict(vocab_size=128, d_model=64, n_heads=4, d_head=16,
+                    d_ff=128, n_layers=4)
+        base.update(cfg_kwargs)
+        cfg = TransformerConfig(**base)
         params = transformer_init(jax.random.PRNGKey(0), cfg)
         pp = mesh.shape.get("pp", 1)
         params = stack_for_pipeline(params, pp, cfg)
@@ -73,6 +75,22 @@ def transformer_dryrun(n_devices: int) -> None:
             batch=4, seqlen=33)  # targets drop 1 -> seq 32 shards by sp=2
         run("dp2*pp2*ep2 moe", dict(dp=-1, pp=2, ep=2),
             dict(moe_every=2, n_experts=4), batch=8, seqlen=17)
+        # Flash-kernel ring attention: T=256 over sp=2 gives 128-aligned
+        # local shards, so ring_attention_shard routes its per-pair
+        # block math through the Pallas flash kernel (interpret mode on
+        # the CPU mesh; the real-TPU kernel path shares this code).
+        import os as _os
+
+        _prev = _os.environ.get("HOROVOD_FLASH_ATTENTION")
+        _os.environ["HOROVOD_FLASH_ATTENTION"] = "1"
+        try:
+            run("dp4*sp2 ring+flash-kernel", dict(dp=-1, sp=2),
+                dict(n_layers=2), batch=4, seqlen=257)
+        finally:
+            if _prev is None:
+                _os.environ.pop("HOROVOD_FLASH_ATTENTION", None)
+            else:
+                _os.environ["HOROVOD_FLASH_ATTENTION"] = _prev
     elif n_devices % 4 == 0:
         run("dp*tp2", dict(dp=-1, tp=2), dict(), batch=4, seqlen=17)
     else:

@@ -100,12 +100,27 @@ def allreduce_gradients(
                 f"Compression.{wire} supports op=Average or Sum, got {op}")
         from ..ops.quantized import quantized_allreduce_shard
 
+        # Quantized wire is float-only: integer leaves (step counters
+        # etc.) must keep summing exactly, same as hierarchical.py's
+        # DCN-wire filter — route them through the exact grouped path.
+        float_idx = [i for i, t in enumerate(leaves)
+                     if jnp.issubdtype(t.dtype, jnp.floating)]
+        int_idx = [i for i in range(len(leaves)) if i not in float_idx]
+        out = [None] * len(leaves)
+        if int_idx:
+            exact = C.grouped_allreduce(
+                [leaves[i] for i in int_idx], op=op, axis_name=axis_name)
+            for i, r in zip(int_idx, exact):
+                out[i] = r
         # Same size-capped bucketing as the exact path (fusion
         # threshold / autotuner apply here too) so the ring collectives
         # can overlap remaining backward compute.
-        buckets = _buckets_by_size(leaves, fusion_threshold_bytes)
-        out = [None] * len(leaves)
-        for idxs in buckets:
+        buckets = _buckets_by_size(
+            [leaves[i] for i in float_idx], fusion_threshold_bytes)
+        for bidxs in buckets:
+            idxs = [float_idx[j] for j in bidxs] if float_idx else []
+            if not idxs:
+                continue
             flat = jnp.concatenate(
                 [leaves[i].astype(jnp.float32).reshape(-1) for i in idxs])
             reduced = quantized_allreduce_shard(
@@ -310,6 +325,12 @@ def data_parallel(
         # (reference: parameter_manager.cc fed from the runtime, not by
         # user code).
         _autotune_record(args)
+        # Step-cycle marker (reference: HOROVOD_TIMELINE_MARK_CYCLES
+        # marks each runloop cycle; the SPMD analog is one compiled step).
+        from ..utils import timeline as _tl
+        tl = _tl.get_timeline()
+        if tl is not None:
+            tl.mark_cycle()
         return out
 
     return call

@@ -157,18 +157,28 @@ def ring_attention_shard(q, k, v, axis: str, causal: bool = True):
 
 
 def full_attention(q, k, v, causal: bool = True, q_offset: int = 0):
-    """Dense reference attention [B,T,H,D] (used by Ulysses locally and by
-    tests as the numerical oracle).
+    """Production dense attention [B,T,H,D] (used by Ulysses locally).
 
     With HOROVOD_FLASH_ATTENTION=1 and compatible shapes (square,
     128-aligned, no offset) this routes through the Pallas flash kernel
     (ops/flash_attention.py): same numerics, O(T) memory instead of the
-    [T, T] score matrix — the enabler for long-context local shards."""
+    [T, T] score matrix — the enabler for long-context local shards.
+    Tests comparing flash against a dense result must use
+    `dense_attention_oracle`, which NEVER dispatches to flash (otherwise
+    a CI env exporting the flag would turn the comparison into a
+    self-comparison)."""
     from ..ops import flash_attention as fa
 
     if (fa.flash_enabled() and q_offset == 0 and
             q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0):
         return fa.flash_attention(q, k, v, causal=causal)
+    return dense_attention_oracle(q, k, v, causal=causal, q_offset=q_offset)
+
+
+def dense_attention_oracle(q, k, v, causal: bool = True, q_offset: int = 0):
+    """Numerical oracle: the O(T^2) dense softmax attention, guaranteed
+    never to route through the flash kernel regardless of
+    HOROVOD_FLASH_ATTENTION — the fixed point flash is tested against."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / (D ** 0.5)

@@ -13,10 +13,14 @@ and the shard count is the worker count so each worker reads exactly
 its own pair.  Checkpoints are single pickled blobs written atomically
 (tmp + rename).
 
-`Store.create(prefix)` mirrors the reference factory: local paths (and
-`file://`) get a `LocalStore`; remote schemes (`hdfs://`, `s3://`,
-`dbfs:/`) raise with a pointer to what a cluster deployment would plug
-in, since those client libraries are not in this environment.
+`Store.create(prefix)` mirrors the reference factory's URI routing
+(store.py `Store.create`): local paths (and `file://`) get a
+`LocalStore`; `dbfs:/` maps to the `/dbfs` FUSE mount
+(`DBFSLocalStore`, exactly the reference's translation); `hdfs://` and
+object-store schemes get a `FilesystemStore` over a duck-typed client —
+pyarrow/fsspec when importable, or any injected `filesystem=` object
+(the mocked-client seam the tests use, since the real cluster clients
+are not in this image).
 """
 
 from __future__ import annotations
@@ -29,9 +33,9 @@ from typing import List, Optional
 
 from ...common.exceptions import HorovodTpuError
 
-_REMOTE_SCHEMES = ("hdfs://", "s3://", "s3a://", "s3n://", "gs://",
-                   "dbfs:/", "abfs://", "abfss://", "wasb://",
-                   "wasbs://")
+_HDFS_SCHEMES = ("hdfs://",)
+_OBJECT_SCHEMES = ("s3://", "s3a://", "s3n://", "gs://", "abfs://",
+                   "abfss://", "wasb://", "wasbs://")
 
 
 class Store:
@@ -41,14 +45,13 @@ class Store:
     def create(prefix_path: Optional[str] = None, **kwargs) -> "Store":
         if prefix_path is None:
             return LocalStore(None, **kwargs)
-        for scheme in _REMOTE_SCHEMES:
-            if prefix_path.lower().startswith(scheme):
-                raise HorovodTpuError(
-                    f"Store.create: scheme {scheme!r} needs a remote "
-                    "filesystem client (reference: HDFSStore via pyarrow, "
-                    "DBFSLocalStore); none is available in this "
-                    "environment — pass a local path or mount the remote "
-                    "store locally")
+        low = prefix_path.lower()
+        if low.startswith("dbfs:/"):
+            return DBFSLocalStore(prefix_path, **kwargs)
+        if any(low.startswith(s) for s in _HDFS_SCHEMES):
+            return HDFSStore(prefix_path, **kwargs)
+        if any(low.startswith(s) for s in _OBJECT_SCHEMES):
+            return FilesystemStore(prefix_path, **kwargs)
         if prefix_path.startswith("file://"):
             prefix_path = prefix_path[len("file://"):]
         return LocalStore(prefix_path, **kwargs)
@@ -149,6 +152,191 @@ class LocalStore(Store):
     def cleanup(self) -> None:
         if self._owns_prefix and os.path.isdir(self._prefix):
             shutil.rmtree(self._prefix, ignore_errors=True)
+
+
+class DBFSLocalStore(LocalStore):
+    """Databricks DBFS store (reference: store.py `DBFSLocalStore`):
+    `dbfs:/path` is the cluster-local FUSE mount `/dbfs/path`, so the
+    whole LocalStore machinery applies after the prefix translation —
+    the same trick the reference plays."""
+
+    def __init__(self, prefix_path: str):
+        if not prefix_path.lower().startswith("dbfs:/"):
+            raise HorovodTpuError(
+                f"DBFSLocalStore expects a dbfs:/ path, got {prefix_path!r}")
+        # Defer directory creation: the FUSE mount only exists on a
+        # Databricks node, but path layout must be computable anywhere.
+        self._prefix = "/dbfs/" + prefix_path[len("dbfs:/"):].lstrip("/")
+        self._owns_prefix = False
+
+    @staticmethod
+    def normalize_datasets_dir(path: str) -> str:
+        """dbfs:/... → /dbfs/... (reference helper name)."""
+        return ("/dbfs/" + path[len("dbfs:/"):].lstrip("/")
+                if path.lower().startswith("dbfs:/") else path)
+
+
+class FilesystemStore(Store):
+    """Remote store over a duck-typed filesystem client (reference:
+    store.py `HDFSStore` / the fsspec-style object stores).
+
+    The client needs five methods — `open(path, mode)`, `exists(path)`,
+    `mkdirs(path)` (or `makedirs`), `ls(path)` (or `list`), and
+    optionally `rename(src, dst)` for atomic checkpoint writes (falls
+    back to direct write when absent).  Pass one via `filesystem=`;
+    without it, fsspec is tried for the URI's scheme.  This is the
+    URI-level API-parity seam: real cluster deployments inject their
+    client, tests inject a mock."""
+
+    def __init__(self, prefix_path: str, filesystem=None):
+        self._prefix = prefix_path.rstrip("/")
+        if filesystem is None:
+            scheme = prefix_path.split("://", 1)[0]
+            try:
+                import fsspec
+                filesystem = fsspec.filesystem(scheme)
+            except Exception as e:  # noqa: BLE001
+                raise HorovodTpuError(
+                    f"Store for {prefix_path!r} needs a filesystem "
+                    f"client: pass filesystem=<client> (fsspec-style "
+                    f"open/exists/mkdirs/ls) — no fsspec driver for "
+                    f"{scheme!r} in this environment") from e
+        self._fs = filesystem
+
+    @property
+    def prefix_path(self) -> str:
+        return self._prefix
+
+    def _join(self, *parts: str) -> str:
+        return "/".join([self._prefix.rstrip("/"), *parts])
+
+    def get_run_path(self, run_id: str) -> str:
+        return self._join("runs", run_id)
+
+    def get_train_data_path(self, run_id: str) -> str:
+        return self._join("intermediate_train_data", run_id)
+
+    def get_val_data_path(self, run_id: str) -> str:
+        return self._join("intermediate_val_data", run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return self.get_run_path(run_id) + "/" + CHECKPOINT_FILE
+
+    def get_logs_path(self, run_id: str) -> str:
+        return self.get_run_path(run_id) + "/logs"
+
+    def exists(self, path: str) -> bool:
+        return bool(self._fs.exists(path))
+
+    def read_bytes(self, path: str) -> bytes:
+        with self._fs.open(path, "rb") as f:
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        self.mkdirs(path.rsplit("/", 1)[0])
+        if hasattr(self._fs, "rename"):
+            tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
+            with self._fs.open(tmp, "wb") as f:
+                f.write(data)
+            # HDFS rename does NOT overwrite an existing destination
+            # (unlike POSIX os.replace): clear it first so repeated
+            # checkpoint writes to the same path succeed.
+            if self.exists(path):
+                rm = getattr(self._fs, "delete", None) or \
+                    getattr(self._fs, "rm", None)
+                if rm is not None:
+                    rm(path)
+            self._fs.rename(tmp, path)
+        else:
+            with self._fs.open(path, "wb") as f:
+                f.write(data)
+
+    def mkdirs(self, path: str) -> None:
+        mk = getattr(self._fs, "mkdirs", None) or \
+            getattr(self._fs, "makedirs", None)
+        if mk is not None:
+            try:
+                mk(path)
+            except FileExistsError:
+                pass
+
+    def list_dir(self, path: str) -> List[str]:
+        ls = getattr(self._fs, "ls", None) or getattr(self._fs, "list", None)
+        if ls is None or not self.exists(path):
+            return []
+        return sorted(str(p).rstrip("/").rsplit("/", 1)[-1]
+                      for p in ls(path))
+
+    def saving_runs(self) -> List[str]:
+        return self.list_dir(self._join("runs"))
+
+    def cleanup(self) -> None:
+        """Remote prefixes are caller-owned; nothing to remove."""
+
+
+class HDFSStore(FilesystemStore):
+    """HDFS store (reference: store.py `HDFSStore` ≈L200-400).
+
+    Accepts the reference's connection kwargs (host/port/user/
+    kerb_ticket) and builds a pyarrow HadoopFileSystem when no client
+    is injected; with `filesystem=` any duck-typed client works (the
+    reference similarly accepts a ready `pyarrow.fs` object)."""
+
+    def __init__(self, prefix_path: str, host: Optional[str] = None,
+                 port: Optional[int] = None, user: Optional[str] = None,
+                 kerb_ticket: Optional[str] = None, filesystem=None):
+        if filesystem is None:
+            try:
+                from pyarrow.fs import HadoopFileSystem
+
+                filesystem = _PyarrowFsAdapter(HadoopFileSystem(
+                    host=host or "default", port=port or 0, user=user,
+                    kerb_ticket=kerb_ticket))
+            except Exception as e:  # noqa: BLE001
+                raise HorovodTpuError(
+                    "HDFSStore needs a hadoop client: pyarrow's "
+                    "HadoopFileSystem is unavailable here — pass "
+                    "filesystem=<client> (open/exists/mkdirs/ls)"
+                ) from e
+        super().__init__(prefix_path, filesystem=filesystem)
+
+
+class _PyarrowFsAdapter:
+    """Duck-type a pyarrow.fs.FileSystem to the five-method client
+    surface FilesystemStore expects."""
+
+    def __init__(self, fs):
+        self._fs = fs
+
+    def open(self, path: str, mode: str):
+        p = _strip_scheme(path)
+        return (self._fs.open_input_stream(p) if "r" in mode
+                else self._fs.open_output_stream(p))
+
+    def exists(self, path: str) -> bool:
+        from pyarrow.fs import FileType
+
+        return self._fs.get_file_info(
+            _strip_scheme(path)).type != FileType.NotFound
+
+    def mkdirs(self, path: str) -> None:
+        self._fs.create_dir(_strip_scheme(path), recursive=True)
+
+    def ls(self, path: str):
+        from pyarrow.fs import FileSelector
+
+        return [i.path for i in self._fs.get_file_info(
+            FileSelector(_strip_scheme(path)))]
+
+    def rename(self, src: str, dst: str) -> None:
+        self._fs.move(_strip_scheme(src), _strip_scheme(dst))
+
+    def delete(self, path: str) -> None:
+        self._fs.delete_file(_strip_scheme(path))
+
+
+def _strip_scheme(path: str) -> str:
+    return path.split("://", 1)[1] if "://" in path else path
 
 
 # Shard base name shared by writer (util.py) and the remote trainers;

@@ -8,13 +8,27 @@ gradient in `gradient()` via `_allreduce_grads`), `broadcast_variables`,
 `Compression.fp16`, IndexedSlices handling (sparse-as-dense), `join`.
 
 TPU-native redesign: the reference registers custom TF ops
-(HorovodAllreduceOp, tensorflow/mpi_ops.cc) that enqueue into the C++
-background runtime.  Here tf.Tensors bridge to numpy, run through the same
-cached compiled XLA collective programs every frontend shares
-(ops/collectives.py), and come back as tf.Tensors.  Eager execution is the
-native mode (TF2 default); inside a `tf.function` the collective runs
-through `tf.py_function`, preserving semantics at graph-build time the way
-the reference's custom-op kernels do at session-run time.
+(HorovodAllreduceOp, tensorflow/mpi_ops.cc ≈1.8k; xla_mpi_ops.cc puts
+allreduce inside TF-XLA graphs).  Here tf.Tensors bridge to numpy (a
+view for CPU-resident eager tensors), run through the same cached
+compiled XLA collective programs every frontend shares
+(ops/collectives.py), and come back as tf.Tensors.  Eager execution is
+the native mode (TF2 default); inside a `tf.function` the collective
+runs through `tf.py_function`, preserving semantics at graph-build time
+the way the reference's custom-op kernels do at session-run time.
+
+Bridge-cost design (r03 verdict task 4): TF in this stack executes on
+host CPU while the collective core executes wherever JAX runs (TPU over
+ICI, or host), so a per-tensor hop would pay one H2D+D2H per gradient.
+Two mechanisms collapse that cost:
+  - `_fused_flat_allreduce`: gradients are packed into ONE flat tensor
+    per dtype on the TF side before crossing (the FusionBufferManager
+    pack/unpack, done where the tensors live), so a whole model's
+    gradient update is one bridge crossing each way;
+  - size-1 short-circuit in `_allreduce_grads`: allreduce over one rank
+    is the identity (reference np=1 = memcpy) and skips the bridge
+    entirely — single-chip TF/Keras training pays ~zero framework tax
+    (bench.py `keras_vs_baseline`).
 
     import horovod_tpu.tensorflow as hvd
     hvd.init()
@@ -131,6 +145,29 @@ def _eager_or_py_function(fn, tensors: Sequence, name: str,
 # Collective ops on tf tensors (reference: horovod/tensorflow/mpi_ops.py)
 # ---------------------------------------------------------------------------
 
+def _sparse_allreduce(slices: "tf.IndexedSlices", op,
+                      process_set: Optional[ProcessSet] = None
+                      ) -> "tf.IndexedSlices":
+    """Allgather-based sparse allreduce of tf.IndexedSlices (reference:
+    horovod/tensorflow/__init__.py ≈L350-450, the `sparse_as_dense=False`
+    branch of allreduce): gather every rank's (values, indices) slabs and
+    return IndexedSlices whose scatter-add equals the dense allreduce of
+    the scattered input.  Average divides the gathered values by the
+    participating size.  An embedding-heavy model moves only its touched
+    rows instead of the full dense [vocab, dim] gradient per step."""
+    if op not in (Average, Sum):
+        raise NotImplementedError(
+            "sparse (IndexedSlices) allreduce supports op=Average or Sum; "
+            "densify first for other ops")
+    values = allgather(slices.values, process_set=process_set)
+    indices = allgather(slices.indices, process_set=process_set)
+    if op is Average:
+        n = len(process_set.ranks) if process_set is not None else size()
+        values = values / tf.cast(n, values.dtype)
+    return tf.IndexedSlices(values=values, indices=indices,
+                            dense_shape=slices.dense_shape)
+
+
 def allreduce(tensor, average: Optional[bool] = None,
               name: Optional[str] = None, op=None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
@@ -138,6 +175,15 @@ def allreduce(tensor, average: Optional[bool] = None,
               process_set: Optional[ProcessSet] = None):
     if op is None:
         op = Sum if average is False else Average
+
+    if isinstance(tensor, tf.IndexedSlices):
+        # Reference semantics: allreduce of IndexedSlices is the
+        # allgather-based sparse path and returns IndexedSlices.
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            raise NotImplementedError(
+                "prescale/postscale not supported for IndexedSlices; "
+                "densify first")
+        return _sparse_allreduce(tensor, op, process_set=process_set)
 
     def _fn(nps):
         x = nps[0]
@@ -373,28 +419,79 @@ def broadcast_global_variables(root_rank: int = 0) -> None:
 # DistributedGradientTape (reference: horovod/tensorflow/__init__.py)
 # ---------------------------------------------------------------------------
 
+def _fused_flat_allreduce(dense: Sequence, op, compression,
+                          process_set: Optional[ProcessSet]) -> List:
+    """TF-side fusion buffer: concat same-dtype gradients into ONE flat
+    tensor per dtype *before* crossing the bridge, allreduce once, split
+    back with tf.split.  The reference's FusionBufferManager does this
+    pack/unpack in C++ before one NCCL launch; here it collapses
+    per-tensor bridge crossings (tf→host→XLA→host→tf) into one per
+    dtype — the whole point of killing the per-collective host hop
+    (r03 verdict task 4)."""
+    by_dtype = {}
+    for i, g in enumerate(dense):
+        g = tf.convert_to_tensor(g)
+        by_dtype.setdefault(g.dtype, []).append((i, g))
+    out = [None] * len(dense)
+    for dt, items in by_dtype.items():
+        if len(items) == 1:
+            i, g = items[0]
+            out[i] = allreduce(g, op=op, compression=compression,
+                               process_set=process_set)
+            continue
+        shapes = [g.shape for _, g in items]
+        sizes = [int(np.prod(s)) if s.rank else 1 for s in shapes]
+        flat = tf.concat([tf.reshape(g, [-1]) for _, g in items], axis=0)
+        red = allreduce(flat, op=op, compression=compression,
+                        process_set=process_set)
+        parts = tf.split(red, sizes)
+        for (i, _), part, shape in zip(items, parts, shapes):
+            out[i] = tf.reshape(part, shape)
+    return out
+
+
 def _allreduce_grads(grads: Sequence, op, compression,
                      process_set: Optional[ProcessSet],
                      sparse_as_dense: bool) -> List:
     """The reference's `_allreduce_grads`: fused (grouped) allreduce of all
-    non-None gradients, None passed through at its position."""
+    non-None gradients, None passed through at its position.
+
+    IndexedSlices gradients follow `sparse_as_dense`: True densifies and
+    rides the fused dense path (often faster over ICI for small vocabs);
+    False (the reference default) keeps them sparse through the
+    allgather-based `_sparse_allreduce`, moving only touched rows."""
     idx = [i for i, g in enumerate(grads) if g is not None]
     if not idx:
         return list(grads)
-    dense = []
+    n = len(process_set.ranks) if process_set is not None else size()
+    if n == 1:
+        # Allreduce over one rank is the identity for Sum and Average
+        # alike (the reference's np=1 op is a memcpy); skip the bridge
+        # entirely.  Densify IndexedSlices when asked so the output
+        # types match the n>1 path.
+        out = list(grads)
+        for i in idx:
+            if isinstance(out[i], tf.IndexedSlices) and sparse_as_dense:
+                out[i] = tf.convert_to_tensor(out[i])
+        return out
+    out = list(grads)
+    dense_idx, dense = [], []
     for i in idx:
         g = grads[i]
         if isinstance(g, tf.IndexedSlices):
-            # sparse_as_dense=False in the reference routes IndexedSlices
-            # through allgather; the dense path is both simpler and faster
-            # over ICI (no variable-size negotiation), so densify always.
-            g = tf.convert_to_tensor(g)
+            if sparse_as_dense:
+                g = tf.convert_to_tensor(g)
+            else:
+                out[i] = _sparse_allreduce(g, op, process_set=process_set)
+                continue
+        dense_idx.append(i)
         dense.append(g)
-    reduced = grouped_allreduce(dense, op=op, compression=compression,
-                                process_set=process_set)
-    out = list(grads)
-    for i, r in zip(idx, reduced):
-        out[i] = r
+    if dense:
+        reduced = _fused_flat_allreduce(dense, op=op,
+                                        compression=compression,
+                                        process_set=process_set)
+        for i, r in zip(dense_idx, reduced):
+            out[i] = r
     return out
 
 
@@ -404,7 +501,7 @@ class _DistributedGradientTape:
 
     def __init__(self, tape: "tf.GradientTape", op=Average,
                  compression=Compression.none,
-                 sparse_as_dense: bool = True,
+                 sparse_as_dense: bool = False,
                  process_set: Optional[ProcessSet] = None):
         self._tape = tape
         self._op = op
@@ -434,7 +531,7 @@ class _DistributedGradientTape:
 
 def DistributedGradientTape(gradtape: "tf.GradientTape", op=Average,
                             compression=Compression.none,
-                            sparse_as_dense: bool = True,
+                            sparse_as_dense: bool = False,
                             process_set: Optional[ProcessSet] = None):
     return _DistributedGradientTape(
         gradtape, op=op, compression=compression,

@@ -31,15 +31,21 @@ from . import callbacks  # noqa: F401
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          op=Average, compression=Compression.none,
                          backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = False,
+                         sparse_as_dense: bool = False,
                          process_set: Optional[ProcessSet] = None):
     """Wrap a Keras optimizer so every `apply_gradients` first averages
     gradients across ranks (reference: create_distributed_optimizer).
 
     `backward_passes_per_step > 1` locally accumulates gradients in
-    non-trainable slots and only every Nth call allreduces the average
-    and applies it (the reference's LocalGradientAggregationHelper,
+    non-trainable slots and only every Nth call allreduces and applies
+    them (the reference's LocalGradientAggregationHelper,
     horovod/tensorflow/gradient_aggregation.py) — tf.Variable counter +
-    tf.cond so it works inside model.fit's compiled train step."""
+    tf.cond so it works inside model.fit's compiled train step.
+    `average_aggregated_gradients` matches the reference flag and
+    default: False SUMS the N locally-accumulated passes (effective
+    batch-size scaling is the user's job, as upstream); True divides the
+    accumulator by N before the allreduce."""
     cls = optimizer.__class__
 
     class _DistributedKerasOptimizer(cls):
@@ -47,6 +53,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
         _hvd_compression = compression
         _hvd_process_set = process_set
         _hvd_bpps = int(backward_passes_per_step)
+        _hvd_avg_agg = bool(average_aggregated_gradients)
+        _hvd_sparse_as_dense = bool(sparse_as_dense)
 
         def _hvd_reduce_then(self, grads, tvars, apply_fn):
             """Allreduce-and-apply now (bpps==1), or accumulate and do
@@ -68,7 +76,7 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                 # counter).
                 return _apply_inner(_allreduce_grads(
                     grads, self._hvd_op, self._hvd_compression,
-                    self._hvd_process_set, True))
+                    self._hvd_process_set, self._hvd_sparse_as_dense))
 
             if getattr(self, "_hvd_accum_vars", None) is None:
                 # First trace: create the aggregation slots.
@@ -82,11 +90,15 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
             count = self._hvd_counter.assign_add(1)
 
             def _sync():
-                local = [acc / tf.cast(self._hvd_bpps, acc.dtype)
-                         for acc in self._hvd_accum_vars]
+                if self._hvd_avg_agg:
+                    local = [acc / tf.cast(self._hvd_bpps, acc.dtype)
+                             for acc in self._hvd_accum_vars]
+                else:
+                    local = [tf.convert_to_tensor(acc)
+                             for acc in self._hvd_accum_vars]
                 _apply_inner(_allreduce_grads(
                     local, self._hvd_op, self._hvd_compression,
-                    self._hvd_process_set, True))
+                    self._hvd_process_set, self._hvd_sparse_as_dense))
                 for acc in self._hvd_accum_vars:
                     acc.assign(tf.zeros_like(acc))
                 return tf.convert_to_tensor(self.iterations)

@@ -1,0 +1,140 @@
+"""Device-trace capture + merged host/device timeline view.
+
+Reference parity (SURVEY.md §5): the reference's timeline shows the
+whole story in one chrome://tracing view because its background thread
+observes both control plane and NCCL launches.  Under SPMD the device
+side belongs to `jax.profiler` (XLA's profiler), so the merged view is
+assembled from two captures:
+
+  - the control-plane timeline (`utils/timeline.py`, HOROVOD_TIMELINE),
+  - a jax.profiler device trace taken over the same steps.
+
+`start_device_trace` / `stop_device_trace` wrap `jax.profiler` and drop
+an alignment marker into the control-plane timeline;
+`merge_traces` shifts the host events onto the device trace's clock via
+that marker and emits ONE Chrome-trace JSON both chrome://tracing and
+Perfetto load.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import time
+from typing import Optional
+
+from . import timeline as _tl
+
+#: Instant-event name used to align the two clocks.
+TRACE_START_MARKER = "PROFILER_TRACE_START"
+
+# Host pids are offset so they never collide with the device trace's
+# process ids in the merged view.
+HOST_PID_OFFSET = 100000
+
+
+def start_device_trace(logdir: str) -> None:
+    """Start a jax.profiler trace and stamp the alignment marker into
+    the control-plane timeline (if one is active)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    tl = _tl.get_timeline()
+    if tl is not None:
+        tl.instant(TRACE_START_MARKER, category="profiler",
+                   args={"logdir": logdir, "wall": time.time()})
+
+
+def stop_device_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+def _load_timeline_events(timeline_json: str) -> list:
+    with open(timeline_json) as f:
+        text = f.read()
+    # The writer's array may lack the closing bracket if the process
+    # died mid-run (valid per the Chrome trace reader; tolerate it too).
+    text = text.strip()
+    if text.endswith(","):
+        text = text[:-1]
+    if not text.endswith("]"):
+        text += "]"
+    return json.loads(text)
+
+
+def _find_device_trace(profile_logdir: str) -> Optional[str]:
+    """Locate the newest `*.trace.json.gz` under a jax.profiler logdir
+    (layout: <logdir>/plugins/profile/<run>/<host>.trace.json.gz)."""
+    pats = [
+        os.path.join(profile_logdir, "plugins", "profile", "*",
+                     "*.trace.json.gz"),
+        os.path.join(profile_logdir, "**", "*.trace.json.gz"),
+    ]
+    hits: list = []
+    for p in pats:
+        hits.extend(glob.glob(p, recursive=True))
+        if hits:
+            break
+    return max(hits, key=os.path.getmtime) if hits else None
+
+
+def merge_traces(timeline_json: str, device_trace: str,
+                 out_path: str) -> dict:
+    """Merge the control-plane timeline with a device trace into one
+    Chrome-trace JSON.
+
+    `device_trace` may be a `.trace.json[.gz]` file or a jax.profiler
+    logdir (searched for the newest trace).  Host events are shifted so
+    the TRACE_START_MARKER instant lands at the device trace's t=0 (the
+    moment `start_device_trace` returned); host pids are offset and
+    labeled via process_name metadata.  Returns summary stats.
+    """
+    if os.path.isdir(device_trace):
+        found = _find_device_trace(device_trace)
+        if found is None:
+            raise FileNotFoundError(
+                f"no *.trace.json.gz under {device_trace}; run "
+                "tensorboard_plugin_profile's conversion or pass the "
+                "trace file directly")
+        device_trace = found
+
+    opener = gzip.open if device_trace.endswith(".gz") else open
+    with opener(device_trace, "rt") as f:
+        dev = json.load(f)
+    dev_events = dev.get("traceEvents", dev if isinstance(dev, list) else [])
+
+    host_events = _load_timeline_events(timeline_json)
+    marker_ts = None
+    for ev in host_events:
+        if ev.get("name") == TRACE_START_MARKER:
+            marker_ts = float(ev.get("ts", 0.0))
+            break
+    shift = -marker_ts if marker_ts is not None else 0.0
+
+    merged = list(dev_events)
+    host_pids = set()
+    for ev in host_events:
+        ev = dict(ev)
+        ev["ts"] = round(float(ev.get("ts", 0.0)) + shift, 1)
+        ev["pid"] = HOST_PID_OFFSET + int(ev.get("pid", 0))
+        host_pids.add(ev["pid"])
+        merged.append(ev)
+    for pid in sorted(host_pids):
+        merged.append({
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name":
+                     f"horovod control plane (rank {pid - HOST_PID_OFFSET})"},
+        })
+
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged}, f, default=str)
+    return {
+        "device_events": len(dev_events),
+        "host_events": len(host_events),
+        "aligned": marker_ts is not None,
+        "out": out_path,
+    }

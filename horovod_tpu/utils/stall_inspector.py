@@ -187,6 +187,27 @@ class StallInspector:
             if self._result_ready(result):
                 self.record_end(key)
 
+    @staticmethod
+    def _local_identity() -> str:
+        """Best-effort identity of THIS process from jax.distributed /
+        basics when no KV is available (degraded-mode attribution)."""
+        try:
+            from ..common import basics
+
+            if basics.is_initialized():
+                return (f"This process is rank {basics.rank()}/"
+                        f"{basics.size()} (pid {os.getpid()})")
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            import jax
+
+            return (f"This process is jax process "
+                    f"{jax.process_index()}/{jax.process_count()} "
+                    f"(pid {os.getpid()})")
+        except Exception:  # noqa: BLE001
+            return f"This process is pid {os.getpid()}"
+
     # -- the check (reference: CheckForStalledTensors) --------------------
     def check(self, now: Optional[float] = None) -> List[str]:
         """Report newly-stalled ops; trigger abort if past shutdown_time.
@@ -205,14 +226,23 @@ class StallInspector:
             if age >= self.warn_time and key not in self._warned:
                 self._warned.add(key)
                 warned_now.append(desc)
-                blame = ""
                 if self._reporter is not None:
                     with self._lock:
                         my_seq = self._next_key
                     lag = self._reporter.laggards(
                         my_seq, stale_after=max(self.warn_time, 5.0))
-                    if lag:
-                        blame = f" Ranks behind: {', '.join(lag)}."
+                    blame = (f" Ranks behind: {', '.join(lag)}."
+                             if lag else "")
+                else:
+                    # Degraded mode (reference names the missing ranks;
+                    # without the rendezvous KV we cannot): still name
+                    # the blocked op, this process's identity, and say
+                    # explicitly that attribution is unavailable.
+                    blame = (f" {self._local_identity()}; rank "
+                             "attribution unavailable (no rendezvous KV "
+                             "— launch via horovodrun_tpu or set "
+                             "HOROVOD_RENDEZVOUS_ADDR to name lagging "
+                             "ranks).")
                 self._warn_fn(
                     f"One or more collectives stalled for {age:.0f}s: "
                     f"[{desc}]. A rank may be lagging, dead, or running a "

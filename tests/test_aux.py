@@ -95,6 +95,25 @@ def test_stall_inspector_warns_once_per_op():
     assert si.pending_ops() == []
 
 
+def test_stall_inspector_degraded_mode_names_op_and_identity():
+    # Without a rendezvous KV the warning must still name the blocked
+    # op, this process's identity, and say attribution is unavailable
+    # (reference: CheckForStalledTensors' missing-ranks report; degraded
+    # analog per r03 verdict item 9).
+    warnings = []
+    si = stall_mod.StallInspector(
+        warn_time_seconds=0.05, warn_fn=warnings.append, reporter=None
+    )
+    si.record_start("ALLREDUCE:grad.w")
+    time.sleep(0.06)
+    si.check()
+    assert warnings
+    msg = warnings[0]
+    assert "ALLREDUCE:grad.w" in msg
+    assert "rank attribution unavailable" in msg
+    assert "This process is" in msg
+
+
 def test_stall_inspector_shutdown_threshold():
     aborted = []
     si = stall_mod.StallInspector(
@@ -242,3 +261,85 @@ def test_standalone_keras_namespace():
     assert callable(hvd_keras.DistributedOptimizer)
     assert hasattr(hvd_keras.callbacks, "BroadcastGlobalVariablesCallback")
     assert callable(hvd_keras.init)
+
+
+# ---------------------------------------------------------------------------
+# Profiler merge (host timeline + jax.profiler device trace -> one view)
+# ---------------------------------------------------------------------------
+
+def test_profiler_merge_aligns_and_offsets(tmp_path):
+    import gzip
+    import json as _json
+
+    from horovod_tpu.utils import profiler as prof
+
+    # Host timeline with the alignment marker at ts=500us.
+    tl = tl_mod.start_timeline(str(tmp_path / "host.json"))
+    tl._t0 -= 0.0005  # pretend 500us elapsed before the marker
+    tl.instant(prof.TRACE_START_MARKER, category="profiler")
+    tok = tl.activity_start("grad.w", "EXECUTE")
+    tl.activity_end(tok)
+    tl_mod.stop_timeline()
+
+    # Fake device trace (the converted jax.profiler format).
+    dev = {"traceEvents": [
+        {"name": "fusion.1", "ph": "X", "ts": 10.0, "dur": 50.0,
+         "pid": 1, "tid": 2},
+    ]}
+    devf = tmp_path / "dev.trace.json.gz"
+    with gzip.open(devf, "wt") as f:
+        _json.dump(dev, f)
+
+    out = tmp_path / "merged.json"
+    stats = prof.merge_traces(str(tmp_path / "host.json"), str(devf),
+                              str(out))
+    assert stats["aligned"] and stats["device_events"] == 1
+    merged = _json.load(open(out))["traceEvents"]
+    names = [e.get("name") for e in merged]
+    assert "fusion.1" in names and "EXECUTE" in names
+    marker = next(e for e in merged
+                  if e["name"] == prof.TRACE_START_MARKER)
+    # Marker shifted to t=0; host pid offset out of the device range.
+    assert abs(marker["ts"]) < 1.0
+    assert marker["pid"] >= prof.HOST_PID_OFFSET
+    host_exec = next(e for e in merged if e.get("name") == "EXECUTE")
+    assert host_exec["ts"] >= 0
+
+
+def test_profiler_merge_finds_trace_in_logdir(tmp_path):
+    import gzip
+    import json as _json
+
+    from horovod_tpu.utils import profiler as prof
+
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+    with gzip.open(run / "host.trace.json.gz", "wt") as f:
+        _json.dump({"traceEvents": []}, f)
+    tl = tl_mod.start_timeline(str(tmp_path / "host.json"))
+    tl_mod.stop_timeline()
+    stats = prof.merge_traces(str(tmp_path / "host.json"),
+                              str(tmp_path), str(tmp_path / "m.json"))
+    assert stats["device_events"] == 0 and not stats["aligned"]
+
+
+def test_data_parallel_step_marks_cycles(tmp_path):
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    f = tmp_path / "cycles.json"
+    tl_mod.start_timeline(str(f), mark_cycles=True)
+    try:
+        step = hvd.data_parallel(
+            lambda s, o, b: (s, o, jnp.sum(b)), batch_args=(2,))
+        s = jnp.zeros(())
+        o = jnp.zeros(())
+        b = hvd.shard_batch(jnp.ones((8, 2)))
+        s, o, _ = step(s, o, b)  # args 0/1 are donated: thread them
+        step(s, o, b)
+    finally:
+        tl_mod.stop_timeline()
+    evs = json.loads(open(f).read())
+    cycles = [e for e in evs if e.get("cat") == "cycle"]
+    assert len(cycles) == 2

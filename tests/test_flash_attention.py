@@ -23,14 +23,14 @@ class TestFlashForward:
     def test_matches_dense_oracle(self, causal):
         q, k, v = qkv()
         o_flash = fa.flash_attention(q, k, v, causal=causal)
-        o_dense = seq.full_attention(q, k, v, causal=causal)
+        o_dense = seq.dense_attention_oracle(q, k, v, causal=causal)
         np.testing.assert_allclose(o_flash, o_dense, atol=2e-5, rtol=2e-5)
 
     def test_bf16_inputs_bf16_output(self):
         q, k, v = qkv(dtype=jnp.bfloat16)
         o = fa.flash_attention(q, k, v)
         assert o.dtype == jnp.bfloat16
-        o_dense = seq.full_attention(q, k, v, causal=True)
+        o_dense = seq.dense_attention_oracle(q, k, v, causal=True)
         np.testing.assert_allclose(
             o.astype(np.float32), o_dense.astype(np.float32), atol=3e-2)
 
@@ -38,7 +38,7 @@ class TestFlashForward:
         q, k, v = qkv(T=128)
         np.testing.assert_allclose(
             fa.flash_attention(q, k, v),
-            seq.full_attention(q, k, v, causal=True), atol=2e-5, rtol=2e-5)
+            seq.dense_attention_oracle(q, k, v, causal=True), atol=2e-5, rtol=2e-5)
 
     def test_unaligned_seq_raises(self):
         q, k, v = qkv(T=100)
@@ -55,7 +55,7 @@ class TestFlashBackward:
             return lambda q, k, v: jnp.sum(fn(q, k, v, causal=causal) ** 2)
 
         gf = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
-        gd = jax.grad(loss(seq.full_attention), argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss(seq.dense_attention_oracle), argnums=(0, 1, 2))(q, k, v)
         for name, a, b in zip("qkv", gf, gd):
             scale = float(jnp.abs(b).max())
             np.testing.assert_allclose(
@@ -86,7 +86,7 @@ class TestDispatch:
         assert calls, "flash path not taken"
         monkeypatch.delenv("HOROVOD_FLASH_ATTENTION")
         np.testing.assert_allclose(
-            out, seq.full_attention(q, k, v, causal=True),
+            out, seq.dense_attention_oracle(q, k, v, causal=True),
             atol=2e-5, rtol=2e-5)
 
     def test_fallback_on_offset_or_unaligned(self, monkeypatch):
@@ -130,7 +130,7 @@ class TestRingFlash:
     def test_matches_oracle(self, causal, monkeypatch):
         mesh = self._mesh()
         q, k, v = qkv(B=1, T=512, H=4, D=32)
-        oracle = seq.full_attention(q, k, v, causal=causal)
+        oracle = seq.dense_attention_oracle(q, k, v, causal=causal)
         monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "1")
         out = seq.ring_attention(q, k, v, mesh, causal=causal)
         np.testing.assert_allclose(out, oracle, atol=3e-5, rtol=3e-5)
@@ -140,7 +140,7 @@ class TestRingFlash:
         mesh = self._mesh()
         q, k, v = qkv(B=1, T=256, H=4, D=32)
         # Oracle BEFORE the env flip so it is the true dense reference.
-        oracle = seq.full_attention(q, k, v)
+        oracle = seq.dense_attention_oracle(q, k, v)
         monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "1")
         monkeypatch.setattr(
             fa, "flash_attention_lse",
@@ -157,7 +157,7 @@ class TestRingFlash:
             argnums=(0, 1, 2))(q, k, v)
         monkeypatch.delenv("HOROVOD_FLASH_ATTENTION")
         gd = jax.grad(lambda q, k, v: jnp.sum(
-            seq.full_attention(q, k, v) ** 2),
+            seq.dense_attention_oracle(q, k, v) ** 2),
             argnums=(0, 1, 2))(q, k, v)
         for name, a, b in zip("qkv", gf, gd):
             scale = max(1.0, float(jnp.abs(b).max()))

@@ -208,3 +208,42 @@ class TestZooModels:
         gn = sum(float(jnp.abs(x).sum())
                  for x in jax.tree_util.tree_leaves(g))
         assert np.isfinite(gn) and gn > 0
+
+
+class TestSpaceToDepthStem:
+    """Conv0 space-to-depth (HOROVOD_CONV0_SPACE_TO_DEPTH) must be
+    numerically equivalent to the plain 7x7/s2 SAME stem — same weights,
+    re-tiled in-graph."""
+
+    def test_stem_transform_matches_plain_conv(self):
+        from horovod_tpu.models import layers as L
+        from horovod_tpu.models.resnet import _stem_space_to_depth_apply
+
+        p = L.conv2d_init(jax.random.PRNGKey(0), 3, 64, 7, jnp.float32)
+        for hw in (64, 224):
+            x = jax.random.normal(jax.random.PRNGKey(1), (2, hw, hw, 3))
+            ref = L.conv2d_apply(p, x, 2, compute_dtype=None)
+            got = _stem_space_to_depth_apply(p, x, None)
+            assert got.shape == ref.shape
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_full_apply_matches_with_flag(self, monkeypatch):
+        from horovod_tpu.models import resnet_init, resnet_apply
+
+        v = resnet_init(jax.random.PRNGKey(0), 18, num_classes=10)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        base, _ = resnet_apply(v, x, train=False, compute_dtype=None)
+        monkeypatch.setenv("HOROVOD_CONV0_SPACE_TO_DEPTH", "1")
+        s2d, _ = resnet_apply(v, x, train=False, compute_dtype=None)
+        np.testing.assert_allclose(np.asarray(s2d), np.asarray(base),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_odd_spatial_falls_back(self, monkeypatch):
+        from horovod_tpu.models import resnet_init, resnet_apply
+
+        monkeypatch.setenv("HOROVOD_CONV0_SPACE_TO_DEPTH", "1")
+        v = resnet_init(jax.random.PRNGKey(0), 18, num_classes=10)
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 33, 33, 3))
+        logits, _ = resnet_apply(v, x, train=False, compute_dtype=None)
+        assert logits.shape == (1, 10)

@@ -14,7 +14,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import horovod_tpu as hvd
 from horovod_tpu.parallel import (
     create_hybrid_mesh,
-    full_attention,
+    dense_attention_oracle,
     gpipe,
     moe_apply_dense,
     moe_init,
@@ -53,7 +53,7 @@ class TestSequenceParallel:
     def test_ring_vs_full(self, causal, sp):
         mesh = create_hybrid_mesh(dp=-1, sp=sp)
         q, k, v = _qkv(jax.random.PRNGKey(0))
-        want = full_attention(q, k, v, causal=causal)
+        want = dense_attention_oracle(q, k, v, causal=causal)
         got = ring_attention(q, k, v, mesh, causal=causal)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
@@ -62,7 +62,7 @@ class TestSequenceParallel:
     def test_ulysses_vs_full(self, sp):
         mesh = create_hybrid_mesh(dp=-1, sp=sp)
         q, k, v = _qkv(jax.random.PRNGKey(1))
-        want = full_attention(q, k, v, causal=True)
+        want = dense_attention_oracle(q, k, v, causal=True)
         got = ulysses_attention(q, k, v, mesh, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
@@ -71,7 +71,7 @@ class TestSequenceParallel:
         # f32 accumulation inside: bf16 inputs must not collapse.
         mesh = create_hybrid_mesh(dp=-1, sp=4)
         q, k, v = _qkv(jax.random.PRNGKey(2), dtype=jnp.bfloat16)
-        want = full_attention(q, k, v, causal=True)
+        want = dense_attention_oracle(q, k, v, causal=True)
         got = ring_attention(q, k, v, mesh, causal=True)
         np.testing.assert_allclose(
             np.asarray(got, np.float32), np.asarray(want, np.float32),
@@ -85,7 +85,7 @@ class TestSequenceParallel:
             return jnp.sum(ring_attention(q, k, v, mesh) ** 2)
 
         def loss_full(q, k, v):
-            return jnp.sum(full_attention(q, k, v) ** 2)
+            return jnp.sum(dense_attention_oracle(q, k, v) ** 2)
 
         g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
         g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)

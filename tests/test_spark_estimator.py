@@ -43,11 +43,14 @@ class TestStore:
         s = Store.create(f"file://{tmp_path}/fs")
         assert s.prefix_path == f"{tmp_path}/fs"
 
-    @pytest.mark.parametrize("url", ["hdfs://nn/x", "s3://b/x", "dbfs:/x",
-                                     "abfss://c@a/x", "HDFS://nn/x"])
-    def test_remote_schemes_raise(self, url):
-        with pytest.raises(HorovodTpuError, match="remote filesystem"):
+    @pytest.mark.parametrize("url", ["s3://b/x", "abfss://c@a/x"])
+    def test_object_scheme_without_client_raises(self, url):
+        with pytest.raises(HorovodTpuError, match="filesystem client"):
             Store.create(url)
+
+    def test_hdfs_without_client_raises(self):
+        with pytest.raises(HorovodTpuError, match="hadoop client"):
+            Store.create("hdfs://nn/x")
 
     def test_paths_and_atomic_write(self, tmp_path):
         s = Store.create(str(tmp_path))
@@ -65,6 +68,124 @@ class TestStore:
         assert os.path.isdir(prefix)
         s.cleanup()
         assert not os.path.exists(prefix)
+
+
+class _MockFs:
+    """In-memory duck-typed filesystem client (the injection seam real
+    cluster deployments fill with pyarrow/fsspec)."""
+
+    def __init__(self):
+        self.files = {}
+        self.dirs = set()
+        self.renames = []
+
+    class _Buf:
+        def __init__(self, fs, path, mode):
+            import io
+
+            self._fs, self._path, self._mode = fs, path, mode
+            self._io = io.BytesIO(fs.files.get(path, b"")
+                                  if "r" in mode else b"")
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            if "w" in self._mode:
+                self._fs.files[self._path] = self._io.getvalue()
+
+        def read(self):
+            return self._io.getvalue()
+
+        def write(self, data):
+            self._io.write(data)
+
+    def open(self, path, mode="rb"):
+        return self._Buf(self, path, mode)
+
+    def exists(self, path):
+        return path in self.files or path in self.dirs or any(
+            f.startswith(path + "/") for f in self.files)
+
+    def mkdirs(self, path):
+        self.dirs.add(path)
+
+    def ls(self, path):
+        out = set()
+        for f in list(self.files) + list(self.dirs):
+            if f.startswith(path + "/"):
+                out.add(f[len(path) + 1:].split("/")[0])
+        return sorted(path + "/" + o for o in out)
+
+    def rename(self, src, dst):
+        # HDFS semantics: rename refuses to overwrite an existing dst.
+        if dst in self.files:
+            raise FileExistsError(dst)
+        self.renames.append((src, dst))
+        self.files[dst] = self.files.pop(src)
+
+    def delete(self, path):
+        self.files.pop(path, None)
+
+
+class TestRemoteStores:
+    """URI-level store routing with mocked clients (reference:
+    store.py HDFSStore ≈L200-400 / DBFSLocalStore; r03 verdict
+    missing-item 4)."""
+
+    def test_create_routes_hdfs_with_injected_client(self):
+        from horovod_tpu.spark.common.store import HDFSStore
+
+        fs = _MockFs()
+        s = Store.create("hdfs://nn:8020/warehouse", filesystem=fs)
+        assert isinstance(s, HDFSStore)
+        assert s.get_train_data_path("r1") == \
+            "hdfs://nn:8020/warehouse/intermediate_train_data/r1"
+
+    def test_create_routes_object_store_with_injected_client(self):
+        from horovod_tpu.spark.common.store import FilesystemStore
+
+        s = Store.create("s3://bucket/prefix", filesystem=_MockFs())
+        assert isinstance(s, FilesystemStore)
+
+    def test_remote_io_roundtrip_atomic(self):
+        fs = _MockFs()
+        s = Store.create("hdfs://nn/wh", filesystem=fs)
+        p = s.get_run_path("r2") + "/blob.bin"
+        s.write_bytes(p, b"payload")
+        assert s.exists(p) and s.read_bytes(p) == b"payload"
+        # Atomic: written to a tmp name then renamed.
+        assert fs.renames and fs.renames[0][1] == p
+        assert s.list_dir(s.get_run_path("r2")) == ["blob.bin"]
+        assert s.saving_runs() == ["r2"]
+
+    def test_remote_rewrite_same_path_survives_hdfs_rename(self):
+        # HDFS rename does not overwrite: the second checkpoint write to
+        # the same path must still land (store deletes dst first).
+        fs = _MockFs()
+        s = Store.create("hdfs://nn/wh", filesystem=fs)
+        p = s.get_checkpoint_path("r3")
+        s.write_bytes(p, b"epoch1")
+        s.write_bytes(p, b"epoch2")
+        assert s.read_bytes(p) == b"epoch2"
+        assert not [f for f in fs.files if ".tmp." in f]
+
+    def test_checkpoint_path_layout_matches_local(self, tmp_path):
+        remote = Store.create("hdfs://nn/wh", filesystem=_MockFs())
+        local = Store.create(str(tmp_path))
+        rel = lambda s, p: p.replace(s.prefix_path, "")  # noqa: E731
+        assert rel(remote, remote.get_checkpoint_path("x")).replace(
+            "\\", "/") == rel(local, local.get_checkpoint_path("x")).replace(
+            os.sep, "/")
+
+    def test_dbfs_maps_to_fuse_mount(self):
+        from horovod_tpu.spark.common.store import DBFSLocalStore
+
+        s = Store.create("dbfs:/ml/store")
+        assert isinstance(s, DBFSLocalStore)
+        assert s.prefix_path == "/dbfs/ml/store"
+        assert DBFSLocalStore.normalize_datasets_dir("dbfs:/a/b") == \
+            "/dbfs/a/b"
 
 
 # ---------------------------------------------------------------------------

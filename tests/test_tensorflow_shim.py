@@ -98,15 +98,95 @@ class TestTfOps:
         hvd_tf.broadcast_variables([v], root_rank=0)
         np.testing.assert_allclose(v.numpy(), [1.0, 2.0, 3.0])
 
-    def test_indexed_slices_densified(self):
+    def test_indexed_slices_sparse_allreduce(self):
+        # Reference semantics: allreduce of IndexedSlices is the
+        # allgather-based sparse path — IndexedSlices out, scatter-add
+        # equal to the dense allreduce of the scattered input.
         values = tf.constant([[1.0, 1.0], [2.0, 2.0]])
         indices = tf.constant([0, 2], dtype=tf.int64)
         slices = tf.IndexedSlices(values, indices,
                                   dense_shape=tf.constant([4, 2],
                                                           dtype=tf.int64))
         out = hvd_tf.allreduce(slices, op=hvd_tf.Sum)
-        dense = tf.convert_to_tensor(slices).numpy()
-        np.testing.assert_allclose(out.numpy(), 8.0 * dense)
+        assert isinstance(out, tf.IndexedSlices)
+        assert int(out.values.shape[0]) == 2 * hvd_tf.size()
+        dense_want = 8.0 * tf.convert_to_tensor(slices).numpy()
+        dense_got = tf.scatter_nd(
+            tf.expand_dims(out.indices, 1), out.values, [4, 2]).numpy()
+        np.testing.assert_allclose(dense_got, dense_want)
+
+    def test_indexed_slices_sparse_average_matches_dense(self):
+        values = tf.constant([[3.0], [5.0]])
+        indices = tf.constant([1, 3], dtype=tf.int64)
+        slices = tf.IndexedSlices(values, indices,
+                                  dense_shape=tf.constant([4, 1],
+                                                          dtype=tf.int64))
+        out = hvd_tf.allreduce(slices)  # Average
+        dense_got = tf.scatter_nd(
+            tf.expand_dims(out.indices, 1), out.values, [4, 1]).numpy()
+        np.testing.assert_allclose(
+            dense_got, tf.convert_to_tensor(slices).numpy())
+
+    def test_fused_flat_allreduce_matches_per_tensor(self):
+        # The TF-side fusion buffer (one flat bridge crossing per dtype)
+        # must be numerically identical to per-tensor reduction.
+        from horovod_tpu.tensorflow import _fused_flat_allreduce
+
+        ts = [tf.constant([[1.0, 2.0], [3.0, 4.0]]),
+              tf.constant([5.0, 6.0, 7.0]),
+              tf.constant([1, 2, 3], dtype=tf.int32),
+              tf.constant(9.0)]
+        fused = _fused_flat_allreduce(
+            ts, hvd_tf.Sum, hvd_tf.Compression.none, None)
+        single = [hvd_tf.allreduce(t, op=hvd_tf.Sum) for t in ts]
+        for f, s, t in zip(fused, single, ts):
+            assert f.dtype == t.dtype and f.shape == t.shape
+            np.testing.assert_allclose(np.asarray(f), np.asarray(s))
+
+    def test_allreduce_grads_size1_process_set_short_circuits(self):
+        # n==1 allreduce is the identity (reference np=1 = memcpy):
+        # result returns unchanged without crossing the bridge.
+        from horovod_tpu.tensorflow import _allreduce_grads
+
+        ps = hvd_tf.add_process_set([hvd_tf.rank()])
+        try:
+            g = tf.constant([1.0, 2.0])
+            out = _allreduce_grads([g, None], hvd_tf.Average,
+                                   hvd_tf.Compression.none, ps,
+                                   sparse_as_dense=False)
+            assert out[0] is g and out[1] is None
+        finally:
+            hvd_tf.remove_process_set(ps)
+
+    def test_allreduce_grads_sparse_vs_dense_switch(self):
+        # Ragged embedding-style grads: sparse path result must equal
+        # the sparse_as_dense=True densified path after scatter-add.
+        from horovod_tpu.tensorflow import _allreduce_grads
+
+        values = tf.constant([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        indices = tf.constant([0, 2, 2], dtype=tf.int64)
+        mk = lambda: tf.IndexedSlices(  # noqa: E731
+            values, indices,
+            dense_shape=tf.constant([5, 2], dtype=tf.int64))
+        dense_grad = tf.ones([3, 3])
+
+        out_sparse = _allreduce_grads(
+            [mk(), dense_grad, None], hvd_tf.Average,
+            hvd_tf.Compression.none, None, sparse_as_dense=False)
+        out_dense = _allreduce_grads(
+            [mk(), dense_grad, None], hvd_tf.Average,
+            hvd_tf.Compression.none, None, sparse_as_dense=True)
+
+        assert isinstance(out_sparse[0], tf.IndexedSlices)
+        assert not isinstance(out_dense[0], tf.IndexedSlices)
+        scattered = tf.scatter_nd(
+            tf.expand_dims(out_sparse[0].indices, 1),
+            out_sparse[0].values, [5, 2]).numpy()
+        np.testing.assert_allclose(scattered, out_dense[0].numpy(),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(out_sparse[1].numpy(),
+                                   out_dense[1].numpy())
+        assert out_sparse[2] is None and out_dense[2] is None
 
     def test_async_handle(self):
         h = hvd_tf.allreduce_async(tf.ones([4]), op=hvd_tf.Sum)
