@@ -112,6 +112,11 @@ from .utils.timeline import (  # noqa: F401
     stop_timeline,
 )
 
+from .utils.prefetch import (  # noqa: F401
+    prefetch_to_device,
+    BackgroundPrefetcher,
+)
+
 from .utils.autotune import (  # noqa: F401
     ParameterManager,
     get_manager as autotune_manager,

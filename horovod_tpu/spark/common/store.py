@@ -25,6 +25,7 @@ are not in this image).
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import tempfile
@@ -160,7 +161,12 @@ class DBFSLocalStore(LocalStore):
     whole LocalStore machinery applies after the prefix translation —
     the same trick the reference plays."""
 
-    def __init__(self, prefix_path: str):
+    def __init__(self, prefix_path: str, **kwargs):
+        if kwargs:
+            raise HorovodTpuError(
+                "DBFSLocalStore is the local /dbfs FUSE mount and takes "
+                f"no client options; got {sorted(kwargs)} — remote "
+                "clients belong to hdfs://…/s3://… stores")
         if not prefix_path.lower().startswith("dbfs:/"):
             raise HorovodTpuError(
                 f"DBFSLocalStore expects a dbfs:/ path, got {prefix_path!r}")
@@ -229,8 +235,24 @@ class FilesystemStore(Store):
         return bool(self._fs.exists(path))
 
     def read_bytes(self, path: str) -> bytes:
+        if not self.exists(path):
+            # Crash-window recovery: a write interrupted between the
+            # two swap renames leaves the previous good file at .bak.
+            bak = f"{path}.bak"
+            if self.exists(bak):
+                with self._fs.open(bak, "rb") as f:
+                    return f.read()
         with self._fs.open(path, "rb") as f:
             return f.read()
+
+    def _reap_bak(self, path: str) -> None:
+        """Best-effort removal of a superseded/stale `.bak` once a good
+        `path` exists (covers crash leftovers from interrupted swaps)."""
+        bak = f"{path}.bak"
+        rm = getattr(self._fs, "delete", None) or \
+            getattr(self._fs, "rm", None)
+        if rm is not None and self.exists(bak):
+            rm(bak)
 
     def write_bytes(self, path: str, data: bytes) -> None:
         self.mkdirs(path.rsplit("/", 1)[0])
@@ -238,15 +260,43 @@ class FilesystemStore(Store):
             tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
             with self._fs.open(tmp, "wb") as f:
                 f.write(data)
-            # HDFS rename does NOT overwrite an existing destination
-            # (unlike POSIX os.replace): clear it first so repeated
-            # checkpoint writes to the same path succeed.
-            if self.exists(path):
+            try:
+                # POSIX-like clients overwrite on rename — fully atomic.
+                self._fs.rename(tmp, path)
+            except Exception:  # noqa: BLE001 — dst-exists rename refusal
+                # Only treat the failure as HDFS no-overwrite semantics
+                # when the destination actually exists; anything else
+                # (permissions, quota, partition) must propagate without
+                # touching the live file.
+                if not self.exists(path):
+                    raise
+                # Move the old checkpoint ASIDE (never delete-first): a
+                # crash between these renames leaves a recoverable .bak
+                # (read_bytes falls back to it), not a window with no
+                # checkpoint at all.  The bak name is FIXED so leftovers
+                # are reaped, not accumulated.
+                bak = f"{path}.bak"
                 rm = getattr(self._fs, "delete", None) or \
                     getattr(self._fs, "rm", None)
-                if rm is not None:
-                    rm(path)
-            self._fs.rename(tmp, path)
+                if self.exists(bak):
+                    if rm is not None:
+                        rm(bak)
+                    else:
+                        # No delete capability: rotate the stale backup
+                        # to a unique name so the fixed slot frees up.
+                        # This leaks one file per rewrite — loudly, once.
+                        if not getattr(self, "_warned_bak_leak", False):
+                            self._warned_bak_leak = True
+                            logging.getLogger(__name__).warning(
+                                "store client for %s has no delete/rm: "
+                                "checkpoint rewrites on a no-overwrite "
+                                "filesystem will accumulate .bak files",
+                                self.prefix_path)
+                        self._fs.rename(
+                            bak, f"{bak}.{uuid.uuid4().hex[:8]}")
+                self._fs.rename(path, bak)
+                self._fs.rename(tmp, path)
+            self._reap_bak(path)
         else:
             with self._fs.open(path, "wb") as f:
                 f.write(data)
@@ -336,7 +386,15 @@ class _PyarrowFsAdapter:
 
 
 def _strip_scheme(path: str) -> str:
-    return path.split("://", 1)[1] if "://" in path else path
+    """`hdfs://host:port/a/b` → `/a/b` (the client is already bound to
+    the authority; keeping `host:port` would make every path a bogus
+    relative path).  `hdfs:///a/b` → `/a/b`; scheme-less paths pass
+    through."""
+    if "://" not in path:
+        return path
+    rest = path.split("://", 1)[1]
+    slash = rest.find("/")
+    return rest[slash:] if slash >= 0 else "/"
 
 
 # Shard base name shared by writer (util.py) and the remote trainers;

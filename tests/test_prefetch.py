@@ -1,0 +1,110 @@
+"""Input-prefetcher tests (utils/prefetch.py): device placement, stream
+order, look-ahead, exception propagation, producer-thread lifecycle.
+
+Reference analog: the Spark async data loaders
+(spark/data_loaders/pytorch_data_loaders.py) and the synthetic
+benchmark's pre-staged device batches.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import horovod_tpu as hvd
+from horovod_tpu.utils.prefetch import (
+    BackgroundPrefetcher,
+    prefetch_to_device,
+)
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+    yield
+
+
+def _host_batches(n, batch=8):
+    for i in range(n):
+        yield {"x": np.full((batch, 4), i, np.float32),
+               "y": np.arange(batch, dtype=np.int32)}
+
+
+class TestPrefetchToDevice:
+    def test_stream_order_and_values(self):
+        out = list(prefetch_to_device(_host_batches(5), size=2))
+        assert len(out) == 5
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(
+                np.asarray(b["x"]), np.full((8, 4), i, np.float32))
+
+    def test_batches_are_sharded_on_mesh(self):
+        (b,) = list(prefetch_to_device(_host_batches(1), size=2))
+        x = b["x"]
+        assert isinstance(x, jax.Array)
+        # dim 0 split over the 8-rank axis: each shard holds 1 row.
+        assert len(x.addressable_shards) == hvd.size()
+        assert x.addressable_shards[0].data.shape == (1, 4)
+
+    def test_feeds_data_parallel_step(self):
+        step = hvd.data_parallel(
+            lambda b: hvd.allreduce(b["x"].sum()))
+        for b in prefetch_to_device(_host_batches(3), size=2):
+            out = step(b)
+        assert np.isfinite(float(out))
+
+    def test_size_one_and_short_stream(self):
+        assert len(list(prefetch_to_device(_host_batches(1), size=4))) == 1
+        assert list(prefetch_to_device(iter([]), size=2)) == []
+
+    def test_bad_size(self):
+        with pytest.raises(ValueError):
+            list(prefetch_to_device(_host_batches(1), size=0))
+
+    def test_custom_sharding_replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from horovod_tpu.common import basics
+
+        s = NamedSharding(basics.global_mesh(), P())
+        (b,) = list(prefetch_to_device(_host_batches(1), sharding=s))
+        assert b["x"].sharding.is_fully_replicated
+
+    def test_source_exception_propagates(self):
+        def bad():
+            yield {"x": np.zeros((8, 4), np.float32)}
+            raise RuntimeError("decode failed")
+
+        it = prefetch_to_device(bad(), size=1)
+        next(it)
+        with pytest.raises(RuntimeError, match="decode failed"):
+            next(it)
+
+
+class TestBackgroundPrefetcher:
+    def test_stream_order(self):
+        with BackgroundPrefetcher(_host_batches(6), size=2) as it:
+            vals = [float(np.asarray(b["x"])[0, 0]) for b in it]
+        assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+    def test_exception_reraises_in_order(self):
+        def bad():
+            yield {"x": np.ones((8, 4), np.float32)}
+            raise ValueError("boom")
+
+        p = BackgroundPrefetcher(bad(), size=2)
+        it = iter(p)
+        next(it)
+        with pytest.raises(ValueError, match="boom"):
+            next(it)
+
+    def test_close_unblocks_producer(self):
+        p = BackgroundPrefetcher(_host_batches(100), size=1)
+        it = iter(p)
+        next(it)
+        p.close()  # must not hang on the full queue
+
+    def test_second_iteration_returns_immediately(self):
+        p = BackgroundPrefetcher(_host_batches(2), size=2)
+        assert len(list(iter(p))) == 2
+        assert list(iter(p)) == []  # must not hang on a spent sentinel

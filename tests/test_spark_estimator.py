@@ -161,14 +161,40 @@ class TestRemoteStores:
 
     def test_remote_rewrite_same_path_survives_hdfs_rename(self):
         # HDFS rename does not overwrite: the second checkpoint write to
-        # the same path must still land (store deletes dst first).
+        # the same path must still land (store moves dst aside to a .bak
+        # and cleans it up after the swap).
         fs = _MockFs()
         s = Store.create("hdfs://nn/wh", filesystem=fs)
         p = s.get_checkpoint_path("r3")
         s.write_bytes(p, b"epoch1")
         s.write_bytes(p, b"epoch2")
         assert s.read_bytes(p) == b"epoch2"
-        assert not [f for f in fs.files if ".tmp." in f]
+        assert not [f for f in fs.files if ".tmp." in f or ".bak" in f]
+
+    def test_rewrite_never_deletes_checkpoint_outright(self):
+        # Crash-safety: at no point may the destination be deleted while
+        # no replacement exists — the old file is renamed aside, so a
+        # crash mid-swap leaves a recoverable .bak (r04 review finding).
+        fs = _MockFs()
+        deleted = []
+        orig_delete = fs.delete
+        fs.delete = lambda path: (deleted.append(path), orig_delete(path))
+        s = Store.create("hdfs://nn/wh", filesystem=fs)
+        p = s.get_checkpoint_path("r4")
+        s.write_bytes(p, b"epoch1")
+        s.write_bytes(p, b"epoch2")
+        assert p not in deleted
+        assert all(".bak" in d for d in deleted)
+
+    def test_strip_scheme_drops_authority(self):
+        from horovod_tpu.spark.common.store import _strip_scheme
+
+        # hdfs://host:port/a/b must resolve to the ABSOLUTE /a/b — the
+        # client is already bound to the authority (r04 review finding).
+        assert _strip_scheme("hdfs://nn:8020/tmp/run/x") == "/tmp/run/x"
+        assert _strip_scheme("hdfs:///tmp/run/x") == "/tmp/run/x"
+        assert _strip_scheme("hdfs://nn:8020") == "/"
+        assert _strip_scheme("/plain/path") == "/plain/path"
 
     def test_checkpoint_path_layout_matches_local(self, tmp_path):
         remote = Store.create("hdfs://nn/wh", filesystem=_MockFs())
