@@ -50,6 +50,19 @@ def env_float(name: str, default: float) -> float:
         return default
 
 
+def is_tpu_backend() -> bool:
+    """True when jax's default backend is a real TPU — the predicate
+    auto-default perf features key on (conv0 space-to-depth, flash
+    length routing).  Never raises: a broken/unreachable backend reads
+    as 'not TPU' so auto features degrade to the portable path."""
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001 — probe must not propagate
+        return False
+
+
 def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     """Pin JAX to the CPU host platform (optionally with n virtual
     devices) BEFORE any backend initialization.

@@ -46,11 +46,25 @@ _NEG = -1e30
 _BLOCK = 128  # q and k block rows (= lane width; min f32 sublane x 16)
 
 
-def flash_enabled() -> bool:
-    """HOROVOD_FLASH_ATTENTION=1 routes transformer/sequence local
-    attention through these kernels (opt-in until measured faster on
-    the target shape — the Adasum-kernel precedent)."""
-    return PALLAS_AVAILABLE and util.env_bool("FLASH_ATTENTION", False)
+def flash_routed(seq_len: int) -> bool:
+    """Should attention at `seq_len` run the flash kernel?
+
+    Forced by HOROVOD_FLASH_ATTENTION=1/0 when set.  AUTO when unset:
+    on TPU, lengths >= HOROVOD_FLASH_ATTENTION_MIN_T (default 16384)
+    route to flash — the r04 on-chip sweep (docs/PERF_NOTES.md) measured
+    the XLA dense path OOM-ing at T=16384 (the f32 [T,T] score temp
+    alone wants 34 GB at 32k) while flash runs 16k at 420 ms and 32k at
+    1275 ms fwd+bwd; below the threshold XLA's fused dense attention
+    ties or wins wall-clock (1.12x flash at 2k B4, 0.89-0.95x at
+    4k-8k), so it stays the default there."""
+    if not PALLAS_AVAILABLE:
+        return False
+    forced = util.getenv("FLASH_ATTENTION")
+    if forced is not None:
+        return util.env_bool("FLASH_ATTENTION", False)
+    if not util.is_tpu_backend():
+        return False
+    return seq_len >= util.env_int("FLASH_ATTENTION_MIN_T", 16384)
 
 
 # ---------------------------------------------------------------------------
@@ -341,5 +355,5 @@ def flash_attention_lse(q, k, v, causal: bool = True):
     return o, lse
 
 
-__all__ = ["flash_attention", "flash_attention_lse", "flash_enabled",
+__all__ = ["flash_attention", "flash_attention_lse", "flash_routed",
            "PALLAS_AVAILABLE"]

@@ -126,7 +126,7 @@ def ring_attention_shard(q, k, v, axis: str, causal: bool = True):
     """
     from ..ops import flash_attention as fa
 
-    if fa.flash_enabled() and q.shape[1] % 128 == 0:
+    if fa.flash_routed(q.shape[1]) and q.shape[1] % 128 == 0:
         return ring_flash_attention_shard(q, k, v, axis, causal=causal)
     sp = lax.psum(1, axis)
     idx = lax.axis_index(axis)
@@ -159,17 +159,19 @@ def ring_attention_shard(q, k, v, axis: str, causal: bool = True):
 def full_attention(q, k, v, causal: bool = True, q_offset: int = 0):
     """Production dense attention [B,T,H,D] (used by Ulysses locally).
 
-    With HOROVOD_FLASH_ATTENTION=1 and compatible shapes (square,
-    128-aligned, no offset) this routes through the Pallas flash kernel
-    (ops/flash_attention.py): same numerics, O(T) memory instead of the
-    [T, T] score matrix — the enabler for long-context local shards.
+    Routing (`ops.flash_attention.flash_routed`): compatible shapes
+    (square, 128-aligned, no offset) go through the Pallas flash kernel
+    when forced by HOROVOD_FLASH_ATTENTION=1 or — automatically, on
+    TPU — when T >= 16384, where the dense [T, T] score matrix can no
+    longer be materialized at all (r04 on-chip sweep): same numerics,
+    O(T) memory, the enabler for long-context local shards.
     Tests comparing flash against a dense result must use
     `dense_attention_oracle`, which NEVER dispatches to flash (otherwise
     a CI env exporting the flag would turn the comparison into a
     self-comparison)."""
     from ..ops import flash_attention as fa
 
-    if (fa.flash_enabled() and q_offset == 0 and
+    if (fa.flash_routed(q.shape[1]) and q_offset == 0 and
             q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0):
         return fa.flash_attention(q, k, v, causal=causal)
     return dense_attention_oracle(q, k, v, causal=causal, q_offset=q_offset)

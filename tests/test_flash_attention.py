@@ -171,3 +171,40 @@ class TestRingFlash:
         ref = jax.scipy.special.logsumexp(s, axis=-1)  # [B,H,T]
         np.testing.assert_allclose(
             lse, ref.transpose(0, 2, 1), atol=2e-5, rtol=2e-5)
+
+
+class TestAutoRouting:
+    """Length-based auto routing (flash_routed): forced by the env flag
+    when set; unset = TPU-only auto at T >= MIN_T (r04 on-chip sweep:
+    dense OOMs at 16k, flash is the only runner)."""
+
+    def test_forced_on_and_off(self, monkeypatch):
+        from horovod_tpu.ops import flash_attention as fa
+
+        if not fa.PALLAS_AVAILABLE:
+            pytest.skip("pallas unavailable")
+        monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "1")
+        assert fa.flash_routed(128) is True
+        monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "0")
+        assert fa.flash_routed(1 << 20) is False
+
+    def test_auto_is_off_on_cpu(self, monkeypatch):
+        from horovod_tpu.ops import flash_attention as fa
+
+        monkeypatch.delenv("HOROVOD_FLASH_ATTENTION", raising=False)
+        # The test harness runs on the CPU platform: auto must not
+        # route to the (interpreter-slow) kernel regardless of length.
+        assert fa.flash_routed(1 << 20) is False
+
+    def test_auto_threshold_on_tpu(self, monkeypatch):
+        from horovod_tpu.ops import flash_attention as fa
+
+        if not fa.PALLAS_AVAILABLE:
+            pytest.skip("pallas unavailable")
+        monkeypatch.delenv("HOROVOD_FLASH_ATTENTION", raising=False)
+        import jax
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert fa.flash_routed(16384) is True
+        assert fa.flash_routed(8192) is False
+        monkeypatch.setenv("HOROVOD_FLASH_ATTENTION_MIN_T", "4096")
+        assert fa.flash_routed(8192) is True
