@@ -81,9 +81,15 @@ def main():
     for epoch in range(args.epochs):
         t0 = time.time()
         perm = np.random.permutation(len(train_x))
-        for i in range(steps):
-            idx = perm[i * global_bs:(i + 1) * global_bs]
-            batch = hvd.shard_batch((train_x[idx], train_y[idx]))
+
+        def host_batches():
+            for i in range(steps):
+                idx = perm[i * global_bs:(i + 1) * global_bs]
+                yield train_x[idx], train_y[idx]
+
+        # Double-buffered host->device pipeline: batch i+1 transfers
+        # while batch i trains (utils/prefetch.py).
+        for batch in hvd.prefetch_to_device(host_batches(), size=2):
             params, opt_state, loss = train_step(params, opt_state, batch)
         # Metric averaging across ranks (reference: MetricAverageCallback).
         acc = eval_step(params, hvd.shard_batch(

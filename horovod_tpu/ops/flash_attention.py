@@ -60,7 +60,9 @@ def flash_routed(seq_len: int) -> bool:
     if not PALLAS_AVAILABLE:
         return False
     forced = util.getenv("FLASH_ATTENTION")
-    if forced is not None:
+    if forced is not None and forced.strip() != "":
+        # Empty string = unset (a CI default like FOO= must not force
+        # dense and reintroduce the long-T OOM auto-routing prevents).
         return util.env_bool("FLASH_ATTENTION", False)
     if not util.is_tpu_backend():
         return False

@@ -57,7 +57,9 @@ def _block_attn_update(q, k, v, o, m, l, q_pos, k_pos, scale, causal):
 
 def ring_flash_attention_shard(q, k, v, axis: str, causal: bool = True):
     """Ring attention with the Pallas flash kernel as the per-pair block
-    engine (used when HOROVOD_FLASH_ATTENTION=1 and T_local % 128 == 0).
+    engine (used when `flash_routed(T_local)` — forced via
+    HOROVOD_FLASH_ATTENTION=1 or auto on TPU at T_local >= 16384 — and
+    T_local % 128 == 0).
 
     Each ring step runs AT MOST one flash call on (q_local, kv_block):
     a lax.switch picks causal (diagonal pair), dense (strictly-past
@@ -119,10 +121,12 @@ def ring_attention_shard(q, k, v, axis: str, causal: bool = True):
     Per-shard shapes: q/k/v [B, T_local, H, D] (the global sequence is
     sharded over `axis`).  Returns [B, T_local, H, D] in q.dtype.
 
-    With HOROVOD_FLASH_ATTENTION=1 and 128-aligned local shards, the
-    per-pair block math runs through the Pallas flash kernel
-    (`ring_flash_attention_shard`); the XLA blockwise path below is the
-    default and the numerical oracle.
+    With `flash_routed(T_local)` (HOROVOD_FLASH_ATTENTION=1, or — with
+    the env unset — automatically on TPU at T_local >= 16384) and
+    128-aligned local shards, the per-pair block math runs through the
+    Pallas flash kernel (`ring_flash_attention_shard`); the XLA
+    blockwise path below serves shorter shards and is the numerical
+    oracle.
     """
     from ..ops import flash_attention as fa
 

@@ -14,7 +14,6 @@ import gzip
 import json
 import os
 import sys
-import time
 
 
 def main():

@@ -208,3 +208,14 @@ class TestAutoRouting:
         assert fa.flash_routed(8192) is False
         monkeypatch.setenv("HOROVOD_FLASH_ATTENTION_MIN_T", "4096")
         assert fa.flash_routed(8192) is True
+
+    def test_empty_env_value_is_unset(self, monkeypatch):
+        from horovod_tpu.ops import flash_attention as fa
+
+        if not fa.PALLAS_AVAILABLE:
+            pytest.skip("pallas unavailable")
+        monkeypatch.setenv("HOROVOD_FLASH_ATTENTION", "")
+        import jax
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        # Empty string must fall through to auto, not force dense.
+        assert fa.flash_routed(32768) is True
