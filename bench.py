@@ -35,6 +35,7 @@ Reported fields:
                  freshness, not its existence.
 """
 
+import functools
 import json
 import os
 import subprocess
@@ -343,7 +344,7 @@ def run_transformer_bench(d_model=512, seq=1024, batch=8, layers=8) -> float:
     import optax
 
     from horovod_tpu.models import (
-        TransformerConfig, transformer_init, transformer_ref_apply,
+        TransformerConfig, transformer_init, transformer_ref_loss,
     )
 
     cfg = TransformerConfig(
@@ -356,14 +357,10 @@ def run_transformer_bench(d_model=512, seq=1024, batch=8, layers=8) -> float:
         jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
     x, y = tokens[:, :-1], tokens[:, 1:]
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, x, y):
         def loss_fn(p):
-            logits, aux = transformer_ref_apply(p, x, cfg)
-            ll = jax.nn.log_softmax(logits.astype(jnp.float32))
-            loss = -jnp.mean(jnp.take_along_axis(
-                ll, y[..., None], axis=-1))
-            return loss + aux
+            return transformer_ref_loss(p, x, y, cfg)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = opt.update(grads, opt_state, params)

@@ -34,6 +34,7 @@ from .transformer import (  # noqa: F401
     transformer_init,
     transformer_pspecs,
     transformer_ref_apply,
+    transformer_ref_loss,
 )
 
 

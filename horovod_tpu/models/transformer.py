@@ -184,9 +184,31 @@ def transformer_ref_apply(params: Dict, tokens, cfg: TransformerConfig):
         else:
             x = _mlp_block(lp, x, cfg, None)
     x = _rmsnorm(params["final_norm"]["scale"], x)
-    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
-                        params["embed"].astype(jnp.float32))
+    # Head matmul in compute_dtype with f32 MXU accumulation: at bf16
+    # this is ~4x the f32 matmul rate on v5e and cost 1/3 of the bench
+    # step before (r04 profile, docs/PERF_NOTES.md); logits come out
+    # f32 either way.
+    logits = jnp.einsum("btd,vd->btv", x.astype(cfg.compute_dtype),
+                        params["embed"].astype(cfg.compute_dtype),
+                        preferred_element_type=jnp.float32)
     return logits, aux_total
+
+
+def transformer_ref_loss(params: Dict, tokens, targets,
+                         cfg: TransformerConfig):
+    """Reference next-token loss: fused cross-entropy (logsumexp minus
+    the picked logit — identical math to log_softmax + gather without
+    materializing the normalized [B, T, V] matrix) plus the weighted
+    MoE aux loss.  The ONE definition the bench, the sharded `_loss`,
+    and the parity tests all share, so they cannot drift apart."""
+    logits, aux = transformer_ref_apply(params, tokens, cfg)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    loss = jnp.mean(lse - picked)
+    if cfg.moe_every:
+        loss = loss + cfg.aux_loss_weight * aux
+    return loss
 
 
 # ---------------------------------------------------------------------------
@@ -272,10 +294,17 @@ def _loss_shard(params, tokens, targets, cfg: TransformerConfig,
     prevents the pp-fold gradient overcount through the tied embedding)."""
     x, aux = _forward_shard(params, tokens, cfg, axes, n_microbatches)
     x = _rmsnorm(params["final_norm"]["scale"], x)
-    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
-                        params["embed"].astype(jnp.float32))
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ce = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    logits = jnp.einsum("btd,vd->btv", x.astype(cfg.compute_dtype),
+                        params["embed"].astype(cfg.compute_dtype),
+                        preferred_element_type=jnp.float32)
+    # Fused cross-entropy: logsumexp - picked logit.  Identical math to
+    # log_softmax + gather but never materializes the normalized
+    # [B, T, V] matrix (a third of the bench step's time before —
+    # r04 profile).
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    ce = lse - picked
 
     batch_axes = [a for a in ("dp", "ep", "sp", "pp") if axes.get(a)]
     local_sum = jnp.sum(ce)

@@ -178,6 +178,12 @@ def full_attention(q, k, v, causal: bool = True, q_offset: int = 0):
     if (fa.flash_routed(q.shape[1]) and q_offset == 0 and
             q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0):
         return fa.flash_attention(q, k, v, causal=causal)
+    # The f32-cast oracle IS the production short-T path: an r04 on-chip
+    # A/B of a bf16-matmul variant (preferred_element_type=f32, bf16
+    # probs) measured 132.4k tok/s vs the oracle's 138.8k on the bench
+    # transformer — XLA fuses the cast+mask+softmax chain better than
+    # the hand-lowered mixed-precision version, so there is no separate
+    # "production" dense kernel to maintain.
     return dense_attention_oracle(q, k, v, causal=causal, q_offset=q_offset)
 
 

@@ -204,14 +204,8 @@ class TestTransformer:
         return tokens[:, :-1], tokens[:, 1:]
 
     def _ref_loss(self, params, cfg, tokens, targets):
-        from horovod_tpu.models import transformer_ref_apply
-        logits, aux = transformer_ref_apply(params, tokens, cfg)
-        logp = jax.nn.log_softmax(logits, -1)
-        ce = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
-        loss = jnp.mean(ce)
-        if cfg.moe_every:
-            loss = loss + cfg.aux_loss_weight * aux
-        return loss
+        from horovod_tpu.models import transformer_ref_loss
+        return transformer_ref_loss(params, tokens, targets, cfg)
 
     @pytest.mark.parametrize("mesh_kw,batch", [
         (dict(dp=8), 8),
