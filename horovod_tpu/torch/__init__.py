@@ -173,6 +173,16 @@ def alltoall(tensor: "torch.Tensor", splits=None,
     return _to_torch(out, tensor)
 
 
+def alltoall_async(tensor: "torch.Tensor", splits=None,
+                   name: Optional[str] = None) -> int:
+    """Async alltoall handle (reference: mpi_ops_v2 alltoall_async);
+    resolve with `synchronize(handle)`."""
+    out = C.alltoall(_to_np(tensor), splits=splits, name=name)
+    if isinstance(out, tuple):
+        out = out[0]
+    return _async_dispatch(out, tensor, inplace=False)
+
+
 def grouped_allreduce(tensors, op=Average, name=None):
     outs = C.grouped_allreduce([_to_np(t) for t in tensors], op=op)
     return [_to_torch(o, t) for o, t in zip(outs, tensors)]
@@ -271,6 +281,18 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     return _bo(obj, root_rank=root_rank)
 
 
+def allgather_object(obj: Any, name: "str | None" = None,
+                     process_set=None) -> list:
+    """Pickle-gather one python object per rank into a list ordered by
+    rank (reference: horovod/torch/functions.py allgather_object —
+    serialize, ragged byte allgather, unpickle).  `name` is accepted
+    for signature parity; compiled SPMD programs need no tensor-name
+    negotiation key."""
+    del name
+    from ..ops.functions import allgather_object as _ao
+    return _ao(obj, process_set=process_set)
+
+
 # ---------------------------------------------------------------------------
 # DistributedOptimizer (reference: horovod/torch/optimizer.py)
 # ---------------------------------------------------------------------------
@@ -294,10 +316,12 @@ class _DistributedOptimizer:
                  named_parameters: Optional[Iterable[Tuple[str, Any]]] = None,
                  compression=Compression.none,
                  backward_passes_per_step: int = 1,
-                 op=Average):
+                 op=Average,
+                 sparse_as_dense: bool = False):
         self._opt = optimizer
         self._compression = compression
         self._op = op
+        self._sparse_as_dense = sparse_as_dense
         self._bpps = max(1, backward_passes_per_step)
         self._pass_count = 0
         self._names = {}
@@ -330,6 +354,16 @@ class _DistributedOptimizer:
         step; overflow dispatches the bucket."""
         if id(p) in self._reduced_ids:
             return
+        if p.grad.is_sparse:
+            # Reference: torch sparse gradients (embedding sparse=True)
+            # ride the dense path only when asked (optimizer.py
+            # sparse_as_dense); there is no sparse wire format.
+            if not self._sparse_as_dense:
+                raise ValueError(
+                    "sparse gradient encountered; construct "
+                    "DistributedOptimizer(..., sparse_as_dense=True) "
+                    "to densify before allreduce")
+            p.grad = p.grad.to_dense()
         self._reduced_ids.add(id(p))
         self._bucket.append(p)
         self._bucket_bytes += p.grad.numel() * p.grad.element_size()
@@ -481,7 +515,8 @@ class _DistributedAdasumOptimizer:
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
-                         op=Average):
+                         op=Average,
+                         sparse_as_dense: bool = False):
     """op=Adasum returns the delta-semantics `_DistributedAdasumOptimizer`
     (reference: horovod/torch/optimizer.py DistributedOptimizer routes
     op=Adasum to _DistributedAdasumOptimizer)."""
@@ -493,7 +528,8 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters,
         compression=compression,
-        backward_passes_per_step=backward_passes_per_step, op=op)
+        backward_passes_per_step=backward_passes_per_step, op=op,
+        sparse_as_dense=sparse_as_dense)
 
 
 class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):

@@ -83,18 +83,23 @@ def main():
     # bridge; two independent processes pay the same core split and
     # isolate the actual collective/bridge cost.
     procs = []
-    for i in (0, 1):
-        e = dict(env)
-        e["HOROVOD_RANK"] = str(i)
-        procs.append(subprocess.Popen(
-            [sys.executable, wpath, "plain"],
-            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=e))
-    for p in procs:
-        _, err = p.communicate(timeout=600)
-        if p.returncode != 0:
-            print(f"plain run failed: {err.decode()[-500:]}",
-                  file=sys.stderr)
-            return 1
+    try:
+        for i in (0, 1):
+            e = dict(env)
+            e["HOROVOD_RANK"] = str(i)
+            procs.append(subprocess.Popen(
+                [sys.executable, wpath, "plain"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=e))
+        for p in procs:
+            _, err = p.communicate(timeout=600)
+            if p.returncode != 0:
+                print(f"plain run failed: {err.decode()[-500:]}",
+                      file=sys.stderr)
+                return 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     plains = [json.load(open(os.path.join(out, f"plain_rank{i}.json")))
               ["img_sec"] for i in (0, 1)]
     plain = sum(plains) / len(plains)
@@ -102,7 +107,7 @@ def main():
     # np=2 distributed.
     r = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
-         "python", wpath, "dist"],
+         sys.executable, wpath, "dist"],
         capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
     if r.returncode != 0:
         print(f"np=2 run failed:\n{r.stdout[-800:]}\n{r.stderr[-800:]}",

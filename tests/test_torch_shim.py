@@ -107,6 +107,13 @@ class TestTorchBroadcastState:
         hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
         assert opt.param_groups[0]["lr"] == pytest.approx(0.1)
 
+    def test_allgather_object(self):
+        # One-process sim: every rank contributes this process's object,
+        # so the gather is size() copies ordered by rank.
+        outs = hvd_torch.allgather_object({"r": hvd_torch.rank()},
+                                          name="ignored")
+        assert outs == [{"r": 0}] * hvd_torch.size()
+
     def test_broadcast_object(self):
         obj = {"epoch": 3, "arr": [1, 2, 3]}
         assert hvd_torch.broadcast_object(obj, root_rank=0) == obj
@@ -556,3 +563,38 @@ class TestTorchElasticState:
             model=model, optimizer=opt, epoch=1)
         state.sync()  # single-host: broadcast from rank 0 is identity
         assert state.epoch == 1
+
+
+class TestTorchSparseAndAsync:
+    def test_sparse_grad_requires_flag(self):
+        emb = torch.nn.Embedding(8, 4, sparse=True)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(emb.parameters(), lr=0.1),
+            named_parameters=emb.named_parameters())
+        loss = emb(torch.tensor([1, 2])).sum()
+        # The reduction hook fires as the sparse grad finalizes, so the
+        # error surfaces from backward() (or step() on hook-less torch).
+        with pytest.raises(ValueError, match="sparse_as_dense"):
+            loss.backward()
+            opt.step()
+
+    def test_sparse_as_dense_trains(self):
+        emb = torch.nn.Embedding(8, 4, sparse=True)
+        before = emb.weight.detach().clone()
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(emb.parameters(), lr=0.1),
+            named_parameters=emb.named_parameters(),
+            sparse_as_dense=True)
+        loss = emb(torch.tensor([1, 2])).sum()
+        loss.backward()
+        opt.step()
+        assert not torch.equal(emb.weight.detach(), before)
+        assert not emb.weight.grad.is_sparse
+
+    def test_alltoall_async(self):
+        t = torch.arange(8, dtype=torch.float32)
+        h = hvd_torch.alltoall_async(t)
+        out = hvd_torch.synchronize(h)
+        # Must agree with the synchronous op (in the sim, rank 0
+        # receives every rank's slice 0).
+        assert torch.equal(out, hvd_torch.alltoall(t))
