@@ -41,6 +41,10 @@ class HorovodEstimator(EstimatorParams):
             raise HorovodTpuError(
                 f"{type(self).__name__}: feature_cols and label_cols are "
                 "required")
+        # Cheap framework-specific validation BEFORE prepare_data shards
+        # the dataset into the store — a bad param must not leave
+        # dataset-sized scratch behind.
+        self._validate_params()
         store = self.store or Store.create(None)
         # Expose an auto-created store so the caller can locate the
         # run's checkpoint/artifacts after fit().
@@ -57,8 +61,10 @@ class HorovodEstimator(EstimatorParams):
             validation=self.validation, shuffle=self.shuffle,
             seed=self.random_seed)
 
-        spec = self._build_spec(store, run_id, meta)
         try:
+            # Inside the try: a serialization failure must still clean
+            # up the freshly-written shards.
+            spec = self._build_spec(store, run_id, meta)
             results = backend.run(self._remote_trainer(), args=(spec,),
                                   np=num_proc)
         finally:
@@ -116,16 +122,6 @@ class HorovodEstimator(EstimatorParams):
 
     def _build_spec(self, store: Store, run_id: str,
                     meta: Dict[str, int]) -> Dict[str, Any]:
-        if self.compression not in VALID_COMPRESSION:
-            raise HorovodTpuError(
-                f"compression must be one of "
-                f"{[c for c in VALID_COMPRESSION if c]}, got "
-                f"{self.compression!r}")
-        if not isinstance(self.backward_passes_per_step, int) or \
-                self.backward_passes_per_step < 1:
-            raise HorovodTpuError(
-                f"backward_passes_per_step must be an int >= 1, got "
-                f"{self.backward_passes_per_step!r}")
         return {
             "compression": self.compression,
             "backward_passes_per_step": self.backward_passes_per_step,
@@ -142,6 +138,21 @@ class HorovodEstimator(EstimatorParams):
             "callbacks": self.callbacks,
             "meta": meta,
         }
+
+    def _validate_params(self) -> None:
+        """Fail-fast checks; run at the top of `fit`, before any data
+        materialization.  Subclasses add framework-specific checks and
+        call `super()._validate_params()` for these common ones."""
+        if self.compression not in VALID_COMPRESSION:
+            raise HorovodTpuError(
+                f"compression must be one of "
+                f"{[c for c in VALID_COMPRESSION if c]}, got "
+                f"{self.compression!r}")
+        if not isinstance(self.backward_passes_per_step, int) or \
+                self.backward_passes_per_step < 1:
+            raise HorovodTpuError(
+                f"backward_passes_per_step must be an int >= 1, got "
+                f"{self.backward_passes_per_step!r}")
 
     def _remote_trainer(self):
         raise NotImplementedError

@@ -162,13 +162,16 @@ class KerasEstimator(HorovodEstimator):
 
     _params = dict(HorovodEstimator._params, output_cols=None)
 
+    def _validate_params(self) -> None:
+        if self.optimizer is None or self.loss is None:
+            raise HorovodTpuError(
+                "KerasEstimator: optimizer and loss are required")
+        super()._validate_params()
+
     def _remote_trainer(self):
         return _keras_remote_trainer
 
     def _serialize_model(self) -> bytes:
-        if self.optimizer is None or self.loss is None:
-            raise HorovodTpuError(
-                "KerasEstimator: optimizer and loss are required")
         return _serialize_keras(self.model, self.optimizer, self.loss,
                                 self.metrics, self.custom_objects)
 

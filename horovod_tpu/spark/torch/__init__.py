@@ -25,9 +25,7 @@ import numpy as np
 
 from ...common.exceptions import HorovodTpuError
 from ..common.estimator import HorovodEstimator, HorovodModel
-from ..common.store import save_checkpoint
-from ..common.data_loader import ShardDataLoader
-from ..common.util import load_val, resolve_compression
+from ._worker import init_worker, run_worker
 
 
 def _optimizer_recipe(optimizer):
@@ -91,78 +89,21 @@ def _build_optimizer(recipe, model):
 
 
 def _torch_remote_trainer(spec: Dict[str, Any]):
-    """Per-worker training fn (reference: torch/remote.py)."""
+    """Per-worker training fn (reference: torch/remote.py).  The epoch
+    loop lives in `_worker.run_worker`, shared with the lightning
+    estimator; only the loss computation is supplied here."""
     import torch
 
-    import horovod_tpu.torch as hvd_t
-
-    hvd_t.init()
-    if spec["seed"] is not None:
-        torch.manual_seed(spec["seed"] + hvd_t.rank())
-
+    hvd_t = init_worker(spec)
     payload = pickle.loads(spec["model_bytes"])
     model = torch.load(io.BytesIO(payload["model"]), weights_only=False)
     loss_fn = payload["loss"]
     opt = _build_optimizer(payload["opt_recipe"], model)
 
-    hvd_t.broadcast_parameters(model.state_dict(), root_rank=0)
-    hvd_t.broadcast_optimizer_state(opt, root_rank=0)
-    comp = resolve_compression(hvd_t, spec.get("compression"))
-    dist_opt = hvd_t.DistributedOptimizer(
-        opt, named_parameters=model.named_parameters(), compression=comp,
-        backward_passes_per_step=spec["backward_passes_per_step"])
-
-    def _label_tensor(arr):
-        t = torch.from_numpy(np.ascontiguousarray(arr))
-        # Integer single-column labels → 1-D Long targets, the shape
-        # torch classification losses (cross_entropy/nll) expect.
-        if t.dtype in (torch.int64, torch.int32) and t.shape[1] == 1:
-            return t[:, 0].long()
-        return t
-
-    # Memory-mapped minibatch iteration (reference: data_loaders/ over
-    # Petastorm).  prepare_data guarantees equal shard sizes, so every
-    # rank sees the same batch count (collectives stay in lockstep);
-    # drop_last=False keeps the partial final batch training.
-    loader = ShardDataLoader(
-        spec["train_dir"], hvd_t.rank(), spec["batch_size"],
-        shuffle=spec["shuffle"], seed=spec["seed"], drop_last=False)
-    val = None
-    # Only rank 0 reports history, so only it loads/evaluates val data
-    # (keras differs: its MetricAverageCallback allreduces val metrics,
-    # so every keras worker needs the val set).
-    if spec["val_dir"] and hvd_t.rank() == 0:
-        xv, yv = load_val(spec["val_dir"])
-        val = (torch.from_numpy(np.ascontiguousarray(xv)),
-               _label_tensor(yv))
-    losses, val_losses = [], []
-    for epoch in range(spec["epochs"]):
-        epoch_loss, batches = 0.0, 0
-        model.train()
-        for xb, yb in loader.epoch(epoch):
-            dist_opt.zero_grad()
-            out = model(torch.from_numpy(xb))
-            loss = loss_fn(out, _label_tensor(yb))
-            loss.backward()
-            dist_opt.step()
-            epoch_loss += float(loss.detach())
-            batches += 1
-        avg = epoch_loss / max(1, batches)
-        # Cross-rank epoch metric, like the reference's metric averaging.
-        avg = float(hvd_t.allreduce(torch.tensor([avg]), name="epoch_loss"))
-        losses.append(avg)
-        if val is not None:  # rank 0 only — see the load site above
-            model.eval()
-            with torch.no_grad():
-                val_losses.append(float(loss_fn(model(val[0]), val[1])))
-
-    if hvd_t.rank() != 0:
-        return None  # only rank 0 ships the trained model back
-    save_checkpoint(spec["run_path"], {"state_dict": model.state_dict()})
-    buf = io.BytesIO()
-    torch.save(model, buf)
-    return {"model": buf.getvalue(),
-            "history": {"loss": losses, "val_loss": val_losses}}
+    return run_worker(
+        spec, hvd_t, model, opt,
+        train_step=lambda batch, i: loss_fn(model(batch[0]), batch[1]),
+        val_step=lambda val: loss_fn(model(val[0]), val[1]))
 
 
 class TorchModel(HorovodModel):
@@ -206,12 +147,7 @@ class TorchEstimator(HorovodEstimator):
 
     _params = dict(HorovodEstimator._params, output_cols=None)
 
-    def _remote_trainer(self):
-        return _torch_remote_trainer
-
-    def _serialize_model(self) -> bytes:
-        import torch
-
+    def _validate_params(self) -> None:
         if self.loss is None:
             raise HorovodTpuError("TorchEstimator: loss is required")
         if self.callbacks:
@@ -219,6 +155,15 @@ class TorchEstimator(HorovodEstimator):
                 "TorchEstimator does not take callbacks (a Keras-style "
                 "API); use KerasEstimator or drive the loop via "
                 "horovod_tpu.spark.run")
+        _optimizer_recipe(self.optimizer)  # type check, fail fast
+        super()._validate_params()
+
+    def _remote_trainer(self):
+        return _torch_remote_trainer
+
+    def _serialize_model(self) -> bytes:
+        import torch
+
         buf = io.BytesIO()
         torch.save(self.model, buf)
         return pickle.dumps({

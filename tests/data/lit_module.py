@@ -1,0 +1,58 @@
+"""Duck-typed LightningModule for the LightningEstimator tests.
+
+Implements the exact contract `horovod_tpu.spark.lightning` drives
+(training_step / configure_optimizers / validation_step / epoch hooks)
+on a plain torch Module — what a real pl.LightningModule exposes,
+without requiring pytorch_lightning in the image.  Lives in its own
+importable file because the fitted module pickles by class reference
+and must deserialize inside spawned worker processes.
+"""
+
+import torch
+
+
+class LitRegression(torch.nn.Module):
+    def __init__(self, lr=0.1):
+        super().__init__()
+        self.net = torch.nn.Linear(2, 1)
+        self.lr = lr
+        self.epoch_starts = 0
+        self.epoch_ends = 0
+
+    def forward(self, x):
+        return self.net(x)
+
+    def configure_optimizers(self):
+        return torch.optim.SGD(self.parameters(), lr=self.lr)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        return {"loss": torch.nn.functional.mse_loss(self(x), y)}
+
+    def validation_step(self, batch, batch_idx):
+        x, y = batch
+        return torch.nn.functional.mse_loss(self(x), y)
+
+    def on_train_epoch_start(self):
+        self.epoch_starts += 1
+
+    def on_train_epoch_end(self):
+        self.epoch_ends += 1
+
+
+class LitTupleConfig(LitRegression):
+    """configure_optimizers returning the ([opts], [scheds]) form."""
+
+    def configure_optimizers(self):
+        opt = torch.optim.SGD(self.parameters(), lr=self.lr)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1,
+                                                gamma=0.9)
+        return [opt], [sched]
+
+
+class LitMultiOpt(LitRegression):
+    """Unsupported GAN-style multi-optimizer config."""
+
+    def configure_optimizers(self):
+        return [torch.optim.SGD(self.parameters(), lr=self.lr),
+                torch.optim.SGD(self.parameters(), lr=self.lr)]

@@ -520,6 +520,24 @@ class TestEstimatorValidation:
         with pytest.raises(HorovodTpuError, match="compression must be"):
             est.fit(make_df(8))
 
+    def test_validation_precedes_data_prep(self, tmp_path):
+        # A bad-param fit must fail BEFORE prepare_data, leaving no
+        # dataset-sized shard scratch in the store.
+        import torch
+
+        from horovod_tpu.spark.torch import TorchEstimator
+
+        store = Store.create(str(tmp_path))
+        net = torch.nn.Linear(2, 1)
+        est = TorchEstimator(model=net, optimizer="sgd",
+                             loss=torch.nn.functional.mse_loss,
+                             feature_cols=["x1", "x2"], label_cols=["y"],
+                             store=store, run_id="leakcheck",
+                             backend=LocalBackend(1))
+        with pytest.raises(HorovodTpuError, match="optimizer must be"):
+            est.fit(make_df(16))
+        assert not os.path.exists(store.get_train_data_path("leakcheck"))
+
     def test_bad_torch_optimizer_raises(self):
         import torch
 
@@ -613,3 +631,183 @@ class TestKerasEstimatorFit:
         assert err < 0.5, f"mse {err}"
 
         assert os.path.exists(est.store.get_checkpoint_path("kerasrun"))
+
+
+# ---------------------------------------------------------------------------
+# Lightning estimator (duck-typed LightningModule contract)
+# ---------------------------------------------------------------------------
+
+def _lit_import():
+    import sys
+
+    data_dir = os.path.join(os.path.dirname(__file__), "data")
+    if data_dir not in sys.path:
+        sys.path.insert(0, data_dir)
+    import lit_module
+
+    return data_dir, lit_module
+
+
+class TestLightningValidation:
+    def test_contract_violation_raises(self):
+        import torch
+
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        est = LightningEstimator(model=torch.nn.Linear(2, 1),
+                                 feature_cols=["x1"], label_cols=["y"],
+                                 backend=LocalBackend(1))
+        with pytest.raises(HorovodTpuError, match="LightningModule"):
+            est.fit(make_df(8))
+
+    def test_loss_param_rejected(self):
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        _, lit = _lit_import()
+        est = LightningEstimator(model=lit.LitRegression(),
+                                 loss="mse",
+                                 feature_cols=["x1"], label_cols=["y"],
+                                 backend=LocalBackend(1))
+        with pytest.raises(HorovodTpuError, match="come from the"):
+            est.fit(make_df(8))
+
+    def test_single_optimizer_forms(self):
+        import torch
+
+        from horovod_tpu.spark.lightning import _single_optimizer
+
+        _, lit = _lit_import()
+        m = lit.LitRegression()
+        opt = torch.optim.SGD(m.parameters(), lr=0.1)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1)
+
+        assert _single_optimizer(opt) == (opt, [])
+        assert _single_optimizer([opt]) == (opt, [])
+        assert _single_optimizer(([opt], [sched])) == (opt, [sched])
+        assert _single_optimizer(
+            {"optimizer": opt, "lr_scheduler": {"scheduler": sched,
+                                                "interval": "epoch"}}
+        ) == (opt, [sched])
+        with pytest.raises(HorovodTpuError, match="single-optimizer"):
+            _single_optimizer(([opt, opt], []))
+        # The bare GAN form `return opt_g, opt_d` is a 2-tuple of
+        # optimizers, not ([opts], [scheds]) — explicit rejection, not
+        # a TypeError.
+        opt2 = torch.optim.SGD(m.parameters(), lr=0.1)
+        with pytest.raises(HorovodTpuError, match="single-optimizer"):
+            _single_optimizer((opt, opt2))
+        # Non-epoch scheduler cadence is refused, never approximated.
+        with pytest.raises(HorovodTpuError, match="once per epoch"):
+            _single_optimizer({"optimizer": opt,
+                               "lr_scheduler": {"scheduler": sched,
+                                                "interval": "step"}})
+        # Malformed dicts get explicit rejections, not KeyErrors.
+        with pytest.raises(HorovodTpuError, match="'optimizer' key"):
+            _single_optimizer({"lr_scheduler": {"scheduler": sched}})
+        with pytest.raises(HorovodTpuError, match="'scheduler' key"):
+            _single_optimizer({"optimizer": opt,
+                               "lr_scheduler": {"interval": "epoch"}})
+
+    def test_multi_opt_module_fails_on_driver(self, tmp_path):
+        # Unsupported configs are rejected driver-side, BEFORE data prep.
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        _, lit = _lit_import()
+        store = Store.create(str(tmp_path))
+        est = LightningEstimator(model=lit.LitMultiOpt(),
+                                 feature_cols=["x1"], label_cols=["y"],
+                                 store=store, run_id="multiopt",
+                                 backend=LocalBackend(1))
+        with pytest.raises(HorovodTpuError, match="single-optimizer"):
+            est.fit(make_df(8))
+        assert not os.path.exists(store.get_train_data_path("multiopt"))
+
+    def test_callbacks_rejected(self):
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        _, lit = _lit_import()
+        est = LightningEstimator(model=lit.LitRegression(),
+                                 callbacks=[object()],
+                                 feature_cols=["x1"], label_cols=["y"],
+                                 backend=LocalBackend(1))
+        with pytest.raises(HorovodTpuError, match="does not take callbacks"):
+            est.fit(make_df(8))
+
+    def test_multi_optimizer_module_raises(self):
+        from horovod_tpu.spark.lightning import _single_optimizer
+
+        _, lit = _lit_import()
+        with pytest.raises(HorovodTpuError, match="single-optimizer"):
+            _single_optimizer(lit.LitMultiOpt().configure_optimizers())
+
+    def test_step_loss_forms(self):
+        import torch
+
+        from horovod_tpu.spark.lightning import _step_loss
+
+        t = torch.tensor(1.0)
+        assert _step_loss(t) is t
+        assert _step_loss({"loss": t, "log": {}}) is t
+        with pytest.raises(HorovodTpuError, match="loss"):
+            _step_loss({"log": {}})
+
+
+@pytest.mark.integration
+class TestLightningEstimatorFit:
+    def test_fit_transform_2proc(self, tmp_path, monkeypatch):
+        import torch
+
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        data_dir, lit = _lit_import()
+        # The fitted module pickles by class reference; workers must be
+        # able to import lit_module (they inherit the environment).
+        monkeypatch.setenv(
+            "PYTHONPATH",
+            data_dir + os.pathsep + os.environ.get("PYTHONPATH", ""))
+        torch.manual_seed(0)
+        df = make_df(64)
+        est = LightningEstimator(
+            model=lit.LitRegression(lr=0.1),
+            feature_cols=["x1", "x2"], label_cols=["y"],
+            batch_size=16, epochs=8, validation=0.2, random_seed=0,
+            store=Store.create(str(tmp_path)), run_id="litrun",
+            backend=LocalBackend(2), verbose=0)
+        model = est.fit(df)
+
+        hist = model.get_history()
+        assert len(hist["loss"]) == 8
+        assert hist["loss"][-1] < hist["loss"][0]
+        assert len(hist["val_loss"]) == 8
+
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        preds = np.asarray([float(np.ravel(v)[0])
+                            for v in out["prediction"]])
+        err = np.mean((preds - df["y"].to_numpy()) ** 2)
+        assert err < 0.5, f"mse {err}"
+
+        assert os.path.exists(est.store.get_checkpoint_path("litrun"))
+
+        # The returned module is the trained rank-0 instance: the epoch
+        # hooks ran once per epoch.
+        m = model.getModel()
+        assert m.epoch_starts == 8 and m.epoch_ends == 8
+
+    def test_scheduler_config_1proc(self, tmp_path, monkeypatch):
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        data_dir, lit = _lit_import()
+        monkeypatch.setenv(
+            "PYTHONPATH",
+            data_dir + os.pathsep + os.environ.get("PYTHONPATH", ""))
+        est = LightningEstimator(
+            model=lit.LitTupleConfig(lr=0.1),
+            feature_cols=["x1", "x2"], label_cols=["y"],
+            batch_size=16, epochs=3, random_seed=0,
+            store=Store.create(str(tmp_path)), run_id="litsched",
+            backend=LocalBackend(1), verbose=0)
+        model = est.fit(make_df(48))
+        hist = model.get_history()
+        assert len(hist["loss"]) == 3
+        assert hist["loss"][-1] < hist["loss"][0]
