@@ -1,0 +1,144 @@
+"""Autotune demo: the GP/EI parameter manager driving live throughput.
+
+Reference parity: `horovodrun --autotune` tunes the fusion threshold and
+cycle time from online throughput samples
+(`horovod/common/parameter_manager.cc`, `optim/bayesian_optimization.cc`).
+In this framework the fusion-threshold knob is live-wired the same way
+(`utils/autotune.py init_from_env` + `parallel/data_parallel.py`), and is
+integration-tested on the simulated multi-rank mesh — but fusion only
+matters when there ARE cross-rank collectives.  On a single chip the
+honest demonstration of the same machinery is a knob whose effect is
+measurable there: this script lets the ParameterManager search the
+per-chip batch size of the ResNet synthetic step for maximum img/s,
+converging toward the plateau the hand sweep found (batch ~128-256 on
+v5e, docs/PERF_NOTES.md).
+
+Each proposal triggers a retrace/recompile — exactly the cost profile
+the real fusion knob has (`on_change` → program-cache invalidation), so
+the demo exercises the full loop: propose → recompile → measure →
+observe → freeze at best.
+
+Run:  python examples/autotune_demo.py                 # real chip
+      python examples/autotune_demo.py --tiny          # CPU smoke run
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import zoo_apply, zoo_init
+from horovod_tpu.utils.autotune import ParameterManager
+
+
+def snap(b: int, quantum: int = 32) -> int:
+    """MXU-friendly batch: multiples of 32 (sublane x lane tiling); also
+    collapses nearby GP proposals onto one compiled program."""
+    return max(quantum, int(round(b / quantum)) * quantum)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--low", type=int, default=32)
+    p.add_argument("--high", type=int, default=512)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--steps-per-sample", type=int, default=5)
+    p.add_argument("--max-samples", type=int, default=10)
+    p.add_argument("--warmup-samples", type=int, default=1)
+    p.add_argument("--log-file", default=None,
+                   help="CSV log (the HOROVOD_AUTOTUNE_LOG format)")
+    p.add_argument("--tiny", action="store_true",
+                   help="mnist-scale smoke config for CPU runs/tests")
+    args = p.parse_args()
+    if args.tiny:
+        args.model = "resnet18"
+        args.image_size = 32
+        args.low, args.high = 8, 64
+        args.steps_per_sample = 2
+        args.max_samples = 3
+        args.warmup_samples = 1
+
+    hvd.init()
+    num_classes = 10 if args.tiny else 1000
+    v = zoo_init(args.model, jax.random.PRNGKey(0),
+                 num_classes=num_classes)
+    model_apply = zoo_apply(args.model)
+    cfg = v["config"]
+    opt = optax.sgd(0.01, momentum=0.9)
+
+    def make_step():
+        @jax.jit
+        def step(params, batch_stats, opt_state, xb, yb):
+            def loss_fn(p):
+                logits, ns = model_apply(
+                    {"params": p, "batch_stats": batch_stats,
+                     "config": cfg},
+                    xb, train=True, compute_dtype=jnp.bfloat16)
+                onehot = jax.nn.one_hot(yb, num_classes)
+                loss = -jnp.mean(
+                    jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+                return loss, ns
+
+            (loss, ns), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state2 = opt.update(updates=grads,
+                                             state=opt_state,
+                                             params=params)
+            params2 = optax.apply_updates(params, updates)
+            return params2, ns, opt_state2, loss
+
+        return step
+
+    step = make_step()
+    rng = np.random.default_rng(0)
+    chan = 3
+
+    def measure(batch: int) -> float:
+        """img/s of `steps_per_sample` steps at this batch (jit cache
+        makes repeat visits to a batch size compile-free)."""
+        x = jnp.asarray(rng.random(
+            (batch, args.image_size, args.image_size, chan),
+            dtype=np.float32))
+        y = jnp.asarray(rng.integers(0, num_classes, size=batch))
+        params, bs = v["params"], v["batch_stats"]
+        opt_state = opt.init(params)
+        # one untimed step: compile + warm caches for this shape
+        params, bs, opt_state, loss = step(params, bs, opt_state, x, y)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(args.steps_per_sample):
+            params, bs, opt_state, loss = step(params, bs, opt_state, x, y)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        return batch * args.steps_per_sample / dt
+
+    pm = ParameterManager(warmup_samples=args.warmup_samples,
+                          steps_per_sample=1,  # we report whole samples
+                          max_samples=args.max_samples,
+                          log_file=args.log_file)
+    pm.register("batch", args.low, args.high, log_scale=True,
+                integer=True, initial=snap((args.low + args.high) // 4))
+
+    history = []
+    while not pm.frozen:
+        b = snap(int(pm.value("batch")), 8 if args.tiny else 32)
+        rate = measure(b)
+        history.append((b, rate))
+        print(f"sample {len(history):2d}: batch {b:4d} -> "
+              f"{rate:8.1f} img/s", flush=True)
+        pm.record_sample(rate)
+
+    best_b, best_rate = max(history, key=lambda h: h[1])
+    print(f"frozen: manager value {int(pm.value('batch'))} "
+          f"(snapped {snap(int(pm.value('batch')), 8 if args.tiny else 32)}); "
+          f"best measured batch {best_b} at {best_rate:.1f} img/s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,8 +35,7 @@ import io
 from typing import Any, Dict
 
 from ...common.exceptions import HorovodTpuError
-from ..common.estimator import HorovodEstimator
-from ..torch import TorchModel
+from ..torch import TorchFamilyEstimator, TorchModel
 from ..torch._worker import init_worker, run_worker
 
 _CONTRACT = ("training_step", "configure_optimizers")
@@ -81,7 +80,9 @@ def _single_optimizer(cfg):
     ``step()`` them.
     """
     scheds: list = []
-    if (isinstance(cfg, tuple) and len(cfg) == 2
+    # Lightning's "two lists" form ([opts], [scheds]) — accepted as a
+    # tuple OR a list of two list/tuples (both are valid upstream).
+    if (isinstance(cfg, (tuple, list)) and len(cfg) == 2
             and all(isinstance(c, (list, tuple)) for c in cfg)):
         opts, scheds = list(cfg[0]), list(cfg[1])
     elif isinstance(cfg, dict):
@@ -149,7 +150,7 @@ class LightningModel(TorchModel):
     IS a torch module."""
 
 
-class LightningEstimator(HorovodEstimator):
+class LightningEstimator(TorchFamilyEstimator):
     """Distributed LightningModule estimator (reference:
     lightning/estimator.py `LightningEstimator`).
 
@@ -163,7 +164,7 @@ class LightningEstimator(HorovodEstimator):
     rejected to match the Lightning division of labor.
     """
 
-    _params = dict(HorovodEstimator._params, output_cols=None)
+    _model_cls = LightningModel
 
     def _validate_params(self) -> None:
         if self.loss is not None or self.optimizer is not None:
@@ -181,6 +182,12 @@ class LightningEstimator(HorovodEstimator):
         # Driver-side rejection of unsupported optimizer configs — the
         # workers would otherwise all fail after data prep.
         _single_optimizer(self.model.configure_optimizers())
+        if self.validation and not callable(
+                getattr(self.model, "validation_step", None)):
+            raise HorovodTpuError(
+                "LightningEstimator: validation is set but the module "
+                "has no validation_step — the val split would be carved "
+                "out of training and never evaluated")
         super()._validate_params()
 
     def _remote_trainer(self):
@@ -192,13 +199,6 @@ class LightningEstimator(HorovodEstimator):
         buf = io.BytesIO()
         torch.save(self.model, buf)
         return buf.getvalue()
-
-    def _make_model(self, result, meta, store, run_id) -> LightningModel:
-        return LightningModel(
-            _model_bytes=result["model"],
-            feature_cols=self.feature_cols,
-            output_cols=self.output_cols or ["prediction"],
-            history=result["history"], run_id=run_id)
 
 
 __all__ = ["LightningEstimator", "LightningModel"]

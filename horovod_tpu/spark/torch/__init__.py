@@ -133,7 +133,23 @@ class TorchModel(HorovodModel):
         return out.numpy()
 
 
-class TorchEstimator(HorovodEstimator):
+class TorchFamilyEstimator(HorovodEstimator):
+    """Shared base for estimators whose fitted model is a torch module
+    shipped back as `torch.save` bytes (torch + lightning): the
+    `_make_model` wiring is identical, parameterized by `_model_cls`."""
+
+    _params = dict(HorovodEstimator._params, output_cols=None)
+    _model_cls: type = None  # set by subclasses
+
+    def _make_model(self, result, meta, store, run_id):
+        return self._model_cls(
+            _model_bytes=result["model"],
+            feature_cols=self.feature_cols,
+            output_cols=self.output_cols or ["prediction"],
+            history=result["history"], run_id=run_id)
+
+
+class TorchEstimator(TorchFamilyEstimator):
     """Distributed torch estimator (reference: torch/estimator.py
     `TorchEstimator`).
 
@@ -144,8 +160,6 @@ class TorchEstimator(HorovodEstimator):
                              epochs=3, num_proc=2)
         torch_model = est.fit(df)
     """
-
-    _params = dict(HorovodEstimator._params, output_cols=None)
 
     def _validate_params(self) -> None:
         if self.loss is None:
@@ -172,12 +186,7 @@ class TorchEstimator(HorovodEstimator):
             "opt_recipe": _optimizer_recipe(self.optimizer),
         })
 
-    def _make_model(self, result, meta, store, run_id) -> TorchModel:
-        return TorchModel(
-            _model_bytes=result["model"],
-            feature_cols=self.feature_cols,
-            output_cols=self.output_cols or ["prediction"],
-            history=result["history"], run_id=run_id)
 
+TorchEstimator._model_cls = TorchModel
 
-__all__ = ["TorchEstimator", "TorchModel"]
+__all__ = ["TorchEstimator", "TorchModel", "TorchFamilyEstimator"]

@@ -1,0 +1,98 @@
+"""Megastep: fuse k training steps into one compiled XLA program.
+
+TPU-first extension (no reference analog — upstream Horovod dispatches
+one framework op per step by construction).  Under jit, one dispatch
+carries fixed host->device latency; at small step times that latency is
+a visible fraction of wall clock (the r04 device trace measured ~13 ms
+of per-step dispatch tail on a 46 ms-busy transformer step through a
+remote PJRT link).  `lax.scan` over the step body amortizes it k-fold,
+and XLA still overlaps the per-iteration collectives exactly as it does
+for a single step.
+
+Contract: ``step_fn(carry, batch) -> (carry, out)`` where `carry` is
+any pytree (typically ``(train_state, opt_state)``).  Two drivers:
+
+  - `repeat_steps(step_fn, k)`: the SAME batch every iteration —
+    synthetic-benchmark methodology (resident batch, reference:
+    pytorch_synthetic_benchmark.py timing loops);
+  - `scan_steps(step_fn, k)`: batches stacked on a leading [k, ...]
+    axis — real input pipelines, pairing with `utils/prefetch.py`
+    (stage k batches, run one fused program per k).
+
+Both return a jitted callable with the carry donated (in-place update,
+no per-call state copy).  Only the last `out` is returned
+(`out_mode="last"`) or all k stacked (`out_mode="all"`).
+
+jit caveat: like any jitted step, the fused program bakes tunables read
+at trace time; rebuild after the autotuner freezes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+from jax import lax
+
+from ..common.exceptions import HorovodTpuError
+
+
+def _check(k: int, out_mode: str) -> None:
+    if not isinstance(k, int) or k < 1:
+        raise HorovodTpuError(f"megastep: k must be an int >= 1, got {k!r}")
+    if out_mode not in ("last", "all"):
+        raise HorovodTpuError(
+            f"megastep: out_mode must be 'last' or 'all', got {out_mode!r}")
+
+
+def repeat_body(step_fn: Callable[[Any, Any], Tuple[Any, Any]],
+                k: int, out_mode: str = "last") -> Callable:
+    """Unjitted `fn(carry, batch)` scanning `step_fn` k times over the
+    SAME batch.  Compose with any outer compiler — `jax.jit`,
+    `hvd.data_parallel(..., batch_args=(1,), donate_args=(0,))`, or a
+    user shard_map (`data_parallel` is a host-side dispatcher, so the
+    scan must sit inside it, not around it)."""
+    _check(k, out_mode)
+
+    def many(carry, batch):
+        def body(c, _):
+            c2, out = step_fn(c, batch)
+            return c2, out
+
+        carry2, outs = lax.scan(body, carry, None, length=k)
+        return carry2, (outs if out_mode == "all"
+                        else jax.tree.map(lambda o: o[-1], outs))
+
+    return many
+
+
+def scan_body(step_fn: Callable[[Any, Any], Tuple[Any, Any]],
+              k: int, out_mode: str = "last") -> Callable:
+    """Unjitted `fn(carry, batches)` consuming batches stacked on a
+    leading [k, ...] axis, one `step_fn` call per slice."""
+    _check(k, out_mode)
+
+    def many(carry, batches):
+        carry2, outs = lax.scan(step_fn, carry, batches, length=k)
+        return carry2, (outs if out_mode == "all"
+                        else jax.tree.map(lambda o: o[-1], outs))
+
+    return many
+
+
+def repeat_steps(step_fn: Callable[[Any, Any], Tuple[Any, Any]],
+                 k: int, out_mode: str = "last") -> Callable:
+    """Jitted `repeat_body` with the carry donated (in-place update)."""
+    return partial(jax.jit, donate_argnums=(0,))(
+        repeat_body(step_fn, k, out_mode))
+
+
+def scan_steps(step_fn: Callable[[Any, Any], Tuple[Any, Any]],
+               k: int, out_mode: str = "last") -> Callable:
+    """Jitted `scan_body` with the carry donated (in-place update)."""
+    return partial(jax.jit, donate_argnums=(0,))(
+        scan_body(step_fn, k, out_mode))
+
+
+__all__ = ["repeat_body", "scan_body", "repeat_steps", "scan_steps"]

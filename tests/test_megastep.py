@@ -1,0 +1,117 @@
+"""Megastep (utils/megastep.py): k fused steps == k looped steps."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.utils.megastep import repeat_steps, scan_steps
+
+
+def sgd_step(carry, batch):
+    """Tiny linear-regression SGD step: carry = (w, b)."""
+    w, b = carry
+    x, y = batch
+
+    def loss_fn(w, b):
+        pred = x @ w + b
+        return jnp.mean((pred - y) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(w, b)
+    return (w - 0.1 * grads[0], b - 0.1 * grads[1]), loss
+
+
+def _data(seed=0, n=32):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5], np.float32) + 0.3).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+class TestRepeatSteps:
+    def test_matches_python_loop(self):
+        batch = _data()
+        carry = (jnp.zeros((3,), jnp.float32), jnp.zeros((), jnp.float32))
+        ref = carry
+        for _ in range(5):
+            ref, ref_loss = sgd_step(ref, batch)
+
+        fused = repeat_steps(sgd_step, 5)
+        out_carry, loss = fused(carry, batch)
+        np.testing.assert_allclose(out_carry[0], ref[0], rtol=1e-5)
+        np.testing.assert_allclose(out_carry[1], ref[1], rtol=1e-5)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+
+    def test_all_mode_stacks_outputs(self):
+        batch = _data()
+        carry = (jnp.zeros((3,), jnp.float32), jnp.zeros((), jnp.float32))
+        fused = repeat_steps(sgd_step, 4, out_mode="all")
+        _, losses = fused(carry, batch)
+        assert losses.shape == (4,)
+        # SGD on a convex problem: monotone decrease across the scan.
+        assert float(losses[-1]) < float(losses[0])
+
+    def test_bad_args(self):
+        with pytest.raises(HorovodTpuError, match="k must be"):
+            repeat_steps(sgd_step, 0)
+        with pytest.raises(HorovodTpuError, match="out_mode"):
+            repeat_steps(sgd_step, 2, out_mode="sum")
+
+
+class TestScanSteps:
+    def test_consumes_stacked_batches(self):
+        x, y = _data(n=40)
+        xs = x.reshape(5, 8, 3)
+        ys = y.reshape(5, 8)
+        carry = (jnp.zeros((3,), jnp.float32), jnp.zeros((), jnp.float32))
+        ref = carry
+        for i in range(5):
+            ref, ref_loss = sgd_step(ref, (xs[i], ys[i]))
+
+        fused = scan_steps(sgd_step, 5)
+        out_carry, loss = fused(carry, (xs, ys))
+        np.testing.assert_allclose(out_carry[0], ref[0], rtol=1e-5)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+
+    def test_distributed_step_under_megastep(self):
+        """The scan body can contain cross-rank collectives: a
+        data-parallel step (in-step gradient allreduce) fused 3x inside
+        `hvd.data_parallel` — the scan sits INSIDE the SPMD program."""
+        import horovod_tpu as hvd
+        from horovod_tpu.utils.megastep import repeat_body
+
+        hvd.init()
+        if hvd.size() == 1:
+            pytest.skip("needs the simulated multi-device mesh")
+
+        def dist_step(carry, batch):
+            w, b = carry
+            x, y = batch
+
+            def loss_fn(w, b):
+                return jnp.mean((x @ w + b - y) ** 2)
+
+            loss, grads = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(w, b)
+            grads = hvd.allreduce(grads)
+            loss = hvd.allreduce(loss)
+            return (w - 0.1 * grads[0], b - 0.1 * grads[1]), loss
+
+        dp = hvd.data_parallel(repeat_body(dist_step, 3),
+                               batch_args=(1,), donate_args=())
+        x, y = _data(n=8 * hvd.size())
+        carry = (jnp.zeros((3,), jnp.float32), jnp.zeros((), jnp.float32))
+        out_carry, loss = dp(carry, hvd.shard_batch((x, y)))
+        assert np.isfinite(float(loss))
+
+        # Equivalent to 3 sequential distributed steps on the full batch.
+        ref = (jnp.zeros((3,), jnp.float32), jnp.zeros((), jnp.float32))
+        for _ in range(3):
+            w, b = ref
+            def loss_fn(w, b):
+                return jnp.mean((x @ w + b - y) ** 2)
+            _, g = jax.value_and_grad(loss_fn, argnums=(0, 1))(w, b)
+            ref = (w - 0.1 * g[0], b - 0.1 * g[1])
+        np.testing.assert_allclose(out_carry[0], ref[0], rtol=1e-4)
+        np.testing.assert_allclose(out_carry[1], ref[1], rtol=1e-4)

@@ -684,6 +684,8 @@ class TestLightningValidation:
         assert _single_optimizer(opt) == (opt, [])
         assert _single_optimizer([opt]) == (opt, [])
         assert _single_optimizer(([opt], [sched])) == (opt, [sched])
+        # Lightning also allows the two-list form AS a list.
+        assert _single_optimizer([[opt], [sched]]) == (opt, [sched])
         assert _single_optimizer(
             {"optimizer": opt, "lr_scheduler": {"scheduler": sched,
                                                 "interval": "epoch"}}
@@ -721,6 +723,31 @@ class TestLightningValidation:
         with pytest.raises(HorovodTpuError, match="single-optimizer"):
             est.fit(make_df(8))
         assert not os.path.exists(store.get_train_data_path("multiopt"))
+
+    def test_validation_without_validation_step_rejected(self):
+        import torch
+
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        _, lit = _lit_import()
+
+        class NoVal(torch.nn.Module):
+            training_step = lit.LitRegression.training_step
+            configure_optimizers = lit.LitRegression.configure_optimizers
+
+            def __init__(self):
+                super().__init__()
+                self.net = torch.nn.Linear(1, 1)
+                self.lr = 0.1
+
+            def forward(self, x):
+                return self.net(x)
+
+        est = LightningEstimator(model=NoVal(), validation=0.2,
+                                 feature_cols=["x1"], label_cols=["y"],
+                                 backend=LocalBackend(1))
+        with pytest.raises(HorovodTpuError, match="no validation_step"):
+            est.fit(make_df(8))
 
     def test_callbacks_rejected(self):
         from horovod_tpu.spark.lightning import LightningEstimator
