@@ -74,6 +74,12 @@ class TestExamples:
              "--compression", "int8"])
         assert "Total img/sec" in out
 
+    def test_autotune_demo_tiny(self):
+        out = _run_example("autotune_demo.py", ["--tiny"],
+                           extra_env={"XLA_FLAGS": ""})
+        assert "frozen:" in out
+        assert "sample  3" in out  # warmup 1 + max_samples 3 closed out
+
     def test_torch_mnist(self):
         out = _run_example("torch_mnist.py", ["--epochs", "1"])
         assert "loss=" in out
