@@ -409,6 +409,33 @@ def ccl_built() -> bool:
     return False
 
 
+def cuda_built() -> bool:
+    """Reference: basics.py cuda_built — constitutionally False here
+    (the build target is TPU/XLA; BASELINE.json's no-CUDA constraint)."""
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    """IBM DDL was removed upstream ~v0.21; kept for probe parity."""
+    return False
+
+
+def mpi_enabled() -> bool:
+    """Reference: basics.py mpi_enabled — 'built' is compile-time,
+    'enabled' is runtime availability.  No MPI in this runtime."""
+    return False
+
+
+def gloo_enabled() -> bool:
+    """The gloo role (MPI-free rendezvous + CPU collectives) is always
+    available: KV rendezvous + the JAX CPU backend."""
+    return True
+
+
 def mpi_threads_supported() -> bool:
     return False
 

@@ -69,6 +69,13 @@ from ..common.basics import (  # noqa: F401
     mpi_built,
     nccl_built,
     gloo_built,
+    ccl_built,
+    cuda_built,
+    rocm_built,
+    ddl_built,
+    mpi_enabled,
+    gloo_enabled,
+    global_process_set,
     mpi_threads_supported,
     add_process_set,
     remove_process_set,

@@ -42,6 +42,13 @@ from ..common.basics import (  # noqa: F401
     mpi_built,
     nccl_built,
     gloo_built,
+    ccl_built,
+    cuda_built,
+    rocm_built,
+    ddl_built,
+    mpi_enabled,
+    gloo_enabled,
+    global_process_set,
     mpi_threads_supported,
     add_process_set,
     remove_process_set,
@@ -148,6 +155,38 @@ def grouped_allreduce_async_(tensors, op=Average, name=None) -> int:
     return _async_dispatch(outs, list(tensors), inplace=True)
 
 
+def sparse_allreduce_async(tensor, name: Optional[str] = None,
+                           op=Average,
+                           process_set: Optional[ProcessSet] = None) -> int:
+    """Allreduce a torch sparse COO tensor (reference: torch/mpi_ops.py
+    `sparse_allreduce_async` — gathers each rank's (indices, values) and
+    sums duplicates).  Returns a handle; `synchronize(handle)` yields the
+    reduced (coalesced) sparse tensor.  `op=Average` divides by the
+    participating size, matching the dense allreduce default."""
+    import torch
+
+    if not getattr(tensor, "is_sparse", False):
+        raise ValueError(
+            "sparse_allreduce_async expects a torch sparse COO tensor; "
+            "use allreduce/allreduce_async for dense tensors")
+    t = tensor.coalesce()
+    # [nnz, ndim] so the ragged allgather concatenates entries on dim 0.
+    idx = np.ascontiguousarray(t.indices().t().numpy())
+    vals = np.ascontiguousarray(t.values().numpy())
+    base = name or "sparse_allreduce"
+    gi = C.allgather(idx, name=f"{base}.indices", process_set=process_set)
+    gv = C.allgather(vals, name=f"{base}.values", process_set=process_set)
+    h = HandleManager.global_instance().allocate((gi, gv))
+    denom = (process_set.size() if process_set is not None else
+             size()) if op == Average else 1
+    _sparse_meta[h] = (t, denom)
+    return h
+
+
+# handle -> (template coalesced sparse tensor, average denominator)
+_sparse_meta = {}
+
+
 def allgather(tensor: "torch.Tensor", name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None) -> "torch.Tensor":
     out = C.allgather(_to_np(tensor), name=name, process_set=process_set)
@@ -225,6 +264,17 @@ def synchronize(handle: int):
     """Block until the handle's collective completes; return the result
     as a torch tensor (in-place variants copy into and return the
     original tensor)."""
+    sp = _sparse_meta.pop(handle, None)
+    if sp is not None:
+        import torch
+
+        tmpl, denom = sp
+        gi, gv = _synchronize_handle(handle)
+        vals = np.asarray(gv) / denom if denom != 1 else np.asarray(gv)
+        return torch.sparse_coo_tensor(
+            torch.from_numpy(np.asarray(gi)).t(),
+            torch.from_numpy(np.ascontiguousarray(vals)).to(tmpl.dtype),
+            size=tuple(tmpl.shape)).coalesce()
     out = _synchronize_handle(handle)
     meta = _async_meta.pop(handle, None)
     if meta is None:

@@ -93,6 +93,37 @@ class TestTorchOps:
         torch.testing.assert_close(outs[1], ts[1])
 
 
+class TestSparseAllreduce:
+    """Reference: torch/mpi_ops.py sparse_allreduce_async — gathered
+    (indices, values) coalesced into the reduced sparse tensor.  Every
+    sim rank contributes the same entries, so duplicates sum to
+    size*values and Average restores the original."""
+
+    def _sparse(self):
+        i = torch.tensor([[0, 1, 3], [2, 0, 1]])
+        v = torch.tensor([1.0, 2.0, 3.0])
+        return torch.sparse_coo_tensor(i, v, size=(4, 4))
+
+    def test_average_roundtrip(self):
+        h = hvd_torch.sparse_allreduce_async(self._sparse(), name="s1")
+        out = hvd_torch.synchronize(h)
+        assert out.is_sparse
+        torch.testing.assert_close(out.to_dense(),
+                                   self._sparse().to_dense())
+
+    def test_sum_scales_by_size(self):
+        h = hvd_torch.sparse_allreduce_async(self._sparse(), name="s2",
+                                             op=hvd_torch.Sum)
+        out = hvd_torch.synchronize(h)
+        torch.testing.assert_close(
+            out.to_dense(),
+            self._sparse().to_dense() * hvd_torch.size())
+
+    def test_dense_input_rejected(self):
+        with pytest.raises(ValueError, match="sparse COO"):
+            hvd_torch.sparse_allreduce_async(torch.ones(3))
+
+
 class TestTorchBroadcastState:
     def test_broadcast_parameters_state_dict(self):
         model = torch.nn.Linear(4, 2)
