@@ -1,0 +1,33 @@
+"""LightningModule-contract regression model for the estimator demo.
+
+Lives in its own importable module (not the example's __main__) because
+the fitted module pickles by class reference and must deserialize
+inside the spawned worker processes.  With pytorch_lightning installed
+this class could equally subclass pl.LightningModule — the estimator
+drives exactly this method surface either way.
+"""
+
+import torch
+
+
+class LitRegressor(torch.nn.Module):
+    def __init__(self, lr=0.01):
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(2, 16), torch.nn.ReLU(),
+            torch.nn.Linear(16, 1))
+        self.lr = lr
+
+    def forward(self, x):
+        return self.net(x)
+
+    def configure_optimizers(self):
+        return torch.optim.Adam(self.parameters(), lr=self.lr)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        return torch.nn.functional.mse_loss(self(x), y)
+
+    def validation_step(self, batch, batch_idx):
+        x, y = batch
+        return torch.nn.functional.mse_loss(self(x), y)

@@ -69,15 +69,39 @@ def run_keras(df, np_workers):
     return model, model.get_history()["loss"]
 
 
+def run_lightning(df, np_workers):
+    """The LightningModule-contract path (horovod_tpu.spark.lightning):
+    no estimator-level loss/optimizer — the module supplies both."""
+    import torch
+
+    from examples.lit_module import LitRegressor
+    from horovod_tpu.spark.common import LocalBackend
+    from horovod_tpu.spark.lightning import LightningEstimator
+
+    # Workers unpickle the module by class reference.
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.environ["PYTHONPATH"] = (
+        repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    torch.manual_seed(0)
+    est = LightningEstimator(
+        model=LitRegressor(lr=0.01),
+        feature_cols=["a", "b"], label_cols=["y"],
+        batch_size=32, epochs=20, validation=0.2, random_seed=0,
+        backend=LocalBackend(np_workers, start_timeout=300))
+    model = est.fit(df)
+    return model, model.get_history()["loss"]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--np", type=int, default=2)
-    ap.add_argument("--framework", choices=["torch", "keras"],
+    ap.add_argument("--framework", choices=["torch", "keras", "lightning"],
                     default="torch")
     args = ap.parse_args()
 
     df = make_dataframe()
-    runner = run_torch if args.framework == "torch" else run_keras
+    runner = {"torch": run_torch, "keras": run_keras,
+              "lightning": run_lightning}[args.framework]
     model, losses = runner(df, args.np)
     out = model.transform(df)
     preds = np.asarray([float(np.ravel(v)[0]) for v in out["prediction"]])

@@ -106,6 +106,9 @@ def check_build() -> str:
         f"    [{'X' if basics.gloo_built() else ' '}] CPU (host platform)",
         f"    [{'X' if basics.mpi_built() else ' '}] MPI",
         f"    [{'X' if basics.nccl_built() else ' '}] NCCL",
+        f"    [{'X' if basics.ccl_built() else ' '}] oneCCL",
+        f"    [{'X' if basics.cuda_built() else ' '}] CUDA",
+        f"    [{'X' if basics.rocm_built() else ' '}] ROCm",
         "",
         "Available controllers:",
         "    [X] jax.distributed (gRPC over DCN)",
