@@ -204,7 +204,21 @@ def allreduce(tensor, average: Optional[bool] = None,
                           process_set=process_set)
         return [compression.decompress(out, ctx)]
 
-    return _eager_or_py_function(_fn, [tensor], "HorovodAllreduce")[0]
+    @tf.custom_gradient
+    def _differentiable(x):
+        out = _eager_or_py_function(_fn, [x], "HorovodAllreduce")[0]
+
+        def grad(dy):
+            # Reference: RegisterGradient('HorovodAllreduce') — the
+            # gradient of allreduce is allreduce with the same op.
+            return allreduce(dy, op=op, prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             compression=compression,
+                             process_set=process_set)
+
+        return out, grad
+
+    return _differentiable(tf.convert_to_tensor(tensor))
 
 
 def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
@@ -314,8 +328,32 @@ def allgather(tensor, name: Optional[str] = None,
         return tf.TensorShape([None]).concatenate(shape[1:]) \
             if shape.rank else None
 
-    return _eager_or_py_function(_fn, [tensor], "HorovodAllgather",
-                                 out_shape_fn=_out_shape)[0]
+    @tf.custom_gradient
+    def _differentiable(x):
+        out = _eager_or_py_function(_fn, [x], "HorovodAllgather",
+                                    out_shape_fn=_out_shape)[0]
+        n0 = tf.shape(x)[0]
+
+        def grad(dy):
+            # Reference: _allgather_grad — sum the output gradient
+            # across ranks, then take this rank's slice (ragged offsets
+            # from the gathered per-rank sizes).
+            summed = allreduce(dy, op=Sum, process_set=process_set)
+            sizes = allgather(tf.reshape(n0, [1]),
+                              process_set=process_set)
+            r = (process_set.rank() if process_set is not None
+                 else rank())
+            begin = tf.reduce_sum(sizes[:r])
+            return summed[begin:begin + n0]
+
+        return out, grad
+
+    x = tf.convert_to_tensor(tensor)
+    if x.shape.rank == 0:
+        # The collective gathers scalars as [1]-slices; reshape so the
+        # backward slice math sees the same shape (grad flows through).
+        x = tf.reshape(x, [1])
+    return _differentiable(x)
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
@@ -324,7 +362,21 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
         return [C.broadcast(nps[0], root_rank=root_rank,
                             name=name, process_set=process_set)]
 
-    return _eager_or_py_function(_fn, [tensor], "HorovodBroadcast")[0]
+    @tf.custom_gradient
+    def _differentiable(x):
+        out = _eager_or_py_function(_fn, [x], "HorovodBroadcast")[0]
+
+        def grad(dy):
+            # Reference: _broadcast_grad — gradients sum to the root;
+            # non-root inputs did not influence the output.
+            red = allreduce(dy, op=Sum, process_set=process_set)
+            r = (process_set.rank() if process_set is not None
+                 else rank())
+            return red if r == root_rank else tf.zeros_like(red)
+
+        return out, grad
+
+    return _differentiable(tf.convert_to_tensor(tensor))
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
@@ -338,8 +390,20 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
             return [C.alltoall(nps[0], name=name,
                                process_set=process_set)]
 
-        return _eager_or_py_function(_fn, [tensor], "HorovodAlltoall",
-                                     out_shape_fn=_out_shape)[0]
+        @tf.custom_gradient
+        def _differentiable(x):
+            out = _eager_or_py_function(_fn, [x], "HorovodAlltoall",
+                                        out_shape_fn=_out_shape)[0]
+
+            def grad(dy):
+                # Reference: _alltoall_grad — equal splits invert
+                # themselves by another alltoall.  (The explicit-splits
+                # variant below is not differentiable here.)
+                return alltoall(dy, process_set=process_set)
+
+            return out, grad
+
+        return _differentiable(tf.convert_to_tensor(tensor))
 
     # With splits the reference returns (received, received_splits); the
     # splits tensor rides the same bridge so graph mode works.
@@ -366,8 +430,24 @@ def reducescatter(tensor, op=Average, name: Optional[str] = None,
         return tf.TensorShape([None]).concatenate(shape[1:]) \
             if shape.rank else None
 
-    return _eager_or_py_function(_fn, [tensor], "HorovodReducescatter",
-                                 out_shape_fn=_out_shape)[0]
+    @tf.custom_gradient
+    def _differentiable(x):
+        out = _eager_or_py_function(_fn, [x], "HorovodReducescatter",
+                                    out_shape_fn=_out_shape)[0]
+
+        def grad(dy):
+            # Reference: _reducescatter_grad — allgather the slice
+            # gradients; Average needs the same 1/N the forward applied.
+            g = allgather(dy, process_set=process_set)
+            if op is Average:
+                n = (len(process_set.ranks) if process_set is not None
+                     else size())
+                g = g / tf.cast(n, g.dtype)
+            return g
+
+        return out, grad
+
+    return _differentiable(tf.convert_to_tensor(tensor))
 
 
 # -- async variants (reference: *_async in mpi_ops.py) ----------------------

@@ -85,8 +85,122 @@ def _to_torch(a, like: "torch.Tensor") -> "torch.Tensor":
 # Collective ops on torch tensors (reference: horovod/torch/mpi_ops.py)
 # ---------------------------------------------------------------------------
 
+class _AllreduceFn(torch.autograd.Function):
+    """Differentiable allreduce (reference: torch/mpi_ops.py
+    HorovodAllreduce autograd.Function — the gradient of allreduce is
+    allreduce with the same op)."""
+
+    @staticmethod
+    def forward(ctx, tensor, op, name, process_set):
+        ctx.op, ctx.ps = op, process_set
+        out = C.allreduce(_to_np(tensor), op=op, name=name,
+                          process_set=process_set)
+        return _to_torch(out, tensor)
+
+    @staticmethod
+    def backward(ctx, grad):
+        out = C.allreduce(_to_np(grad), op=ctx.op, process_set=ctx.ps)
+        return _to_torch(out, grad), None, None, None
+
+
+class _AllgatherFn(torch.autograd.Function):
+    """Reference: HorovodAllgather autograd.Function — backward sums the
+    output gradient across ranks and takes this rank's slice."""
+
+    @staticmethod
+    def forward(ctx, tensor, name, process_set):
+        ctx.ps, ctx.n0 = process_set, tensor.shape[0]
+        out = C.allgather(_to_np(tensor), name=name,
+                          process_set=process_set)
+        return _to_torch(out, tensor)
+
+    @staticmethod
+    def backward(ctx, grad):
+        summed = C.allreduce(_to_np(grad), op=Sum, process_set=ctx.ps)
+        sizes = np.asarray(C.allgather(
+            np.asarray([ctx.n0], np.int64), process_set=ctx.ps))
+        r = ctx.ps.rank() if ctx.ps is not None else rank()
+        begin = int(sizes[:r].sum())
+        return (_to_torch(np.asarray(summed)[begin:begin + ctx.n0],
+                          grad), None, None)
+
+
+class _BroadcastFn(torch.autograd.Function):
+    """Reference: HorovodBroadcast autograd.Function — gradients sum to
+    the root; non-root inputs did not influence the output."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name, process_set):
+        ctx.ps, ctx.root = process_set, root_rank
+        out = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
+                          process_set=process_set)
+        return _to_torch(out, tensor)
+
+    @staticmethod
+    def backward(ctx, grad):
+        red = C.allreduce(_to_np(grad), op=Sum, process_set=ctx.ps)
+        r = ctx.ps.rank() if ctx.ps is not None else rank()
+        g = _to_torch(red, grad)
+        return (g if r == ctx.root else torch.zeros_like(g),
+                None, None, None)
+
+
+class _ReducescatterFn(torch.autograd.Function):
+    """Reference: HorovodReducescatter autograd.Function — backward
+    allgathers the slice gradients (scaled 1/N for Average)."""
+
+    @staticmethod
+    def forward(ctx, tensor, op, name, process_set):
+        ctx.op, ctx.ps = op, process_set
+        out = C.reducescatter(_to_np(tensor), op=op, name=name,
+                              process_set=process_set)
+        return _to_torch(out, tensor)
+
+    @staticmethod
+    def backward(ctx, grad):
+        g = _to_torch(C.allgather(_to_np(grad), process_set=ctx.ps), grad)
+        if ctx.op == Average:
+            n = ctx.ps.size() if ctx.ps is not None else size()
+            g = g / n
+        return g, None, None, None
+
+
+class _AlltoallFn(torch.autograd.Function):
+    """Reference: HorovodAlltoall autograd.Function — equal splits
+    invert themselves by another alltoall."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        out = C.alltoall(_to_np(tensor), name=name)
+        return _to_torch(out, tensor)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return _to_torch(C.alltoall(_to_np(grad)), grad), None
+
+
+class _GroupedAllreduceFn(torch.autograd.Function):
+    """Reference: grouped allreduce autograd — the gradient of a grouped
+    allreduce is the grouped allreduce of the gradients (one fused
+    negotiation both ways)."""
+
+    @staticmethod
+    def forward(ctx, op, name, *tensors):
+        ctx.op = op
+        outs = C.grouped_allreduce([_to_np(t) for t in tensors], op=op)
+        return tuple(_to_torch(o, t) for o, t in zip(outs, tensors))
+
+    @staticmethod
+    def backward(ctx, *grads):
+        outs = C.grouped_allreduce([_to_np(g) for g in grads], op=ctx.op)
+        return (None, None) + tuple(
+            _to_torch(o, g) for o, g in zip(outs, grads))
+
+
 def allreduce(tensor: "torch.Tensor", op=Average, name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None) -> "torch.Tensor":
+    if tensor.requires_grad:
+        return _AllreduceFn.apply(tensor, op, name, process_set)
     out = C.allreduce(_to_np(tensor), op=op, name=name,
                       process_set=process_set)
     return _to_torch(out, tensor)
@@ -189,12 +303,19 @@ _sparse_meta = {}
 
 def allgather(tensor: "torch.Tensor", name: Optional[str] = None,
               process_set: Optional[ProcessSet] = None) -> "torch.Tensor":
+    if tensor.requires_grad:
+        # 0-d: the collective gathers scalars as [1]-slices; unsqueeze
+        # so the backward slice math sees the same shape.
+        t = tensor.unsqueeze(0) if tensor.dim() == 0 else tensor
+        return _AllgatherFn.apply(t, name, process_set)
     out = C.allgather(_to_np(tensor), name=name, process_set=process_set)
     return _to_torch(out, tensor)
 
 
 def broadcast(tensor: "torch.Tensor", root_rank: int = 0,
               name: Optional[str] = None) -> "torch.Tensor":
+    if tensor.requires_grad:
+        return _BroadcastFn.apply(tensor, root_rank, name, None)
     out = C.broadcast(_to_np(tensor), root_rank=root_rank, name=name)
     return _to_torch(out, tensor)
 
@@ -206,6 +327,8 @@ def broadcast_(tensor: "torch.Tensor", root_rank: int = 0, **kw):
 
 def alltoall(tensor: "torch.Tensor", splits=None,
              name: Optional[str] = None) -> "torch.Tensor":
+    if tensor.requires_grad and splits is None:
+        return _AlltoallFn.apply(tensor, name)
     out = C.alltoall(_to_np(tensor), splits=splits, name=name)
     if isinstance(out, tuple):
         out = out[0]
@@ -223,6 +346,8 @@ def alltoall_async(tensor: "torch.Tensor", splits=None,
 
 
 def grouped_allreduce(tensors, op=Average, name=None):
+    if any(t.requires_grad for t in tensors):
+        return list(_GroupedAllreduceFn.apply(op, name, *tensors))
     outs = C.grouped_allreduce([_to_np(t) for t in tensors], op=op)
     return [_to_torch(o, t) for o, t in zip(outs, tensors)]
 
@@ -233,6 +358,8 @@ def reducescatter(tensor: "torch.Tensor", op=Average,
                   ) -> "torch.Tensor":
     """Reference: hvd.reducescatter (torch/mpi_ops.py) — reduce across
     ranks, return this rank's 1/size slice of dim 0."""
+    if tensor.requires_grad:
+        return _ReducescatterFn.apply(tensor, op, name, process_set)
     out = C.reducescatter(_to_np(tensor), op=op, name=name,
                           process_set=process_set)
     return _to_torch(out, tensor)

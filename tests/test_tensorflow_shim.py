@@ -17,6 +17,62 @@ import horovod_tpu.tensorflow as hvd_tf  # noqa: E402
 import horovod_tpu.tensorflow.keras as hvd_keras  # noqa: E402
 
 
+class TestTfCollectiveGradients:
+    """Reference: the RegisterGradient entries in
+    horovod/tensorflow/__init__.py — tapes differentiate THROUGH
+    collectives ('grad of allreduce' tests in test_tensorflow.py)."""
+
+    def test_allreduce_gradient(self):
+        import tensorflow as tf
+
+        x = tf.Variable(tf.ones((4,)))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(hvd_tf.allreduce(x * 2.0))
+        g = tape.gradient(y, x)
+        np.testing.assert_allclose(g.numpy(), np.full((4,), 2.0))
+
+    def test_allgather_gradient(self):
+        import tensorflow as tf
+
+        x = tf.Variable(tf.ones((2, 3)))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(hvd_tf.allgather(x))
+        g = tape.gradient(y, x)
+        np.testing.assert_allclose(
+            g.numpy(), np.full((2, 3), float(hvd_tf.size())))
+
+    def test_broadcast_gradient_root(self):
+        import tensorflow as tf
+
+        x = tf.Variable(tf.ones((3,)))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(hvd_tf.broadcast(x, root_rank=0))
+        g = tape.gradient(y, x)
+        np.testing.assert_allclose(
+            g.numpy(), np.full((3,), float(hvd_tf.size())))
+
+    def test_reducescatter_gradient_average(self):
+        import tensorflow as tf
+
+        n = hvd_tf.size()
+        x = tf.Variable(tf.ones((2 * n, 3)))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(hvd_tf.reducescatter(x))
+        g = tape.gradient(y, x)
+        np.testing.assert_allclose(
+            g.numpy(), np.full((2 * n, 3), 1.0 / n))
+
+    def test_alltoall_gradient(self):
+        import tensorflow as tf
+
+        n = hvd_tf.size()
+        x = tf.Variable(tf.ones((n, 2)))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(hvd_tf.alltoall(x) * 3.0)
+        g = tape.gradient(y, x)
+        np.testing.assert_allclose(g.numpy(), np.full((n, 2), 3.0))
+
+
 class TestTfOps:
     def test_allreduce_average_roundtrip(self):
         t = tf.constant([[1.0, 2.0], [3.0, 4.0]])
@@ -550,3 +606,20 @@ class TestDlpackBridge:
 
         a = C.allreduce(np.ones(4, np.float32))
         assert isinstance(a, jax.Array)
+
+
+class TestTfScalarAllgather:
+    def test_scalar_allgather_forward(self):
+        import tensorflow as tf
+
+        y = hvd_tf.allgather(tf.constant(3.0))
+        assert y.shape == (hvd_tf.size(),)
+
+    def test_scalar_allgather_gradient(self):
+        import tensorflow as tf
+
+        x = tf.Variable(2.0)
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(hvd_tf.allgather(x))
+        g = tape.gradient(y, x)
+        np.testing.assert_allclose(float(g), float(hvd_tf.size()))

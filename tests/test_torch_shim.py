@@ -93,6 +93,38 @@ class TestTorchOps:
         torch.testing.assert_close(outs[1], ts[1])
 
 
+class TestCollectiveGradients:
+    """Reference: torch/mpi_ops.py autograd Functions — collectives are
+    differentiable; grad-of-allreduce is allreduce, grad-of-allgather is
+    the summed gradient's own slice, grad-of-broadcast sums to root."""
+
+    def test_allreduce_gradient(self):
+        x = torch.ones(4, requires_grad=True)
+        y = hvd_torch.allreduce(x * 2.0)
+        y.sum().backward()
+        torch.testing.assert_close(x.grad, torch.full((4,), 2.0))
+
+    def test_allgather_gradient_sums_and_slices(self):
+        x = torch.ones(2, 3, requires_grad=True)
+        y = hvd_torch.allgather(x)
+        assert y.shape[0] == 2 * hvd_torch.size()
+        y.sum().backward()
+        torch.testing.assert_close(
+            x.grad, torch.full((2, 3), float(hvd_torch.size())))
+
+    def test_broadcast_gradient_on_root(self):
+        x = torch.ones(3, requires_grad=True)
+        y = hvd_torch.broadcast(x, root_rank=0)
+        y.sum().backward()
+        # This process IS rank 0 in the sim: gradient sums across ranks.
+        torch.testing.assert_close(
+            x.grad, torch.full((3,), float(hvd_torch.size())))
+
+    def test_no_grad_path_unchanged(self):
+        y = hvd_torch.allreduce(torch.ones(3))
+        assert not y.requires_grad
+
+
 class TestSparseAllreduce:
     """Reference: torch/mpi_ops.py sparse_allreduce_async — gathered
     (indices, values) coalesced into the reduced sparse tensor.  Every
@@ -629,3 +661,43 @@ class TestTorchSparseAndAsync:
         # Must agree with the synchronous op (in the sim, rank 0
         # receives every rank's slice 0).
         assert torch.equal(out, hvd_torch.alltoall(t))
+
+
+class TestMoreCollectiveGradients:
+    """Round out differentiability parity: reducescatter, alltoall,
+    grouped allreduce, and the 0-d allgather edge."""
+
+    def test_scalar_allgather_gradient(self):
+        x = torch.tensor(2.0, requires_grad=True)
+        y = hvd_torch.allgather(x)
+        assert y.shape == (hvd_torch.size(),)
+        y.sum().backward()
+        torch.testing.assert_close(
+            x.grad, torch.tensor(float(hvd_torch.size())))
+
+    def test_scalar_allgather_no_grad(self):
+        y = hvd_torch.allgather(torch.tensor(3.0))
+        assert y.shape == (hvd_torch.size(),)
+
+    def test_reducescatter_gradient_average(self):
+        n = hvd_torch.size()
+        x = torch.ones(2 * n, 3, requires_grad=True)
+        y = hvd_torch.reducescatter(x)
+        y.sum().backward()
+        torch.testing.assert_close(x.grad,
+                                   torch.full((2 * n, 3), 1.0 / n))
+
+    def test_alltoall_gradient(self):
+        n = hvd_torch.size()
+        x = torch.ones(n, 2, requires_grad=True)
+        y = hvd_torch.alltoall(x * 3.0)
+        y.sum().backward()
+        torch.testing.assert_close(x.grad, torch.full((n, 2), 3.0))
+
+    def test_grouped_allreduce_gradient(self):
+        a = torch.ones(3, requires_grad=True)
+        b = torch.ones(2, 2, requires_grad=True)
+        outs = hvd_torch.grouped_allreduce([a * 2.0, b * 5.0])
+        (outs[0].sum() + outs[1].sum()).backward()
+        torch.testing.assert_close(a.grad, torch.full((3,), 2.0))
+        torch.testing.assert_close(b.grad, torch.full((2, 2), 5.0))
