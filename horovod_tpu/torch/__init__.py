@@ -516,6 +516,8 @@ class _DistributedOptimizer:
         self._bucket_bytes = 0
         # (handle, params, ctxs) per dispatched bucket.
         self._in_flight: list = []
+        # (param, handle) per in-flight sparse allreduce.
+        self._sparse_in_flight: list = []
         self._reduced_ids: set = set()
         self.total_flushes = 0  # observable: fused buckets dispatched
         if hasattr(torch.Tensor, "register_post_accumulate_grad_hook"):
@@ -532,15 +534,16 @@ class _DistributedOptimizer:
         if id(p) in self._reduced_ids:
             return
         if p.grad.is_sparse:
-            # Reference: torch sparse gradients (embedding sparse=True)
-            # ride the dense path only when asked (optimizer.py
-            # sparse_as_dense); there is no sparse wire format.
-            if not self._sparse_as_dense:
-                raise ValueError(
-                    "sparse gradient encountered; construct "
-                    "DistributedOptimizer(..., sparse_as_dense=True) "
-                    "to densify before allreduce")
-            p.grad = p.grad.to_dense()
+            # Reference (optimizer.py): sparse gradients either densify
+            # (sparse_as_dense=True) or ride the allgather-based sparse
+            # allreduce — they never bucket with dense grads.
+            if self._sparse_as_dense:
+                p.grad = p.grad.to_dense()
+            else:
+                self._reduced_ids.add(id(p))
+                self._sparse_in_flight.append(
+                    (p, sparse_allreduce_async(p.grad, op=self._op)))
+                return
         self._reduced_ids.add(id(p))
         self._bucket.append(p)
         self._bucket_bytes += p.grad.numel() * p.grad.element_size()
@@ -576,6 +579,11 @@ class _DistributedOptimizer:
                 p.grad.copy_(_to_torch(self._compression.decompress(o, ctx),
                                        p.grad))
         self._in_flight = []
+        for p, h in self._sparse_in_flight:
+            # Sparse grads are REPLACED (not copied into) — the reduced
+            # nnz differs from the local nnz.
+            p.grad = synchronize(h)
+        self._sparse_in_flight = []
         self._synchronized = True
 
     # -- optimizer protocol ---------------------------------------------

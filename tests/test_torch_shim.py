@@ -629,17 +629,25 @@ class TestTorchElasticState:
 
 
 class TestTorchSparseAndAsync:
-    def test_sparse_grad_requires_flag(self):
+    def test_sparse_grads_use_sparse_allreduce_by_default(self):
+        """Reference default (sparse_as_dense=False): sparse grads ride
+        the allgather-based sparse allreduce; the optimizer step applies
+        a sparse update and the reduced grad STAYS sparse."""
         emb = torch.nn.Embedding(8, 4, sparse=True)
+        before = emb.weight.detach().clone()
         opt = hvd_torch.DistributedOptimizer(
             torch.optim.SGD(emb.parameters(), lr=0.1),
             named_parameters=emb.named_parameters())
         loss = emb(torch.tensor([1, 2])).sum()
-        # The reduction hook fires as the sparse grad finalizes, so the
-        # error surfaces from backward() (or step() on hook-less torch).
-        with pytest.raises(ValueError, match="sparse_as_dense"):
-            loss.backward()
-            opt.step()
+        loss.backward()
+        opt.step()
+        assert emb.weight.grad.is_sparse
+        after = emb.weight.detach()
+        # Only the touched rows moved, by the averaged (== local, in the
+        # sim) gradient of 1.0 per element: -lr * 1.
+        np.testing.assert_allclose(after[1], before[1] - 0.1, atol=1e-6)
+        np.testing.assert_allclose(after[2], before[2] - 0.1, atol=1e-6)
+        np.testing.assert_allclose(after[0], before[0])
 
     def test_sparse_as_dense_trains(self):
         emb = torch.nn.Embedding(8, 4, sparse=True)
