@@ -238,8 +238,22 @@ def grouped_allreduce(tensors: Sequence, average: Optional[bool] = None,
         return [compression.decompress(o, ctx)
                 for o, ctx in zip(outs, ctxs)]
 
-    return _eager_or_py_function(_fn, list(tensors),
-                                 "HorovodGroupedAllreduce")
+    @tf.custom_gradient
+    def _differentiable(*xs):
+        outs = _eager_or_py_function(_fn, list(xs),
+                                     "HorovodGroupedAllreduce")
+
+        def grad(*dys):
+            # Reference: grouped allreduce gradient is the grouped
+            # allreduce of the gradients (one fused pass both ways).
+            return grouped_allreduce(list(dys), op=op,
+                                     compression=compression,
+                                     process_set=process_set)
+
+        return outs, grad
+
+    return list(_differentiable(*[tf.convert_to_tensor(t)
+                                  for t in tensors]))
 
 
 def grouped_allgather(tensors: Sequence, name: Optional[str] = None,

@@ -623,3 +623,17 @@ class TestTfScalarAllgather:
             y = tf.reduce_sum(hvd_tf.allgather(x))
         g = tape.gradient(y, x)
         np.testing.assert_allclose(float(g), float(hvd_tf.size()))
+
+
+class TestTfGroupedGradient:
+    def test_grouped_allreduce_gradient(self):
+        import tensorflow as tf
+
+        a = tf.Variable(tf.ones((3,)))
+        b = tf.Variable(tf.ones((2, 2)))
+        with tf.GradientTape() as tape:
+            outs = hvd_tf.grouped_allreduce([a * 2.0, b * 5.0])
+            y = tf.reduce_sum(outs[0]) + tf.reduce_sum(outs[1])
+        ga, gb = tape.gradient(y, [a, b])
+        np.testing.assert_allclose(ga.numpy(), np.full((3,), 2.0))
+        np.testing.assert_allclose(gb.numpy(), np.full((2, 2), 5.0))
