@@ -427,11 +427,22 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
             process_set=process_set)
         return [recv, np.asarray(recv_splits, np.int32)]
 
+    @tf.custom_gradient
+    def _differentiable(x, s):
+        out, recv_splits = _eager_or_py_function(
+            _fn2, [x, s], "HorovodAlltoall", out_shape_fn=_out_shape)
+
+        def grad(dy, d_recv_splits=None):
+            # Reference: _alltoall_grad — the received splits describe
+            # exactly how to route the gradient back; splits get none.
+            back, _ = alltoall(dy, splits=recv_splits,
+                               process_set=process_set)
+            return back, None
+
+        return (out, recv_splits), grad
+
     splits_t = tf.convert_to_tensor(splits, dtype=tf.int32)
-    out, recv_splits = _eager_or_py_function(
-        _fn2, [tensor, splits_t], "HorovodAlltoall",
-        out_shape_fn=_out_shape)
-    return out, recv_splits
+    return _differentiable(tf.convert_to_tensor(tensor), splits_t)
 
 
 def reducescatter(tensor, op=Average, name: Optional[str] = None,

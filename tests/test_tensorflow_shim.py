@@ -637,3 +637,18 @@ class TestTfGroupedGradient:
         ga, gb = tape.gradient(y, [a, b])
         np.testing.assert_allclose(ga.numpy(), np.full((3,), 2.0))
         np.testing.assert_allclose(gb.numpy(), np.full((2, 2), 5.0))
+
+
+class TestTfAlltoallSplitsGradient:
+    def test_splits_alltoall_gradient(self):
+        import tensorflow as tf
+
+        n = hvd_tf.size()
+        x = tf.Variable(tf.ones((n, 2)))
+        splits = tf.constant([1] * n, dtype=tf.int32)
+        with tf.GradientTape() as tape:
+            out, recv_splits = hvd_tf.alltoall(x * 4.0, splits=splits)
+            y = tf.reduce_sum(out)
+        g = tape.gradient(y, x)
+        assert recv_splits.shape == (n,)
+        np.testing.assert_allclose(g.numpy(), np.full((n, 2), 4.0))
