@@ -532,7 +532,9 @@ def broadcast_global_variables(root_rank: int = 0) -> None:
 # ---------------------------------------------------------------------------
 
 def _fused_flat_allreduce(dense: Sequence, op, compression,
-                          process_set: Optional[ProcessSet]) -> List:
+                          process_set: Optional[ProcessSet],
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0) -> List:
     """TF-side fusion buffer: concat same-dtype gradients into ONE flat
     tensor per dtype *before* crossing the bridge, allreduce once, split
     back with tf.split.  The reference's FusionBufferManager does this
@@ -549,12 +551,16 @@ def _fused_flat_allreduce(dense: Sequence, op, compression,
         if len(items) == 1:
             i, g = items[0]
             out[i] = allreduce(g, op=op, compression=compression,
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor,
                                process_set=process_set)
             continue
         shapes = [g.shape for _, g in items]
         sizes = [int(np.prod(s)) if s.rank else 1 for s in shapes]
         flat = tf.concat([tf.reshape(g, [-1]) for _, g in items], axis=0)
         red = allreduce(flat, op=op, compression=compression,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
                         process_set=process_set)
         parts = tf.split(red, sizes)
         for (i, _), part, shape in zip(items, parts, shapes):
@@ -564,7 +570,8 @@ def _fused_flat_allreduce(dense: Sequence, op, compression,
 
 def _allreduce_grads(grads: Sequence, op, compression,
                      process_set: Optional[ProcessSet],
-                     sparse_as_dense: bool) -> List:
+                     sparse_as_dense: bool,
+                     gradient_predivide_factor: float = 1.0) -> List:
     """The reference's `_allreduce_grads`: fused (grouped) allreduce of all
     non-None gradients, None passed through at its position.
 
@@ -598,10 +605,23 @@ def _allreduce_grads(grads: Sequence, op, compression,
                 continue
         dense_idx.append(i)
         dense.append(g)
+    wire_op, pre, post = op, 1.0, 1.0
+    if gradient_predivide_factor != 1.0:
+        # Reference (gradient_predivide_factor): split the averaging
+        # around the sum — scale by 1/f before, f/size after (numeric
+        # range control for low-precision wires); the net is still the
+        # exact average.
+        if op is not Average:
+            raise ValueError(
+                "gradient_predivide_factor requires op=Average")
+        wire_op, pre = Sum, 1.0 / gradient_predivide_factor
+        post = gradient_predivide_factor / n
     if dense:
-        reduced = _fused_flat_allreduce(dense, op=op,
+        reduced = _fused_flat_allreduce(dense, op=wire_op,
                                         compression=compression,
-                                        process_set=process_set)
+                                        process_set=process_set,
+                                        prescale_factor=pre,
+                                        postscale_factor=post)
         for i, r in zip(dense_idx, reduced):
             out[i] = r
     return out
@@ -614,11 +634,13 @@ class _DistributedGradientTape:
     def __init__(self, tape: "tf.GradientTape", op=Average,
                  compression=Compression.none,
                  sparse_as_dense: bool = False,
+                 gradient_predivide_factor: float = 1.0,
                  process_set: Optional[ProcessSet] = None):
         self._tape = tape
         self._op = op
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
+        self._predivide = gradient_predivide_factor
         self._process_set = process_set
 
     def gradient(self, target, sources, output_gradients=None):
@@ -626,7 +648,8 @@ class _DistributedGradientTape:
         flat = tf.nest.flatten(grads)
         reduced = _allreduce_grads(
             flat, self._op, self._compression, self._process_set,
-            self._sparse_as_dense)
+            self._sparse_as_dense,
+            gradient_predivide_factor=self._predivide)
         return tf.nest.pack_sequence_as(grads, reduced)
 
     # Context-manager & watch API pass through to the underlying tape.
@@ -641,13 +664,22 @@ class _DistributedGradientTape:
         return getattr(self._tape, item)
 
 
-def DistributedGradientTape(gradtape: "tf.GradientTape", op=Average,
+def DistributedGradientTape(gradtape: "tf.GradientTape", device_dense="",
+                            device_sparse="", op=Average,
                             compression=Compression.none,
                             sparse_as_dense: bool = False,
+                            gradient_predivide_factor: float = 1.0,
+                            num_groups: int = 0, groups=None,
                             process_set: Optional[ProcessSet] = None):
+    """`device_dense/device_sparse/num_groups/groups` accepted for
+    reference signature parity; XLA places collectives and fusion groups
+    by dtype automatically."""
+    del device_dense, device_sparse, num_groups, groups
     return _DistributedGradientTape(
         gradtape, op=op, compression=compression,
-        sparse_as_dense=sparse_as_dense, process_set=process_set)
+        sparse_as_dense=sparse_as_dense,
+        gradient_predivide_factor=gradient_predivide_factor,
+        process_set=process_set)
 
 
 # ---------------------------------------------------------------------------
@@ -663,19 +695,22 @@ class _DistributedOptimizer:
                  compression=Compression.none,
                  backward_passes_per_step: int = 1,
                  sparse_as_dense: bool = False,
+                 gradient_predivide_factor: float = 1.0,
                  process_set: Optional[ProcessSet] = None):
         self._opt = optimizer
         self._op = op
         self._compression = compression
         self._process_set = process_set
         self._sparse_as_dense = sparse_as_dense
+        self._predivide = gradient_predivide_factor
         self._bpps = max(1, backward_passes_per_step)
         self._pass = 0
         self._acc: Optional[List[np.ndarray]] = None
 
     def _reduce(self, grads: Sequence) -> List:
         return _allreduce_grads(list(grads), self._op, self._compression,
-                                self._process_set, self._sparse_as_dense)
+                                self._process_set, self._sparse_as_dense,
+                                gradient_predivide_factor=self._predivide)
 
     def apply_gradients(self, grads_and_vars, **kwargs):
         gv = list(grads_and_vars)
@@ -711,15 +746,23 @@ class _DistributedOptimizer:
         return getattr(self._opt, item)
 
 
-def DistributedOptimizer(optimizer, op=Average,
+def DistributedOptimizer(optimizer, name=None, device_dense="",
+                         device_sparse="", op=Average,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          sparse_as_dense: bool = False,
+                         gradient_predivide_factor: float = 1.0,
+                         num_groups: int = 0, groups=None,
                          process_set: Optional[ProcessSet] = None):
+    """`name`, `device_dense/device_sparse` (XLA places collectives) and
+    `num_groups/groups` (fusion groups by dtype automatically) are
+    accepted for reference signature parity and ignored."""
+    del name, device_dense, device_sparse, num_groups, groups
     return _DistributedOptimizer(
         optimizer, op=op, compression=compression,
         backward_passes_per_step=backward_passes_per_step,
         sparse_as_dense=sparse_as_dense,
+        gradient_predivide_factor=gradient_predivide_factor,
         process_set=process_set)
 
 

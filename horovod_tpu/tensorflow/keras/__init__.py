@@ -29,10 +29,13 @@ from . import callbacks  # noqa: F401
 
 
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         device_dense="", device_sparse="",
                          op=Average, compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          average_aggregated_gradients: bool = False,
                          sparse_as_dense: bool = False,
+                         gradient_predivide_factor: float = 1.0,
+                         num_groups: int = 0, groups=None,
                          process_set: Optional[ProcessSet] = None):
     """Wrap a Keras optimizer so every `apply_gradients` first averages
     gradients across ranks (reference: create_distributed_optimizer).
@@ -55,6 +58,7 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
         _hvd_bpps = int(backward_passes_per_step)
         _hvd_avg_agg = bool(average_aggregated_gradients)
         _hvd_sparse_as_dense = bool(sparse_as_dense)
+        _hvd_predivide = float(gradient_predivide_factor)
 
         def _hvd_reduce_then(self, grads, tvars, apply_fn):
             """Allreduce-and-apply now (bpps==1), or accumulate and do
@@ -76,7 +80,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                 # counter).
                 return _apply_inner(_allreduce_grads(
                     grads, self._hvd_op, self._hvd_compression,
-                    self._hvd_process_set, self._hvd_sparse_as_dense))
+                    self._hvd_process_set, self._hvd_sparse_as_dense,
+                    gradient_predivide_factor=self._hvd_predivide))
 
             if getattr(self, "_hvd_accum_vars", None) is None:
                 # First trace: create the aggregation slots.
@@ -98,7 +103,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                              for acc in self._hvd_accum_vars]
                 _apply_inner(_allreduce_grads(
                     local, self._hvd_op, self._hvd_compression,
-                    self._hvd_process_set, self._hvd_sparse_as_dense))
+                    self._hvd_process_set, self._hvd_sparse_as_dense,
+                    gradient_predivide_factor=self._hvd_predivide))
                 for acc in self._hvd_accum_vars:
                     acc.assign(tf.zeros_like(acc))
                 return tf.convert_to_tensor(self.iterations)

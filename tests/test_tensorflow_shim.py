@@ -652,3 +652,44 @@ class TestTfAlltoallSplitsGradient:
         g = tape.gradient(y, x)
         assert recv_splits.shape == (n,)
         np.testing.assert_allclose(g.numpy(), np.full((n, 2), 4.0))
+
+
+class TestGradientPredivide:
+    """Reference: gradient_predivide_factor splits the averaging around
+    the sum (prescale 1/f, postscale f/size) — the NET result is still
+    the exact average for any f."""
+
+    def test_predivide_preserves_average(self):
+        import tensorflow as tf
+
+        v = tf.Variable(tf.ones((4,)))
+        with tf.GradientTape() as t0:
+            y0 = tf.reduce_sum(v * 3.0)
+        plain = hvd_tf.DistributedGradientTape(t0).gradient(y0, [v])[0]
+
+        with tf.GradientTape() as t1:
+            y1 = tf.reduce_sum(v * 3.0)
+        pre = hvd_tf.DistributedGradientTape(
+            t1, gradient_predivide_factor=2.0).gradient(y1, [v])[0]
+        np.testing.assert_allclose(pre.numpy(), plain.numpy(), rtol=1e-6)
+
+    def test_predivide_requires_average(self):
+        import tensorflow as tf
+
+        v = tf.Variable(tf.ones((4,)))
+        with tf.GradientTape() as t:
+            y = tf.reduce_sum(v * 3.0)
+        tape = hvd_tf.DistributedGradientTape(
+            t, op=hvd_tf.Sum, gradient_predivide_factor=2.0)
+        with pytest.raises(ValueError, match="requires op=Average"):
+            tape.gradient(y, [v])
+
+    def test_signature_parity_kwargs_accepted(self):
+        import tensorflow as tf
+
+        # Reference-signature kwargs are accepted (and ignored).
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1), name="dist",
+            device_dense="/gpu:0", device_sparse="/cpu:0",
+            num_groups=2, groups=None)
+        assert opt is not None
