@@ -494,11 +494,15 @@ class _DistributedOptimizer:
                  compression=Compression.none,
                  backward_passes_per_step: int = 1,
                  op=Average,
-                 sparse_as_dense: bool = False):
+                 sparse_as_dense: bool = False,
+                 gradient_predivide_factor: float = 1.0,
+                 process_set: Optional[ProcessSet] = None):
         self._opt = optimizer
         self._compression = compression
         self._op = op
         self._sparse_as_dense = sparse_as_dense
+        self._predivide = gradient_predivide_factor
+        self._ps = process_set
         self._bpps = max(1, backward_passes_per_step)
         self._pass_count = 0
         self._names = {}
@@ -542,7 +546,8 @@ class _DistributedOptimizer:
             else:
                 self._reduced_ids.add(id(p))
                 self._sparse_in_flight.append(
-                    (p, sparse_allreduce_async(p.grad, op=self._op)))
+                    (p, sparse_allreduce_async(p.grad, op=self._op,
+                                               process_set=self._ps)))
                 return
         self._reduced_ids.add(id(p))
         self._bucket.append(p)
@@ -566,7 +571,16 @@ class _DistributedOptimizer:
             c, ctx = self._compression.compress(_to_np(p.grad))
             compressed.append(c)
             ctxs.append(ctx)
-        outs = C.grouped_allreduce(compressed, op=self._op)
+        wire_op, pre, post = self._op, 1.0, 1.0
+        if self._predivide != 1.0:
+            # Reference: averaging split around the Sum wire.
+            n = self._ps.size() if self._ps is not None else size()
+            wire_op, pre = Sum, 1.0 / self._predivide
+            post = self._predivide / n
+        outs = C.grouped_allreduce(compressed, op=wire_op,
+                                   prescale_factor=pre,
+                                   postscale_factor=post,
+                                   process_set=self._ps)
         h = HandleManager.global_instance().allocate(outs)
         self._in_flight.append((h, params, ctxs))
         self.total_flushes += 1
@@ -701,10 +715,21 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          op=Average,
-                         sparse_as_dense: bool = False):
+                         gradient_predivide_factor: float = 1.0,
+                         num_groups: int = 0, groups=None,
+                         sparse_as_dense: bool = False,
+                         process_set: Optional[ProcessSet] = None):
     """op=Adasum returns the delta-semantics `_DistributedAdasumOptimizer`
     (reference: horovod/torch/optimizer.py DistributedOptimizer routes
-    op=Adasum to _DistributedAdasumOptimizer)."""
+    op=Adasum to _DistributedAdasumOptimizer).
+
+    `num_groups/groups` are accepted for signature parity and ignored
+    (fusion buckets by the live threshold); `process_set` scopes the
+    reduction.  `gradient_predivide_factor` splits the averaging around
+    a Sum wire (prescale 1/f, postscale f/size) like the reference."""
+    del num_groups, groups
+    if gradient_predivide_factor != 1.0 and op is not Average:
+        raise ValueError("gradient_predivide_factor requires op=Average")
     if op is Adasum:
         return _DistributedAdasumOptimizer(
             optimizer, named_parameters=named_parameters,
@@ -714,7 +739,9 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         optimizer, named_parameters=named_parameters,
         compression=compression,
         backward_passes_per_step=backward_passes_per_step, op=op,
-        sparse_as_dense=sparse_as_dense)
+        sparse_as_dense=sparse_as_dense,
+        gradient_predivide_factor=gradient_predivide_factor,
+        process_set=process_set)
 
 
 class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):

@@ -709,3 +709,31 @@ class TestMoreCollectiveGradients:
         (outs[0].sum() + outs[1].sum()).backward()
         torch.testing.assert_close(a.grad, torch.full((3,), 2.0))
         torch.testing.assert_close(b.grad, torch.full((2, 2), 5.0))
+
+
+class TestTorchPredivide:
+    def test_predivide_matches_plain_average(self):
+        def train_once(**kw):
+            torch.manual_seed(0)
+            net = torch.nn.Linear(4, 2)
+            opt = hvd_torch.DistributedOptimizer(
+                torch.optim.SGD(net.parameters(), lr=0.1),
+                named_parameters=net.named_parameters(), **kw)
+            x = torch.randn(8, 4, generator=torch.Generator().manual_seed(1))
+            loss = net(x).pow(2).mean()
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+            return [p.detach().clone() for p in net.parameters()]
+
+        plain = train_once()
+        pre = train_once(gradient_predivide_factor=4.0)
+        for a, b in zip(plain, pre):
+            torch.testing.assert_close(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_predivide_requires_average(self):
+        net = torch.nn.Linear(2, 1)
+        with pytest.raises(ValueError, match="requires op=Average"):
+            hvd_torch.DistributedOptimizer(
+                torch.optim.SGD(net.parameters(), lr=0.1),
+                op=hvd_torch.Sum, gradient_predivide_factor=2.0)
