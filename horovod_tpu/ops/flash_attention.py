@@ -19,8 +19,15 @@ Causal masking skips whole blocks strictly above the diagonal (they
 contribute nothing), so causal costs ~half the FLOPs of full.
 
 Layout: [B, T, H, D] API (matching parallel/sequence.py), kernels run
-on [B*H, T, D] with block_q = block_k = 128 lanes and D untiled (D is
+on [B*H, T, D] with block_q x block_k tiles (HOROVOD_FLASH_BLOCK_Q/K,
+default 128 each — the r04 on-chip sweep's pick) and D untiled (D is
 64-256 for every config here; padded to 128 lanes minimum by XLA).
+
+MXU precision: the score / output / gradient matmuls run in the INPUT
+dtype with f32 accumulation (`preferred_element_type`) — bf16 inputs
+hit the MXU at the bf16 rate instead of paying the 4x f32 penalty —
+while the online-softmax state (m, l, acc) and the p/ds intermediates
+stay f32, the standard flash-attention-2 precision contract.
 
 `interpret=True` under HOROVOD_PALLAS_INTERPRET=1 / CPU platform keeps
 the numerics CI-covered without a chip (tests/test_flash_attention.py
@@ -43,7 +50,31 @@ if PALLAS_AVAILABLE:
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
 _NEG = -1e30
-_BLOCK = 128  # q and k block rows (= lane width; min f32 sublane x 16)
+_BLOCK = 128  # default q/k block rows (= lane width)
+
+
+def _block_sizes(t: int):
+    """(block_q, block_k) from HOROVOD_FLASH_BLOCK_Q/K (default 128).
+
+    Clamped to T so short sequences never over-tile; both must divide T
+    (callers validate T % 128 == 0 and the env values are powers of two
+    in every supported sweep config)."""
+    bq = min(util.env_int("FLASH_BLOCK_Q", _BLOCK), t)
+    bk = min(util.env_int("FLASH_BLOCK_K", _BLOCK), t)
+    if bq <= 0 or bk <= 0:
+        raise ValueError(
+            f"HOROVOD_FLASH_BLOCK_Q/K must be positive, got ({bq}, {bk})")
+    return bq, bk
+
+
+def _tc_params():
+    """Mosaic grid semantics: batch*head and the outer seq dimension are
+    parallel; the innermost dimension is the sequential online-softmax /
+    accumulation walk ("arbitrary")."""
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
 def flash_routed(seq_len: int) -> bool:
@@ -73,17 +104,17 @@ def flash_routed(seq_len: int) -> bool:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _causal_mask(s, qi, ki):
-    """Mask scores strictly above the diagonal (only the diagonal block
-    actually mixes masked/unmasked entries; off-diagonal blocks are
-    skipped by the callers' pl.when gates)."""
-    q_pos = qi * _BLOCK + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    k_pos = ki * _BLOCK + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+def _causal_mask(s, qi, ki, bq, bk):
+    """Mask scores strictly above the diagonal (only blocks straddling
+    the diagonal actually mix masked/unmasked entries; blocks fully
+    above it are skipped by the callers' pl.when gates)."""
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     return jnp.where(q_pos >= k_pos, s, _NEG)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, num_kb):
+                m_scr, l_scr, acc_scr, *, scale, causal, num_kb, bq, bk):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -93,28 +124,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # Causal: blocks strictly above the diagonal contribute nothing.
-    run = (ki <= qi) if causal else (ki == ki)
+    run = (ki * bk < (qi + 1) * bq) if causal else (ki == ki)
 
     @pl.when(run)
     def _block():
-        q = q_ref[0].astype(jnp.float32)          # (bq, d)
-        k = k_ref[0].astype(jnp.float32)          # (bk, d)
-        v = v_ref[0].astype(jnp.float32)          # (bk, d)
+        v = v_ref[0]                              # (bk, d) input dtype
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk) f32
         if causal:
-            s = _causal_mask(s, qi, ki)
+            s = _causal_mask(s, qi, ki, bq, bk)
         m_prev = m_scr[...]                       # (bq, 128) lanes equal
         l_prev = l_scr[...]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, m_cur)         # (bq, 128)
-        p = jnp.exp(s - m_new[:, :1])              # (bq, bk)
+        p = jnp.exp(s - m_new[:, :1])              # (bq, bk) f32
         corr = jnp.exp(m_prev - m_new)             # (bq, 128)
         l_scr[...] = l_prev * corr + jnp.sum(
             p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * corr[:, :1] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = m_new
 
@@ -128,23 +157,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _fwd(q3, k3, v3, scale, causal):
-    """q3/k3/v3: (BH, T, D) with T % _BLOCK == 0.  Returns (o, lse)."""
+    """q3/k3/v3: (BH, T, D) with T % block == 0.  Returns (o, lse)."""
     bh, t, d = q3.shape
-    nq = t // _BLOCK
-    nk = t // _BLOCK
+    bq, bk = _block_sizes(t)
+    nq = t // bq
+    nk = t // bk
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               num_kb=nk)
+                               num_kb=nk, bq=bq, bk=bk)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, _BLOCK, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
@@ -153,10 +183,11 @@ def _fwd(q3, k3, v3, scale, causal):
             jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((_BLOCK, 128), jnp.float32),
-            pltpu.VMEM((_BLOCK, 128), jnp.float32),
-            pltpu.VMEM((_BLOCK, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
         ],
+        compiler_params=_tc_params(),
         interpret=_interpret(),
     )(q3, k3, v3)
     return o, lse
@@ -167,35 +198,32 @@ def _fwd(q3, k3, v3, scale, causal):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_scr, *, scale, causal, num_kb):
+                   dq_ref, acc_scr, *, scale, causal, num_kb, bq, bk):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    run = (ki <= qi) if causal else (ki == ki)
+    run = (ki * bk < (qi + 1) * bq) if causal else (ki == ki)
 
     @pl.when(run)
     def _block():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]
         lse = lse_ref[0, :, 0]                    # (bq,)
         delta = delta_ref[0, :, 0]                # (bq,)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, ki)
-        p = jnp.exp(s - lse[:, None])             # (bq, bk)
+            s = _causal_mask(s, qi, ki, bq, bk)
+        p = jnp.exp(s - lse[:, None])             # (bq, bk) f32
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)   # (bq, bk)
         ds = p * (dp - delta[:, None]) * scale
         acc_scr[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_kb - 1)
@@ -205,7 +233,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, num_qb):
+                    *, scale, causal, num_qb, bq, bk):
     ki, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -213,31 +241,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    run = (qi >= ki) if causal else (qi == qi)
+    run = ((qi + 1) * bq > ki * bk) if causal else (qi == qi)
 
     @pl.when(run)
     def _block():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, :, 0]
         delta = delta_ref[0, :, 0]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
         if causal:
-            s = _causal_mask(s, qi, ki)
-        p = jnp.exp(s - lse[:, None])
+            s = _causal_mask(s, qi, ki, bq, bk)
+        p = jnp.exp(s - lse[:, None])                     # f32
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # (bk, d)
 
     @pl.when(qi == num_qb - 1)
@@ -248,48 +274,52 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd(res, g):
     q3, k3, v3, o3, lse, scale, causal = res
-    do3 = g[0].astype(jnp.float32)
+    do3 = g[0]                                   # input dtype (MXU rate)
     dlse = g[1]                                              # (bh, t, 1)
     bh, t, d = q3.shape
-    nq = nk = t // _BLOCK
+    bq, bk = _block_sizes(t)
+    nq = t // bq
+    nk = t // bk
     # delta_i = sum_d dO_i * O_i (rowwise, the flash-2 correction term),
     # minus the lse cotangent: dL/ds_ij = p_ij*(dp_ij - delta_i + dlse_i),
     # so dlse folds into delta with a sign flip.
-    delta = jnp.sum(do3 * o3.astype(jnp.float32), axis=-1,
-                    keepdims=True)                           # (bh, t, 1)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)                  # (bh, t, 1)
     # custom_vjp materializes an unused-lse cotangent as zeros, so this
     # is a no-op (zeros subtraction) on the plain flash_attention path.
     delta = delta - dlse.astype(jnp.float32)
 
-    qspec = pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, qi, 0))
-    kspec = pl.BlockSpec((1, _BLOCK, d), lambda b, qi, ki: (b, ki, 0))
-    rowq = pl.BlockSpec((1, _BLOCK, 1), lambda b, qi, ki: (b, qi, 0))
+    qspec = pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0))
+    kspec = pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0))
+    rowq = pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          num_kb=nk),
+                          num_kb=nk, bq=bq, bk=bk),
         grid=(bh, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
-        scratch_shapes=[pltpu.VMEM((_BLOCK, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_tc_params(),
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)
 
     # dk/dv: grid walks (kb outer, qb inner sequential).
-    qspec2 = pl.BlockSpec((1, _BLOCK, d), lambda b, ki, qi: (b, qi, 0))
-    kspec2 = pl.BlockSpec((1, _BLOCK, d), lambda b, ki, qi: (b, ki, 0))
-    rowq2 = pl.BlockSpec((1, _BLOCK, 1), lambda b, ki, qi: (b, qi, 0))
+    qspec2 = pl.BlockSpec((1, bq, d), lambda b, ki, qi: (b, qi, 0))
+    kspec2 = pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0))
+    rowq2 = pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          num_qb=nq),
+                          num_qb=nq, bq=bq, bk=bk),
         grid=(bh, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
         out_specs=[kspec2, kspec2],
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, t, d), v3.dtype)],
-        scratch_shapes=[pltpu.VMEM((_BLOCK, d), jnp.float32),
-                        pltpu.VMEM((_BLOCK, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=_tc_params(),
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
@@ -323,9 +353,20 @@ def _check_and_to3(q, k, v):
             "flash_attention requires jax.experimental.pallas, which "
             "failed to import in this JAX install")
     B, T, H, D = q.shape
+    if not (q.dtype == k.dtype == v.dtype):
+        # The kernels run the MXU matmuls in the input dtype, so all
+        # three operands must agree (upcast q/k/v consistently upstream).
+        raise ValueError(
+            f"flash_attention needs matching q/k/v dtypes, got "
+            f"({q.dtype}, {k.dtype}, {v.dtype})")
     if T % _BLOCK:
         raise ValueError(
             f"flash_attention needs seq len % {_BLOCK} == 0, got {T}")
+    bq, bk = _block_sizes(T)
+    if T % bq or T % bk:
+        raise ValueError(
+            f"flash_attention: HOROVOD_FLASH_BLOCK_Q/K ({bq}, {bk}) "
+            f"must divide seq len {T}")
 
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)

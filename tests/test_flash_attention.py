@@ -70,6 +70,49 @@ class TestFlashBackward:
         assert g.shape == q.shape and bool(jnp.isfinite(g).all())
 
 
+class TestBlockSizes:
+    """HOROVOD_FLASH_BLOCK_Q/K (r04 kernel rework): non-default and
+    asymmetric tiles must agree with the oracle, incl. the causal
+    block-skip arithmetic for bq != bk."""
+
+    @pytest.mark.parametrize("bq,bk", [(256, 256), (256, 64), (64, 256)])
+    def test_fwd_bwd_match_oracle(self, bq, bk, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLASH_BLOCK_Q", str(bq))
+        monkeypatch.setenv("HOROVOD_FLASH_BLOCK_K", str(bk))
+        q, k, v = qkv()
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(fn(q, k, v, causal=True) ** 2)
+
+        np.testing.assert_allclose(
+            fa.flash_attention(q, k, v, causal=True),
+            seq.dense_attention_oracle(q, k, v, causal=True),
+            atol=2e-5, rtol=2e-5)
+        gf = jax.grad(loss(fa.flash_attention), argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss(seq.dense_attention_oracle),
+                      argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gd):
+            scale = float(jnp.abs(b).max())
+            np.testing.assert_allclose(
+                a, b, atol=3e-5 * max(1.0, scale), rtol=1e-4,
+                err_msg=f"d{name}")
+
+    def test_non_dividing_block_raises(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLASH_BLOCK_Q", "192")
+        q, k, v = qkv()
+        with pytest.raises(ValueError, match="must divide"):
+            fa.flash_attention(q, k, v)
+
+    def test_blocks_clamp_to_short_seq(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLASH_BLOCK_Q", "512")
+        monkeypatch.setenv("HOROVOD_FLASH_BLOCK_K", "512")
+        q, k, v = qkv(T=128)
+        np.testing.assert_allclose(
+            fa.flash_attention(q, k, v),
+            seq.dense_attention_oracle(q, k, v, causal=True),
+            atol=2e-5, rtol=2e-5)
+
+
 class TestDispatch:
     def test_full_attention_routes_to_flash_when_enabled(self, monkeypatch):
         q, k, v = qkv(T=128)
