@@ -16,5 +16,8 @@ Keras frontend in `horovod_tpu.tensorflow.keras`.
 from ..tensorflow.keras import *  # noqa: F401,F403
 from ..tensorflow.keras import (  # noqa: F401
     DistributedOptimizer,
-    callbacks,
+    load_model,
 )
+from . import callbacks  # noqa: F401  — the local submodule, so
+# `horovod_tpu.keras.callbacks` is one module object regardless of
+# whether it is reached by attribute or by import.

@@ -55,7 +55,8 @@ from ..common.basics import (  # noqa: F401
 )
 from ..common.exceptions import HorovodInternalError  # noqa: F401
 from ..ops import collectives as C
-from ..ops.collectives import Average, Sum, Adasum, barrier, join  # noqa: F401
+from ..ops.collectives import (  # noqa: F401
+    Average, Sum, Adasum, Min, Max, Product, barrier, join)
 from ..ops.compression import Compression  # noqa: F401
 
 try:  # pragma: no cover — mxnet not in the base image

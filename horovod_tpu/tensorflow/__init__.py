@@ -89,6 +89,7 @@ from ..ops.collectives import (  # noqa: F401
     Adasum,
     Min,
     Max,
+    Product,
     HandleManager,
     barrier,
     join,

@@ -149,6 +149,81 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
     return _DistributedKerasOptimizer.from_config(cfg)
 
 
+def _distributed_from_config_class(cls, compression, **dist_kwargs):
+    """A deserialization proxy for `cls`: from_config builds the base
+    optimizer and hands it to DistributedOptimizer (reference:
+    horovod/_keras/__init__.py load_model's wrap_optimizer)."""
+
+    class _Proxy(cls):
+        @classmethod
+        def from_config(klass, config, **kwargs):
+            base = cls.from_config(config, **kwargs)
+            return DistributedOptimizer(
+                base, compression=compression, **dist_kwargs)
+
+    _Proxy.__name__ = cls.__name__
+    return _Proxy
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none, **dist_kwargs):
+    """Load a saved Keras model with its optimizer wrapped in
+    `DistributedOptimizer` (reference: horovod/tensorflow/keras
+    `load_model` → horovod/_keras/__init__.py).
+
+    Every known `tf.keras.optimizers` class — plus any classes in
+    `custom_optimizers` — is registered so that whichever optimizer the
+    file deserializes comes back distributed.  Models saved while
+    compiled with a `DistributedOptimizer` are also handled (their
+    serialized class name is ``Distributed<Base>``).  `custom_objects`
+    entries take precedence, matching the reference's merge order.
+    Extra keyword arguments are forwarded to `DistributedOptimizer`.
+    """
+    import inspect
+
+    opt_classes = [
+        obj for _, obj in inspect.getmembers(tf.keras.optimizers)
+        if inspect.isclass(obj)
+        and issubclass(obj, tf.keras.optimizers.Optimizer)
+        and obj is not tf.keras.optimizers.Optimizer
+    ]
+    for cls in (custom_optimizers or []):
+        if cls not in opt_classes:
+            opt_classes.append(cls)
+
+    horovod_objects = {}
+    for cls in opt_classes:
+        proxy = _distributed_from_config_class(
+            cls, compression, **dist_kwargs)
+        for key in (cls.__name__, cls.__name__.lower(),
+                    "Distributed" + cls.__name__):
+            horovod_objects[key] = proxy
+    if custom_objects:
+        horovod_objects.update(custom_objects)
+    model = tf.keras.models.load_model(
+        filepath, custom_objects=horovod_objects)
+
+    # Keras 3 resolves BUILT-IN optimizer class names by module path,
+    # bypassing custom_objects (only custom/"Distributed*" names hit the
+    # proxies above) — so a model saved with a plain optimizer arrives
+    # unwrapped.  Wrap it now, transferring the restored slot state —
+    # unless the user's custom_objects explicitly claimed this class
+    # (the upstream merge-precedence opt-out).
+    opt = getattr(model, "optimizer", None)
+    user_claimed = opt is not None and custom_objects and (
+        type(opt).__name__ in custom_objects
+        or type(opt).__name__.lower() in custom_objects)
+    if opt is not None and not user_claimed and not hasattr(opt, "_hvd_op"):
+        wrapped = DistributedOptimizer(
+            opt, compression=compression, **dist_kwargs)
+        if getattr(opt, "built", False):
+            wrapped.build(model.trainable_variables)
+            for dst, src in zip(wrapped.variables, opt.variables):
+                dst.assign(src)
+        model.optimizer = wrapped
+    return model
+
+
 def broadcast_model(model, root_rank: int = 0) -> None:
     """Broadcast model (and, when built, optimizer) variables from root."""
     broadcast_variables(model.variables, root_rank=root_rank)

@@ -60,6 +60,9 @@ from ..ops.collectives import (  # noqa: F401
     Average,
     Sum,
     Adasum,
+    Min,
+    Max,
+    Product,
     HandleManager,
     barrier,
     join,

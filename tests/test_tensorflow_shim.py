@@ -356,6 +356,49 @@ class TestKerasOptimizer:
         h = model.fit(x, y, epochs=3, batch_size=16, verbose=0)
         assert h.history["loss"][-1] < h.history["loss"][0]
 
+    def test_load_model_wraps_optimizer(self, tmp_path):
+        # Reference: horovod/tensorflow/keras load_model — a model saved
+        # with a PLAIN optimizer deserializes with it Distributed-wrapped.
+        model = _tiny_model()
+        model.compile(optimizer=tf.keras.optimizers.Adam(1e-3), loss="mse")
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+        model.train_on_batch(x, y)   # build slot state before saving
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+        loaded = hvd_keras.load_model(path)
+        assert isinstance(loaded.optimizer, tf.keras.optimizers.Adam)
+        assert hasattr(loaded.optimizer, "_hvd_op")
+        # Restored slot state must survive the wrap (iterations == 1).
+        assert int(loaded.optimizer.iterations.numpy()) == 1
+        loaded.train_on_batch(x, y)
+
+    def test_load_model_custom_objects_opt_out(self, tmp_path):
+        # Upstream merge precedence: an explicit custom_objects entry
+        # for the optimizer class loads it UNWRAPPED.
+        model = _tiny_model()
+        model.compile(optimizer=tf.keras.optimizers.Adam(1e-3), loss="mse")
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+        loaded = hvd_keras.load_model(
+            path, custom_objects={"Adam": tf.keras.optimizers.Adam})
+        assert isinstance(loaded.optimizer, tf.keras.optimizers.Adam)
+        assert not hasattr(loaded.optimizer, "_hvd_op")
+
+    def test_load_model_roundtrips_distributed_optimizer(self, tmp_path):
+        # Saving while compiled WITH DistributedOptimizer stores class
+        # name "Distributed<Base>"; load_model must resolve that too.
+        model = _tiny_model()
+        model.compile(
+            optimizer=hvd_keras.DistributedOptimizer(
+                tf.keras.optimizers.SGD(0.1)),
+            loss="mse")
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+        loaded = hvd_keras.load_model(path)
+        assert isinstance(loaded.optimizer, tf.keras.optimizers.SGD)
+        assert hasattr(loaded.optimizer, "_hvd_op")
+
     def test_broadcast_model(self):
         model = _tiny_model()
         before = [w.numpy().copy() for w in model.variables]
