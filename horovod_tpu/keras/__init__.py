@@ -18,6 +18,7 @@ from ..tensorflow.keras import (  # noqa: F401
     DistributedOptimizer,
     load_model,
 )
-from . import callbacks  # noqa: F401  — the local submodule, so
-# `horovod_tpu.keras.callbacks` is one module object regardless of
-# whether it is reached by attribute or by import.
+from . import callbacks  # noqa: F401  — the local submodules, so
+# `horovod_tpu.keras.{callbacks,elastic}` are each one module object
+# regardless of whether they are reached by attribute or by import.
+from . import elastic  # noqa: F401

@@ -67,7 +67,9 @@ class TensorFlowKerasState(ObjectState):
     def __init__(self, model=None, optimizer: Optional[Any] = None,
                  **kwargs):
         self.model = model
-        self.optimizer = optimizer
+        # Reference default: a compiled model's own optimizer is part of
+        # the state (slot variables must restore/sync with the weights).
+        self.optimizer = optimizer or getattr(model, "optimizer", None)
         self._weights: Any = None
         self._opt_vars: Any = None
         super().__init__(**kwargs)

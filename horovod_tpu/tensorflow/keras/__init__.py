@@ -26,6 +26,7 @@ from .. import (  # noqa: F401
     _allreduce_grads,
 )
 from . import callbacks  # noqa: F401
+from . import elastic  # noqa: F401
 
 
 def DistributedOptimizer(optimizer, name: Optional[str] = None,

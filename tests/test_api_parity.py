@@ -50,13 +50,13 @@ SURFACES = {
     "horovod_tpu.tensorflow.keras": [
         "init", "shutdown", "size", "rank", "local_size", "local_rank",
         "allreduce", "allgather", "broadcast", "broadcast_object",
-        "DistributedOptimizer", "load_model", "callbacks",
+        "DistributedOptimizer", "load_model", "callbacks", "elastic",
         "Average", "Sum", "Adasum", "Compression",
         "mpi_built", "gloo_built", "nccl_built",
     ],
     "horovod_tpu.keras": [
         "init", "size", "rank", "DistributedOptimizer", "load_model",
-        "callbacks", "Compression",
+        "callbacks", "elastic", "Compression",
     ],
     "horovod_tpu.torch": BASICS + OPS_COMMON + [
         "allreduce_", "allreduce_async", "allreduce_async_",
@@ -112,6 +112,11 @@ def test_elastic_surface():
     import horovod_tpu.tensorflow.elastic as tfel
 
     assert hasattr(tfel, "TensorFlowKerasState")
+    import horovod_tpu.tensorflow.keras.elastic as kel
+
+    for s in ["KerasState", "CommitStateCallback",
+              "UpdateBatchStateCallback", "UpdateEpochStateCallback"]:
+        assert hasattr(kel, s), s
 
 
 def test_runner_surface():

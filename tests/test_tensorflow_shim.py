@@ -736,3 +736,90 @@ class TestGradientPredivide:
             device_dense="/gpu:0", device_sparse="/cpu:0",
             num_groups=2, groups=None)
         assert opt is not None
+
+
+class TestElasticKerasCallbacks:
+    """Reference: horovod/_keras/elastic.py callback trio + KerasState
+    (horovod/tensorflow/keras/elastic.py)."""
+
+    def _fit(self, callbacks, epochs=2, batches=4):
+        tf.keras.utils.set_random_seed(0)
+        model = _tiny_model()
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.01), loss="mse")
+        x = np.random.RandomState(0).randn(batches * 4, 4).astype(np.float32)
+        y = np.random.RandomState(1).randn(batches * 4, 2).astype(np.float32)
+        model.fit(x, y, epochs=epochs, batch_size=4, verbose=0,
+                  callbacks=callbacks)
+        return model
+
+    def test_commit_state_callback_commits_every_n(self):
+        import horovod_tpu.tensorflow.keras.elastic as ke
+
+        commits = []
+
+        class SpyState(ke.KerasState):
+            def commit(self):
+                commits.append(1)
+                super().commit()
+
+        state = SpyState(batch=0, epoch=0)
+        self._fit([ke.CommitStateCallback(state, batches_per_commit=2)],
+                  epochs=1, batches=4)
+        assert len(commits) == 2  # 4 batches / commit every 2
+
+    def test_update_batch_and_epoch_state(self):
+        import horovod_tpu.tensorflow.keras.elastic as ke
+
+        state = ke.KerasState(batch=0, epoch=0)
+        seen = []
+
+        class Spy(tf.keras.callbacks.Callback):
+            def on_batch_end(self, batch, logs=None):
+                seen.append(state.batch)
+
+        self._fit([ke.UpdateBatchStateCallback(state), Spy(),
+                   ke.UpdateEpochStateCallback(state)],
+                  epochs=2, batches=3)
+        assert state.epoch == 2
+        assert state.batch == 0          # reset at epoch end
+        assert max(seen) == 3            # tracked in-epoch progress
+
+    def test_keras_state_save_restore_roundtrip(self):
+        import horovod_tpu.tensorflow.keras.elastic as ke
+
+        model = _tiny_model()
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.01), loss="mse")
+        state = ke.KerasState(model, epoch=3)
+        w0 = [w.copy() for w in model.get_weights()]
+        state.save()
+        model.set_weights([w * 0 for w in w0])
+        state.epoch = 7
+        state.restore()
+        for a, b in zip(model.get_weights(), w0):
+            np.testing.assert_array_equal(a, b)
+        assert state.epoch == 3
+
+    def test_standalone_keras_namespace(self):
+        import horovod_tpu.keras.elastic as ske
+        import horovod_tpu.tensorflow.keras.elastic as ke
+
+        assert ske.CommitStateCallback is ke.CommitStateCallback
+        assert ske.KerasState is ke.KerasState
+
+    def test_keras_state_defaults_to_model_optimizer(self):
+        # Reference: TensorFlowKerasState snapshots a compiled model's
+        # own optimizer (slot variables) unless one is passed explicitly.
+        import horovod_tpu.tensorflow.keras.elastic as ke
+
+        model = _tiny_model()
+        model.compile(optimizer=tf.keras.optimizers.Adam(1e-3), loss="mse")
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+        model.train_on_batch(x, y)
+        state = ke.KerasState(model)
+        assert state.optimizer is model.optimizer
+        state.save()
+        it0 = int(model.optimizer.iterations.numpy())
+        model.train_on_batch(x, y)
+        state.restore()
+        assert int(model.optimizer.iterations.numpy()) == it0
