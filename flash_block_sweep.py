@@ -28,7 +28,12 @@ import json, sys, time
 sys.path.insert(0, {repo!r})
 import jax, jax.numpy as jnp
 
+import os
 T, B, BQ, BK = (int(a) for a in sys.argv[1:5])
+# The kernel reads tile sizes from env; set them from argv so a
+# hand-rerun of this child command reproduces the same sweep point.
+os.environ["HOROVOD_FLASH_BLOCK_Q"] = str(BQ)
+os.environ["HOROVOD_FLASH_BLOCK_K"] = str(BK)
 H, D = 8, 64
 q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, T, H, D),
                              jnp.bfloat16) for i in range(3))

@@ -16,7 +16,13 @@ accumulates dQ (grid over K blocks) and dK/dV (grid over Q blocks) in
 separate kernels, as in the flash-attention-2 formulation.
 
 Causal masking skips whole blocks strictly above the diagonal (they
-contribute nothing), so causal costs ~half the FLOPs of full.
+contribute nothing), so causal costs ~half the FLOPs of full.  A
+sliding `window` additionally skips blocks fully below the band
+(O(T * window) compute); GQA/MQA (fewer K/V heads than Q heads) is
+supported through the kv block index map — shared heads are read, not
+materialized.  Neither exists anywhere in the reference (it has no
+attention at all); they are part of this framework's long-context
+edge next to ring/Ulysses sequence parallelism.
 
 Layout: [B, T, H, D] API (matching parallel/sequence.py), kernels run
 on [B*H, T, D] with block_q x block_k tiles (HOROVOD_FLASH_BLOCK_Q/K,
@@ -104,17 +110,40 @@ def flash_routed(seq_len: int) -> bool:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _causal_mask(s, qi, ki, bq, bk):
-    """Mask scores strictly above the diagonal (only blocks straddling
-    the diagonal actually mix masked/unmasked entries; blocks fully
-    above it are skipped by the callers' pl.when gates)."""
+def _apply_mask(s, qi, ki, bq, bk, causal, window):
+    """Mask scores above the diagonal (causal) and, with a sliding
+    `window`, more than window-1 positions below it.  Only blocks
+    straddling a boundary actually mix masked/unmasked entries; blocks
+    fully outside are skipped by the callers' pl.when gates."""
+    if not causal and window is None:
+        return s
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(q_pos >= k_pos, s, _NEG)
+    keep = None
+    if causal:
+        keep = q_pos >= k_pos
+    if window is not None:
+        wkeep = (q_pos - k_pos) < window
+        keep = wkeep if keep is None else jnp.logical_and(keep, wkeep)
+    return jnp.where(keep, s, _NEG)
+
+
+def _block_gate(qi, ki, bq, bk, causal, window):
+    """Whether block (qi, ki) can contain any unmasked entry: its k
+    range [ki*bk, (ki+1)*bk) must intersect the allowed band
+    [q - window + 1, q] for some q in [qi*bq, (qi+1)*bq)."""
+    run = (ki == ki)  # all-true of the right traced type
+    if causal:
+        run = ki * bk < (qi + 1) * bq
+    if window is not None:
+        run = jnp.logical_and(
+            run, (ki + 1) * bk - 1 >= qi * bq - (window - 1))
+    return run
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, num_kb, bq, bk):
+                m_scr, l_scr, acc_scr, *, scale, causal, window,
+                num_kb, bq, bk):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -123,8 +152,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Causal: blocks strictly above the diagonal contribute nothing.
-    run = (ki * bk < (qi + 1) * bq) if causal else (ki == ki)
+    # Blocks fully outside the causal / sliding-window band are skipped.
+    run = _block_gate(qi, ki, bq, bk, causal, window)
 
     @pl.when(run)
     def _block():
@@ -132,8 +161,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk) f32
-        if causal:
-            s = _causal_mask(s, qi, ki, bq, bk)
+        s = _apply_mask(s, qi, ki, bq, bk, causal, window)
         m_prev = m_scr[...]                       # (bq, 128) lanes equal
         l_prev = l_scr[...]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
@@ -156,21 +184,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, :, 0] = (m_scr[...] + jnp.log(l_scr[...]))[:, 0]
 
 
-def _fwd(q3, k3, v3, scale, causal):
-    """q3/k3/v3: (BH, T, D) with T % block == 0.  Returns (o, lse)."""
+def _fwd(q3, k3, v3, scale, causal, window, group):
+    """q3: (B*Hq, T, D), k3/v3: (B*Hkv, T, D) with T % block == 0 and
+    group = Hq // Hkv.  GQA never materializes repeated K/V: the index
+    map points q-head b at kv-head b // group.  Returns (o, lse)."""
     bh, t, d = q3.shape
     bq, bk = _block_sizes(t)
     nq = t // bq
     nk = t // bk
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               num_kb=nk, bq=bq, bk=bk)
+                               window=window, num_kb=nk, bq=bq, bk=bk)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda b, qi, ki: (b // group, ki, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda b, qi, ki: (b // group, ki, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
@@ -198,14 +230,15 @@ def _fwd(q3, k3, v3, scale, causal):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_scr, *, scale, causal, num_kb, bq, bk):
+                   dq_ref, acc_scr, *, scale, causal, window,
+                   num_kb, bq, bk):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    run = (ki * bk < (qi + 1) * bq) if causal else (ki == ki)
+    run = _block_gate(qi, ki, bq, bk, causal, window)
 
     @pl.when(run)
     def _block():
@@ -215,8 +248,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q_ref[0], k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_mask(s, qi, ki, bq, bk)
+        s = _apply_mask(s, qi, ki, bq, bk, causal, window)
         p = jnp.exp(s - lse[:, None])             # (bq, bk) f32
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -233,7 +265,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, num_qb, bq, bk):
+                    *, scale, causal, window, num_qb, bq, bk):
     ki, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -241,7 +273,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    run = ((qi + 1) * bq > ki * bk) if causal else (qi == qi)
+    run = _block_gate(qi, ki, bq, bk, causal, window)
 
     @pl.when(run)
     def _block():
@@ -252,8 +284,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
-        if causal:
-            s = _causal_mask(s, qi, ki, bq, bk)
+        s = _apply_mask(s, qi, ki, bq, bk, causal, window)
         p = jnp.exp(s - lse[:, None])                     # f32
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -273,7 +304,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(res, g):
-    q3, k3, v3, o3, lse, scale, causal = res
+    q3, k3, v3, o3, lse, scale, causal, window, group = res
     do3 = g[0]                                   # input dtype (MXU rate)
     dlse = g[1]                                              # (bh, t, 1)
     bh, t, d = q3.shape
@@ -290,12 +321,13 @@ def _bwd(res, g):
     delta = delta - dlse.astype(jnp.float32)
 
     qspec = pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0))
-    kspec = pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0))
+    kspec = pl.BlockSpec((1, bk, d),
+                         lambda b, qi, ki: (b // group, ki, 0))
     rowq = pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          num_kb=nk, bq=bq, bk=bk),
+                          window=window, num_kb=nk, bq=bq, bk=bk),
         grid=(bh, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
         out_specs=qspec,
@@ -305,23 +337,33 @@ def _bwd(res, g):
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)
 
-    # dk/dv: grid walks (kb outer, qb inner sequential).
+    # dk/dv: grid walks (kb outer, qb inner sequential).  Under GQA the
+    # kernel produces PER-Q-HEAD partials (f32) and the group-sum
+    # happens outside — revisiting one kv output block from g different
+    # grid slots would be an accumulation race the Pallas output model
+    # does not allow.
     qspec2 = pl.BlockSpec((1, bq, d), lambda b, ki, qi: (b, qi, 0))
-    kspec2 = pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0))
+    kspec2 = pl.BlockSpec((1, bk, d),
+                          lambda b, ki, qi: (b // group, ki, 0))
+    ospec2 = pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0))
     rowq2 = pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0))
+    out_dt = (k3.dtype, v3.dtype) if group == 1 else (jnp.float32,) * 2
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          num_qb=nq, bq=bq, bk=bk),
+                          window=window, num_qb=nq, bq=bq, bk=bk),
         grid=(bh, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
-        out_specs=[kspec2, kspec2],
-        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
-                   jax.ShapeDtypeStruct((bh, t, d), v3.dtype)],
+        out_specs=[ospec2, ospec2],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), out_dt[0]),
+                   jax.ShapeDtypeStruct((bh, t, d), out_dt[1])],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         compiler_params=_tc_params(),
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)
+    if group > 1:
+        dk = dk.reshape(-1, group, t, d).sum(axis=1).astype(k3.dtype)
+        dv = dv.reshape(-1, group, t, d).sum(axis=1).astype(v3.dtype)
     return dq, dk, dv
 
 
@@ -329,30 +371,38 @@ def _bwd(res, g):
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash3(q3, k3, v3, causal):
-    return _fwd(q3, k3, v3, 1.0 / math.sqrt(q3.shape[-1]), causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash3(q3, k3, v3, causal, window, group):
+    return _fwd(q3, k3, v3, 1.0 / math.sqrt(q3.shape[-1]), causal,
+                window, group)
 
 
-def _flash3_fwd(q3, k3, v3, causal):
+def _flash3_fwd(q3, k3, v3, causal, window, group):
     scale = 1.0 / math.sqrt(q3.shape[-1])
-    o, lse = _fwd(q3, k3, v3, scale, causal)
-    return (o, lse), (q3, k3, v3, o, lse, scale, causal)
+    o, lse = _fwd(q3, k3, v3, scale, causal, window, group)
+    return (o, lse), (q3, k3, v3, o, lse, scale, causal, window, group)
 
 
-def _flash3_bwd(causal, res, g):
+def _flash3_bwd(causal, window, group, res, g):
     return _bwd(res, g)
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
-def _check_and_to3(q, k, v):
+def _check_and_to3(q, k, v, window=None, causal=True):
     if not PALLAS_AVAILABLE:
         raise RuntimeError(
             "flash_attention requires jax.experimental.pallas, which "
             "failed to import in this JAX install")
     B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    if k.shape != v.shape or k.shape[0] != B or k.shape[1] != T \
+            or k.shape[3] != D or H % max(Hkv, 1):
+        raise ValueError(
+            f"flash_attention: incompatible shapes q={tuple(q.shape)} "
+            f"k={tuple(k.shape)} v={tuple(v.shape)} (GQA needs "
+            f"n_heads % n_kv_heads == 0)")
     if not (q.dtype == k.dtype == v.dtype):
         # The kernels run the MXU matmuls in the input dtype, so all
         # three operands must agree (upcast q/k/v consistently upstream).
@@ -367,32 +417,52 @@ def _check_and_to3(q, k, v):
         raise ValueError(
             f"flash_attention: HOROVOD_FLASH_BLOCK_Q/K ({bq}, {bk}) "
             f"must divide seq len {T}")
+    if window is not None:
+        if not causal:
+            raise ValueError(
+                "flash_attention: window requires causal=True")
+        if int(window) < 1:
+            raise ValueError(f"flash_attention: window must be >= 1, "
+                             f"got {window}")
 
-    def to3(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    def to3(x, h):
+        return x.transpose(0, 2, 1, 3).reshape(B * h, T, D)
 
-    return (B, T, H, D), to3(q), to3(k), to3(v)
+    return (B, T, H, Hkv, D), to3(q, H), to3(k, Hkv), to3(v, Hkv)
 
 
-def flash_attention(q, k, v, causal: bool = True):
+def flash_attention(q, k, v, causal: bool = True, window=None):
     """Flash attention on [B, T, H, D] (same convention as
     parallel/sequence.py), differentiable, O(T) memory.
 
     T must be a multiple of 128 (pad upstream; the transformer configs
     here use power-of-two T).  Numerics: f32 accumulation; output in
-    q.dtype; matches `parallel.sequence.full_attention` to f32 noise.
-    """
-    (B, T, H, D), q3, k3, v3 = _check_and_to3(q, k, v)
-    o3, _ = _flash3(q3, k3, v3, causal)
+    q.dtype; matches `parallel.sequence.dense_attention_oracle` to f32
+    noise.
+
+    GQA/MQA: k/v may carry fewer heads than q (H % Hkv == 0); q head h
+    attends kv head h // (H // Hkv).  The kernel reads the shared K/V
+    blocks through its index map — the repeated heads are never
+    materialized in HBM.
+
+    `window` (requires causal): sliding-window attention — each query
+    sees at most the last `window` keys; blocks fully outside the band
+    are skipped on both sides, so compute scales O(T * window)."""
+    window = None if window is None else int(window)
+    (B, T, H, Hkv, D), q3, k3, v3 = _check_and_to3(q, k, v, window,
+                                                   causal)
+    o3, _ = _flash3(q3, k3, v3, causal, window, H // Hkv)
     return o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 
-def flash_attention_lse(q, k, v, causal: bool = True):
+def flash_attention_lse(q, k, v, causal: bool = True, window=None):
     """Like `flash_attention` but also returns the per-row logsumexp
     (f32, [B, T, H]) — the merge weight ring attention needs to combine
     per-pair partial results (both outputs are differentiable)."""
-    (B, T, H, D), q3, k3, v3 = _check_and_to3(q, k, v)
-    o3, lse3 = _flash3(q3, k3, v3, causal)
+    window = None if window is None else int(window)
+    (B, T, H, Hkv, D), q3, k3, v3 = _check_and_to3(q, k, v, window,
+                                                   causal)
+    o3, lse3 = _flash3(q3, k3, v3, causal, window, H // Hkv)
     o = o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
     lse = lse3.reshape(B, H, T).transpose(0, 2, 1)
     return o, lse

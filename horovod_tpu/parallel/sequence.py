@@ -187,18 +187,33 @@ def full_attention(q, k, v, causal: bool = True, q_offset: int = 0):
     return dense_attention_oracle(q, k, v, causal=causal, q_offset=q_offset)
 
 
-def dense_attention_oracle(q, k, v, causal: bool = True, q_offset: int = 0):
+def dense_attention_oracle(q, k, v, causal: bool = True, q_offset: int = 0,
+                           window=None):
     """Numerical oracle: the O(T^2) dense softmax attention, guaranteed
     never to route through the flash kernel regardless of
-    HOROVOD_FLASH_ATTENTION — the fixed point flash is tested against."""
-    B, Tq, H, D = q.shape
-    Tk = k.shape[1]
+    HOROVOD_FLASH_ATTENTION — the fixed point flash is tested against.
+
+    Supports the kernel's GQA/MQA convention (k/v with fewer heads than
+    q, Hq % Hkv == 0, q head h attending kv head h // (Hq//Hkv)) and
+    causal sliding-window masking (`window`: each query sees at most the
+    last `window` keys)."""
+    B, Tq, Hq, D = q.shape
+    Tk, Hkv = k.shape[1], k.shape[2]
+    if Hq != Hkv:
+        k = jnp.repeat(k, Hq // Hkv, axis=2)
+        v = jnp.repeat(v, Hq // Hkv, axis=2)
     scale = 1.0 / (D ** 0.5)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    q_pos = q_offset + jnp.arange(Tq)
+    k_pos = jnp.arange(Tk)
+    mask = None
     if causal:
-        q_pos = q_offset + jnp.arange(Tq)
-        mask = q_pos[:, None] >= jnp.arange(Tk)[None, :]
+        mask = q_pos[:, None] >= k_pos[None, :]
+    if window is not None:
+        wmask = (q_pos[:, None] - k_pos[None, :]) < window
+        mask = wmask if mask is None else (mask & wmask)
+    if mask is not None:
         s = jnp.where(mask[None, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))

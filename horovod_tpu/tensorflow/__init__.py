@@ -518,6 +518,27 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     return _bo(obj, root_rank=root_rank)
 
 
+def broadcast_object_fn(root_rank: int = 0):
+    """Reference horovod/tensorflow/functions.py `broadcast_object_fn`:
+    returns a callable capturing `root_rank` (the session-reusable form
+    of broadcast_object)."""
+
+    def _fn(obj: Any) -> Any:
+        return broadcast_object(obj, root_rank=root_rank)
+
+    return _fn
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
+    """Reference horovod/tensorflow/functions.py `allgather_object`:
+    gather an arbitrary picklable object from every rank, returning the
+    rank-ordered list.  `name` is accepted for signature parity (the
+    compiled path needs no tensor-name tag)."""
+    del name
+    from ..ops.functions import allgather_object as _ao
+    return _ao(obj)
+
+
 def broadcast_global_variables(root_rank: int = 0) -> None:
     """TF1-compat API: broadcast every global variable (reference:
     broadcast_global_variables)."""

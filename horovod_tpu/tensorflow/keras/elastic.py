@@ -30,6 +30,9 @@ class CommitStateCallback(tf.keras.callbacks.Callback):
 
     def __init__(self, state, batches_per_commit: int = 1):
         super().__init__()
+        if int(batches_per_commit) < 1:
+            raise ValueError(
+                f"batches_per_commit must be >= 1, got {batches_per_commit}")
         self.state = state
         self.batches_per_commit = int(batches_per_commit)
         self.batches_remaining = self.batches_per_commit

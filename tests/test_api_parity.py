@@ -43,7 +43,8 @@ SURFACES = {
         "grouped_allgather", "grouped_reducescatter",
         "DistributedOptimizer", "DistributedGradientTape",
         "broadcast_variables", "broadcast_global_variables",
-        "broadcast_object", "SyncBatchNormalization", "elastic",
+        "broadcast_object", "broadcast_object_fn", "allgather_object",
+        "SyncBatchNormalization", "elastic",
         "rank_op", "local_rank_op", "size_op", "local_size_op",
         "process_set_included_op", "poll", "synchronize",
     ],
