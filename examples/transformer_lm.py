@@ -38,6 +38,10 @@ def main():
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--moe-every", type=int, default=0)
     p.add_argument("--attn", choices=["ring", "ulysses"], default="ring")
+    p.add_argument("--n-kv-heads", type=int, default=0,
+                   help="GQA/MQA kv head count (0 = MHA)")
+    p.add_argument("--attn-window", type=int, default=0,
+                   help="causal sliding window (0 = full; dp-only)")
     p.add_argument("--steps", type=int, default=20)
     args = p.parse_args()
 
@@ -47,7 +51,8 @@ def main():
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         d_head=args.d_model // args.n_heads, d_ff=args.d_model * 4,
         n_layers=args.n_layers, moe_every=args.moe_every,
-        attn_impl=args.attn)
+        attn_impl=args.attn, n_kv_heads=args.n_kv_heads,
+        attn_window=args.attn_window)
 
     params = transformer_init(jax.random.PRNGKey(0), cfg)
     params = stack_for_pipeline(params, args.pp, cfg)

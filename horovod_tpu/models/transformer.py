@@ -45,6 +45,22 @@ class TransformerConfig:
     compute_dtype: Any = jnp.bfloat16
     attn_impl: str = "ring"     # "ring" | "ulysses" (used when sp > 1)
     aux_loss_weight: float = 0.01
+    n_kv_heads: int = 0         # 0 = MHA; else GQA/MQA kv head count
+    attn_window: int = 0        # 0 = full causal; else sliding window
+
+    def __post_init__(self):
+        if self.attn_window < 0:
+            raise ValueError(
+                f"attn_window must be >= 0, got {self.attn_window}")
+        if self.n_kv_heads < 0 or (
+                self.n_kv_heads and self.n_heads % self.n_kv_heads):
+            raise ValueError(
+                f"n_kv_heads ({self.n_kv_heads}) must be 0 (MHA) or a "
+                f"divisor of n_heads ({self.n_heads})")
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 # ---------------------------------------------------------------------------
@@ -69,8 +85,8 @@ def transformer_init(key, cfg: TransformerConfig) -> Dict:
             "ln1": {"scale": jnp.ones((Lr, D), jnp.float32)},
             "ln2": {"scale": jnp.ones((Lr, D), jnp.float32)},
             "wq": norm(keys[1], (Lr, D, H, Dh), s_d),
-            "wk": norm(keys[2], (Lr, D, H, Dh), s_d),
-            "wv": norm(keys[3], (Lr, D, H, Dh), s_d),
+            "wk": norm(keys[2], (Lr, D, cfg.kv_heads, Dh), s_d),
+            "wv": norm(keys[3], (Lr, D, cfg.kv_heads, Dh), s_d),
             "wo": norm(keys[4], (Lr, H, Dh, D), s_hd),
             "wi": norm(keys[5], (Lr, D, F), s_d),
             "wg": norm(keys[6], (Lr, D, F), s_d),
@@ -124,13 +140,27 @@ def _attention_block(lp, x, positions, cfg, tp_axis, sp_axis):
     v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dt))
     q = _rope(q, positions, cfg.rope_theta).astype(dt)
     k = _rope(k, positions, cfg.rope_theta).astype(dt)
+    window = cfg.attn_window or None
     if sp_axis is not None:
+        # The ring/Ulysses shard kernels operate on equal head counts
+        # (heads are the all_to_all currency); under GQA repeat kv to
+        # full H here — the wire/FLOP cost is unchanged vs MHA, GQA
+        # still saves its parameters and kv-cache.  Sliding windows
+        # under sequence parallelism would need per-pair offset bands,
+        # which are NOT implemented: window configs must run without
+        # sp (the config error below, not a silent fallback).
+        k, v = seq_mod.repeat_kv(q, k, v)
+        if window is not None:
+            raise NotImplementedError(
+                "attn_window under sequence parallelism is not "
+                "supported yet (per-pair window bands); run window "
+                "configs without sp")
         if cfg.attn_impl == "ulysses":
             o = seq_mod.ulysses_attention_shard(q, k, v, sp_axis)
         else:
             o = seq_mod.ring_attention_shard(q, k, v, sp_axis)
     else:
-        o = seq_mod.full_attention(q, k, v, causal=True)
+        o = seq_mod.full_attention(q, k, v, causal=True, window=window)
     out = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(dt))
     if tp_axis is not None:
         out = lax.psum(out, tp_axis)   # row-parallel wo
@@ -359,7 +389,10 @@ def stack_for_pipeline(params: Dict, pp: int, cfg: TransformerConfig) -> Dict:
 
 def transformer_pspecs(cfg: TransformerConfig, pp: int = 1) -> Dict:
     """PartitionSpec tree matching `transformer_init` output (after
-    `stack_for_pipeline` when pp > 1)."""
+    `stack_for_pipeline` when pp > 1).
+
+    wk/wv shard their head axis over tp like wq; under GQA this
+    requires n_kv_heads % tp == 0 (the standard GQA+TP constraint)."""
     from jax.sharding import PartitionSpec as P
 
     lead = ("pp",) if pp > 1 else ()

@@ -107,6 +107,14 @@ class TestExamples:
              "--seq-len", "33", "--batch-size", "8", "--steps", "2"])
         assert "tok/s" in out
 
+    def test_transformer_lm_gqa_window(self):
+        out = _run_example(
+            "transformer_lm.py",
+            ["--dp", "8", "--n-kv-heads", "2", "--attn-window", "16",
+             "--d-model", "64", "--n-layers", "2", "--n-heads", "4",
+             "--seq-len", "32", "--batch-size", "8", "--steps", "2"])
+        assert "tok/s" in out
+
     def test_elastic_resnet_under_driver(self, tmp_path):
         script = tmp_path / "discover.sh"
         script.write_text("#!/bin/sh\necho localhost:1\n")
