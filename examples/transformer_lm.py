@@ -41,7 +41,7 @@ def main():
     p.add_argument("--n-kv-heads", type=int, default=0,
                    help="GQA/MQA kv head count (0 = MHA)")
     p.add_argument("--attn-window", type=int, default=0,
-                   help="causal sliding window (0 = full; dp-only)")
+                   help="causal sliding window (0 = full)")
     p.add_argument("--steps", type=int, default=20)
     args = p.parse_args()
 

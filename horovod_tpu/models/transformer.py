@@ -145,20 +145,17 @@ def _attention_block(lp, x, positions, cfg, tp_axis, sp_axis):
         # The ring/Ulysses shard kernels operate on equal head counts
         # (heads are the all_to_all currency); under GQA repeat kv to
         # full H here — the wire/FLOP cost is unchanged vs MHA, GQA
-        # still saves its parameters and kv-cache.  Sliding windows
-        # under sequence parallelism would need per-pair offset bands,
-        # which are NOT implemented: window configs must run without
-        # sp (the config error below, not a silent fallback).
+        # still saves its parameters and kv-cache.  Windows ride the
+        # XLA blockwise ring (per-pair position bands) or Ulysses'
+        # locally-full sequence; the flash per-pair engine serves the
+        # window-free configs.
         k, v = seq_mod.repeat_kv(q, k, v)
-        if window is not None:
-            raise NotImplementedError(
-                "attn_window under sequence parallelism is not "
-                "supported yet (per-pair window bands); run window "
-                "configs without sp")
         if cfg.attn_impl == "ulysses":
-            o = seq_mod.ulysses_attention_shard(q, k, v, sp_axis)
+            o = seq_mod.ulysses_attention_shard(q, k, v, sp_axis,
+                                                window=window)
         else:
-            o = seq_mod.ring_attention_shard(q, k, v, sp_axis)
+            o = seq_mod.ring_attention_shard(q, k, v, sp_axis,
+                                             window=window)
     else:
         o = seq_mod.full_attention(q, k, v, causal=True, window=window)
     out = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(dt))

@@ -75,12 +75,12 @@ def transformer_dryrun(n_devices: int) -> None:
             batch=4, seqlen=33)  # targets drop 1 -> seq 32 shards by sp=2
         run("dp2*pp2*ep2 moe", dict(dp=-1, pp=2, ep=2),
             dict(moe_every=2, n_experts=4), batch=8, seqlen=17)
-        # GQA (2 kv heads under 4 q heads) + sliding window on the
-        # dp-only path (window under sp raises NotImplementedError —
-        # no sp axis in this config).
-        run("dp8 gqa+window", dict(dp=-1),
+        # GQA (2 kv heads under 4 q heads) + sliding window, with the
+        # window riding the XLA blockwise ring's per-pair position
+        # bands across sp=2 shards.
+        run("dp4*sp2 gqa+window", dict(dp=-1, sp=2),
             dict(n_kv_heads=2, attn_window=8, n_layers=2),
-            batch=8, seqlen=17)
+            batch=4, seqlen=17)
         # Flash-kernel ring attention: T=256 over sp=2 gives 128-aligned
         # local shards, so ring_attention_shard routes its per-pair
         # block math through the Pallas flash kernel (interpret mode on

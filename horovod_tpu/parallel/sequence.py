@@ -34,16 +34,23 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG = -1e30
 
 
-def _block_attn_update(q, k, v, o, m, l, q_pos, k_pos, scale, causal):
+def _block_attn_update(q, k, v, o, m, l, q_pos, k_pos, scale, causal,
+                       window=None):
     """One online-softmax update of (o, m, l) with a K/V block.
 
     Shapes: q [B,Tq,H,D], k/v [B,Tk,H,D], o [B,Tq,H,D] f32,
-    m/l [B,H,Tq] f32.  Returns updated (o, m, l).
+    m/l [B,H,Tq] f32.  Returns updated (o, m, l).  `window` adds the
+    causal sliding-window band (q - k < window) to the mask.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    mask = None
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]
+    if window is not None:
+        wmask = (q_pos[:, None] - k_pos[None, :]) < window
+        mask = wmask if mask is None else (mask & wmask)
+    if mask is not None:
         s = jnp.where(mask[None, None], s, _NEG)
     m_new = jnp.maximum(m, s.max(axis=-1))
     # exp of _NEG-filled rows underflows to 0 — no NaN path.
@@ -115,7 +122,8 @@ def ring_flash_attention_shard(q, k, v, axis: str, causal: bool = True):
     return o.astype(q.dtype)
 
 
-def ring_attention_shard(q, k, v, axis: str, causal: bool = True):
+def ring_attention_shard(q, k, v, axis: str, causal: bool = True,
+                         window=None):
     """Ring attention, called inside shard_map with `axis` in scope.
 
     Per-shard shapes: q/k/v [B, T_local, H, D] (the global sequence is
@@ -130,7 +138,12 @@ def ring_attention_shard(q, k, v, axis: str, causal: bool = True):
     """
     from ..ops import flash_attention as fa
 
-    if fa.flash_routed(q.shape[1]) and q.shape[1] % 128 == 0:
+    if window is not None and int(window) < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    if (window is None and fa.flash_routed(q.shape[1])
+            and q.shape[1] % 128 == 0):
+        # The flash per-pair engine has no q_offset/window banding; the
+        # XLA blockwise path below carries window configs.
         return ring_flash_attention_shard(q, k, v, axis, causal=causal)
     sp = lax.psum(1, axis)
     idx = lax.axis_index(axis)
@@ -147,8 +160,26 @@ def ring_attention_shard(q, k, v, axis: str, causal: bool = True):
         o, m, l, kb, vb = carry
         kv_idx = (idx - step) % sp
         k_pos = kv_idx * Tl + jnp.arange(Tl)
-        o, m, l = _block_attn_update(q, kb, vb, o, m, l, q_pos, k_pos,
-                                     scale, causal)
+
+        def _update(oml):
+            return _block_attn_update(q, kb, vb, *oml, q_pos, k_pos,
+                                      scale, causal, window)
+
+        if causal or window is not None:
+            # Skip pairs wholly outside the causal / window band (the
+            # same dead-pair skip the flash ring engine does with its
+            # lax.switch) — with a window this is what makes per-device
+            # compute O(Tl * (window + Tl)) instead of O(Tl * T).
+            run = jnp.asarray(True)
+            if causal:
+                run = kv_idx <= idx
+            if window is not None:
+                run = jnp.logical_and(
+                    run,
+                    (kv_idx + 1) * Tl - 1 >= idx * Tl - (window - 1))
+            o, m, l = lax.cond(run, _update, lambda oml: oml, (o, m, l))
+        else:
+            o, m, l = _update((o, m, l))
         # Rotate K/V around the ring; the last rotation is dead but keeps
         # the loop body uniform (XLA overlaps it with the epilogue).
         kb = lax.ppermute(kb, axis, perm)
@@ -235,11 +266,14 @@ def dense_attention_oracle(q, k, v, causal: bool = True, q_offset: int = 0,
     return out.astype(q.dtype)
 
 
-def ulysses_attention_shard(q, k, v, axis: str, causal: bool = True):
+def ulysses_attention_shard(q, k, v, axis: str, causal: bool = True,
+                            window=None):
     """Ulysses attention inside shard_map: all_to_all tokens→heads, dense
     attention over the full sequence on H/sp local heads, all_to_all back.
 
     Per-shard q/k/v: [B, T_local, H, D] with H divisible by the axis size.
+    The full sequence is local after the re-shard, so `window` applies
+    directly.
     """
     sp = lax.psum(1, axis)
     H = q.shape[2]
@@ -255,28 +289,32 @@ def ulysses_attention_shard(q, k, v, axis: str, causal: bool = True):
                               tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    out = full_attention(qh, kh, vh, causal=causal)
+    out = full_attention(qh, kh, vh, causal=causal, window=window)
     return to_tokens(out)
 
 
-def _mesh_wrap(shard_fn, mesh: Mesh, axis: str, q, k, v, causal: bool):
+def _mesh_wrap(shard_fn, mesh: Mesh, axis: str, q, k, v, causal: bool,
+               window=None):
     spec = P(None, axis, None, None)
     fn = shard_map(
-        functools.partial(shard_fn, axis=axis, causal=causal),
+        functools.partial(shard_fn, axis=axis, causal=causal,
+                          window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                   causal: bool = True):
+                   causal: bool = True, window=None):
     """Mesh-level ring attention: q/k/v [B, T, H, D] with T sharded over
     `axis`."""
-    return _mesh_wrap(ring_attention_shard, mesh, axis, q, k, v, causal)
+    return _mesh_wrap(ring_attention_shard, mesh, axis, q, k, v, causal,
+                      window)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                      causal: bool = True):
+                      causal: bool = True, window=None):
     """Mesh-level Ulysses attention: q/k/v [B, T, H, D] with T sharded
     over `axis`."""
-    return _mesh_wrap(ulysses_attention_shard, mesh, axis, q, k, v, causal)
+    return _mesh_wrap(ulysses_attention_shard, mesh, axis, q, k, v,
+                      causal, window)
