@@ -1,0 +1,62 @@
+"""KV-cache text generation on the flagship transformer.
+
+Completes the model family's inference path (the reference has no
+generation at all): scan-compiled incremental decode with a GQA-sized
+cache and optional sliding-window attention.
+
+    python examples/generate.py --n-kv-heads 2 --attn-window 64 \
+        --prompt-len 8 --new-tokens 32
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models import (
+    TransformerConfig,
+    transformer_generate,
+    transformer_init,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--n-layers", type=int, default=4)
+    p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--n-kv-heads", type=int, default=0)
+    p.add_argument("--attn-window", type=int, default=0)
+    p.add_argument("--vocab", type=int, default=1024)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    args = p.parse_args()
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        d_head=args.d_model // args.n_heads, d_ff=4 * args.d_model,
+        n_layers=args.n_layers, n_kv_heads=args.n_kv_heads,
+        attn_window=args.attn_window)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1),
+                                (args.batch, args.prompt_len), 0, cfg.vocab_size)
+
+    rng = jax.random.PRNGKey(2) if args.temperature else None
+    t0 = time.perf_counter()
+    out, cache = transformer_generate(
+        params, cfg, prompt, args.new_tokens,
+        temperature=args.temperature, rng=rng)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    n = args.batch * args.new_tokens
+    print(f"generated {n} tokens in {dt:.2f}s "
+          f"({n / dt:.0f} tok/s incl. compile); cache pos "
+          f"{int(cache['pos'])}, kv heads {cfg.kv_heads}")
+    print("first sequence:", out[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,12 @@ from .transformer import (  # noqa: F401
     transformer_ref_apply,
     transformer_ref_loss,
 )
+from .decode import (  # noqa: F401
+    init_decode_cache,
+    transformer_decode_step,
+    transformer_generate,
+    transformer_prefill,
+)
 
 
 _ZOO = {

@@ -1,0 +1,236 @@
+"""KV-cache incremental decoding for the flagship transformer.
+
+The reference is a training framework with no generation path at all;
+this module completes the model family for inference: O(1)-per-token
+decode against a persistent KV cache, scan-compiled, greedy or
+temperature sampling.
+
+Why it pairs with the long-context features (ops/flash_attention.py,
+parallel/sequence.py):
+
+  - **GQA/MQA** is primarily a DECODE optimization — the cache holds
+    `n_kv_heads` heads, so a 4:1 grouped config carries 1/4 the cache
+    bytes per token.  The grouped attention here never materializes
+    repeated heads (reshape-grouped einsum, the decode analog of the
+    flash kernel's shared-kv index maps).
+  - **attn_window** bounds the LIVE span, and the cache is a RING
+    BUFFER over absolute positions: with a window, `max_len` may be as
+    small as the window itself and decoding continues indefinitely —
+    slot `pos % max_len` is overwritten and the band mask works on the
+    reconstructed absolute position of each slot.
+
+Dense configs only (`moe_every == 0`) — MoE decode routing is a
+different machine (top-k gather per token) and is not built here.
+
+Layout: cache k/v are [L, B, max_len, Hkv, Dh] in `cfg.compute_dtype`,
+`pos` a scalar int32 count of tokens already absorbed.  All steps are
+fixed-shape (dynamic_update_slice into the ring; band masks over the
+full buffer), so one compiled program serves the whole generation.
+Prefill is ONE batched forward through the training attention path
+(`parallel.sequence.full_attention`), not a per-token loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel import sequence as seq_mod
+from .transformer import (
+    TransformerConfig,
+    _mlp_block,
+    _rmsnorm,
+    _rope,
+)
+
+
+def init_decode_cache(cfg: TransformerConfig, batch: int,
+                      max_len: int) -> Dict:
+    """Empty KV cache for `batch` sequences.
+
+    `max_len` is the ring capacity: without a window it must cover the
+    whole sequence; with `cfg.attn_window` it may be as small as the
+    window (the ring then rolls forever)."""
+    if cfg.moe_every:
+        raise NotImplementedError(
+            "decode cache supports dense configs only (moe_every=0)")
+    if cfg.attn_window and max_len < cfg.attn_window:
+        raise ValueError(
+            f"max_len {max_len} < attn_window {cfg.attn_window}: the "
+            f"ring would evict positions still inside the band")
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.d_head)
+    return {
+        "k": jnp.zeros(shape, cfg.compute_dtype),
+        "v": jnp.zeros(shape, cfg.compute_dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _slot_positions(pos, S):
+    """Absolute position held by each ring slot after the write at
+    `pos`: slot j holds pos - ((pos - j) mod S); negative = never
+    written."""
+    j = jnp.arange(S)
+    return pos - ((pos - j) % S)
+
+
+def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig):
+    """One layer's attention+MLP for a single new token position.
+
+    x [B, 1, D]; ck/cv [B, S, Hkv, Dh] (this layer's ring slices).
+    Returns (x, ck, cv) with slot `pos % S` overwritten.
+    """
+    dt = cfg.compute_dtype
+    B, S = ck.shape[0], ck.shape[1]
+    Hq, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.d_head
+    g = Hq // Hkv
+
+    h = _rmsnorm(lp["ln1"]["scale"], x)
+    q = jnp.einsum("bod,dhk->bohk", h, lp["wq"].astype(dt))
+    k = jnp.einsum("bod,dhk->bohk", h, lp["wk"].astype(dt))
+    v = jnp.einsum("bod,dhk->bohk", h, lp["wv"].astype(dt))
+    positions = pos[None]                          # [1]
+    q = _rope(q, positions, cfg.rope_theta).astype(dt)
+    k = _rope(k, positions, cfg.rope_theta).astype(dt)
+
+    slot = pos % S
+    ck = lax.dynamic_update_slice(ck, k, (0, slot, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v, (0, slot, 0, 0))
+
+    # Grouped attention against the ring: q [B,1,Hkv,g,Dh] x
+    # cache [B,S,Hkv,Dh] — the repeated kv heads never materialize.
+    qg = q.reshape(B, 1, Hkv, g, Dh)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                   ck.astype(jnp.float32)) / (Dh ** 0.5)   # [B,Hkv,g,1,S]
+    abs_pos = _slot_positions(pos, S)
+    valid = (abs_pos >= 0) & (abs_pos <= pos)
+    if cfg.attn_window:
+        valid = valid & ((pos - abs_pos) < cfg.attn_window)
+    s = jnp.where(valid[None, None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv.astype(jnp.float32))
+    o = o.reshape(B, 1, Hq, Dh).astype(dt)
+    out = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(dt))
+    x = x + out.astype(x.dtype)
+    x = _mlp_block(lp, x, cfg, None)
+    return x, ck, cv
+
+
+def transformer_decode_step(params: Dict, cache: Dict, tokens,
+                            cfg: TransformerConfig):
+    """Absorb one token per sequence; return (logits [B, V], cache).
+
+    `tokens` [B] int32.  The cache is a ring: with `cfg.attn_window`
+    set, decoding may continue past `max_len` indefinitely; without a
+    window the caller must size `max_len` to the full sequence (older
+    positions would be silently evicted otherwise).
+    """
+    dt = cfg.compute_dtype
+    x = params["embed"][tokens].astype(dt)[:, None, :]    # [B,1,D]
+    pos = cache["pos"]
+
+    def layer_step(x, inputs):
+        lp, ck, cv = inputs
+        x, ck, cv = _decode_layer(lp, ck, cv, x, pos, cfg)
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(layer_step, x,
+                           (params["blocks"], cache["k"], cache["v"]))
+    x = _rmsnorm(params["final_norm"]["scale"], x)
+    logits = jnp.einsum("bod,vd->bov", x.astype(dt),
+                        params["embed"].astype(dt),
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], {"k": ck, "v": cv, "pos": pos + 1}
+
+
+def transformer_prefill(params: Dict, cache: Dict, prompt,
+                        cfg: TransformerConfig):
+    """Absorb the whole prompt [B, T0] in ONE batched forward (the
+    training attention path), filling ring slots 0..T0-1.  Returns
+    (last-position logits [B, V], cache).  Requires a fresh cache
+    (pos == 0) and T0 <= max_len."""
+    dt = cfg.compute_dtype
+    B, T0 = prompt.shape
+    S = cache["k"].shape[2]
+    if T0 > S:
+        raise ValueError(f"prompt length {T0} > cache max_len {S}")
+    window = cfg.attn_window or None
+    x = params["embed"][prompt].astype(dt)                # [B,T0,D]
+    positions = jnp.arange(T0)
+
+    def layer_step(x, inputs):
+        lp, ck, cv = inputs
+        h = _rmsnorm(lp["ln1"]["scale"], x)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dt))
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dt))
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dt))
+        q = _rope(q, positions, cfg.rope_theta).astype(dt)
+        k = _rope(k, positions, cfg.rope_theta).astype(dt)
+        ck = lax.dynamic_update_slice(ck, k, (0, 0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, 0, 0, 0))
+        o = seq_mod.full_attention(q, k, v, causal=True, window=window)
+        out = jnp.einsum("bthk,hkd->btd", o.astype(dt),
+                         lp["wo"].astype(dt))
+        x = x + out.astype(x.dtype)
+        x = _mlp_block(lp, x, cfg, None)
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(layer_step, x,
+                           (params["blocks"], cache["k"], cache["v"]))
+    x = _rmsnorm(params["final_norm"]["scale"], x[:, -1:])
+    logits = jnp.einsum("bod,vd->bov", x.astype(dt),
+                        params["embed"].astype(dt),
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], {"k": ck, "v": cv,
+                          "pos": cache["pos"] + T0}
+
+
+def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
+                         max_new_tokens: int,
+                         temperature: float = 0.0,
+                         rng: Optional[jax.Array] = None,
+                         max_len: Optional[int] = None
+                         ) -> Tuple[jax.Array, Dict]:
+    """Generate `max_new_tokens` continuations of `prompt` [B, T0].
+
+    Greedy when temperature == 0 (default), else softmax sampling at
+    the given temperature (requires `rng`).  Returns (tokens
+    [B, max_new_tokens], final cache).  Prefill is one batched forward;
+    generation is one `lax.scan` — two compiled programs total.
+
+    `max_len` defaults to T0 + max_new_tokens; with `cfg.attn_window`
+    it may be as small as max(window, T0) — the ring rolls."""
+    B, T0 = prompt.shape
+    max_len = max_len or (T0 + max_new_tokens)
+    if T0 + max_new_tokens > max_len and not cfg.attn_window:
+        raise ValueError(
+            f"max_len {max_len} < prompt {T0} + new {max_new_tokens} "
+            f"(only windowed configs may roll the cache)")
+    if temperature and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng")
+    cache = init_decode_cache(cfg, B, max_len)
+    last_logits, cache = transformer_prefill(params, cache, prompt, cfg)
+
+    def pick(logits, key):
+        if temperature:
+            return jax.random.categorical(key, logits / temperature)
+        return jnp.argmax(logits, axis=-1)
+
+    keys = (jax.random.split(rng, max_new_tokens) if rng is not None
+            else jnp.zeros((max_new_tokens, 2), jnp.uint32))
+
+    def gen_step(carry, key):
+        cache, logits = carry
+        tok = pick(logits, key)
+        logits, cache = transformer_decode_step(params, cache, tok, cfg)
+        return (cache, logits), tok
+
+    (cache, _), toks = lax.scan(gen_step, (cache, last_logits), keys)
+    return toks.T, cache                                  # [B, max_new]
+
+
+__all__ = ["init_decode_cache", "transformer_decode_step",
+           "transformer_prefill", "transformer_generate"]

@@ -1,0 +1,154 @@
+"""KV-cache incremental decoding tests (models/decode.py): every
+decode-step logit must equal the full teacher-forcing forward at that
+position — the exact consistency contract between the training and
+inference paths — across MHA, GQA, and windowed configs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models import (
+    TransformerConfig,
+    init_decode_cache,
+    transformer_decode_step,
+    transformer_generate,
+    transformer_init,
+    transformer_ref_apply,
+)
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, d_model=32, n_heads=4, d_head=8,
+                d_ff=64, n_layers=2, compute_dtype=jnp.float32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+class TestDecodeStep:
+    @pytest.mark.parametrize("kw", [
+        {}, {"n_kv_heads": 2}, {"n_kv_heads": 1},
+        {"n_kv_heads": 2, "attn_window": 5}, {"attn_window": 3},
+    ], ids=["mha", "gqa2", "mqa", "gqa+window", "window"])
+    def test_matches_teacher_forcing(self, kw):
+        cfg = _cfg(**kw)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+        full_logits, _ = transformer_ref_apply(params, toks, cfg)
+        cache = init_decode_cache(cfg, 2, 12)
+        step = jax.jit(
+            lambda c, t: transformer_decode_step(params, c, t, cfg))
+        for t in range(12):
+            lg, cache = step(cache, toks[:, t])
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full_logits[:, t]),
+                atol=2e-4, rtol=2e-4, err_msg=f"position {t}")
+        assert int(cache["pos"]) == 12
+
+    def test_gqa_cache_is_smaller(self):
+        big = init_decode_cache(_cfg(), 2, 16)
+        small = init_decode_cache(_cfg(n_kv_heads=1), 2, 16)
+        assert small["k"].size * 4 == big["k"].size
+
+    def test_moe_config_rejected(self):
+        cfg = _cfg(moe_every=2, n_experts=2)
+        with pytest.raises(NotImplementedError, match="dense"):
+            init_decode_cache(cfg, 1, 8)
+
+
+class TestGenerate:
+    def test_greedy_chain_consistent(self):
+        # Teacher-forcing the generated sequence reproduces the same
+        # greedy choices the incremental path made.
+        cfg = _cfg(n_kv_heads=2)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, 64)
+        out, cache = transformer_generate(params, cfg, prompt,
+                                          max_new_tokens=6)
+        assert out.shape == (2, 6) and int(cache["pos"]) == 10
+        seq = jnp.concatenate([prompt, out], axis=1)
+        logits, _ = transformer_ref_apply(params, seq, cfg)
+        want = jnp.argmax(logits[:, 3:-1], axis=-1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+
+    def test_sampling_needs_rng_and_runs(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 2), jnp.int32)
+        with pytest.raises(ValueError, match="rng"):
+            transformer_generate(params, cfg, prompt, 3, temperature=1.0)
+        out, _ = transformer_generate(params, cfg, prompt, 3,
+                                      temperature=1.0,
+                                      rng=jax.random.PRNGKey(0))
+        assert out.shape == (1, 3)
+        assert bool((out >= 0).all()) and bool((out < 64).all())
+
+    def test_max_len_validation(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="max_len"):
+            transformer_generate(params, cfg, prompt, 8, max_len=8)
+
+
+class TestRingCacheAndPrefill:
+    def test_prefill_matches_teacher_forcing(self):
+        from horovod_tpu.models import transformer_prefill
+
+        cfg = _cfg(n_kv_heads=2)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
+        full, _ = transformer_ref_apply(params, toks, cfg)
+        cache = init_decode_cache(cfg, 2, 16)
+        logits, cache = transformer_prefill(params, cache, toks, cfg)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, -1]),
+                                   atol=2e-4, rtol=2e-4)
+        assert int(cache["pos"]) == 10
+        # decode continues seamlessly from the prefilled cache
+        nxt = jnp.argmax(logits, axis=-1)
+        lg2, cache = transformer_decode_step(params, cache, nxt, cfg)
+        seq = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        full2, _ = transformer_ref_apply(params, seq, cfg)
+        np.testing.assert_allclose(np.asarray(lg2),
+                                   np.asarray(full2[:, -1]),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_ring_rolls_with_window(self):
+        # max_len == window: decode 3x the capacity; logits stay equal
+        # to the full teacher-forcing forward because the band only ever
+        # needs the surviving slots.
+        cfg = _cfg(attn_window=4)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        T = 12
+        toks = jax.random.randint(jax.random.PRNGKey(3), (2, T), 0, 64)
+        full, _ = transformer_ref_apply(params, toks, cfg)
+        cache = init_decode_cache(cfg, 2, 4)     # ring capacity = window
+        step = jax.jit(
+            lambda c, t: transformer_decode_step(params, c, t, cfg))
+        for t in range(T):
+            lg, cache = step(cache, toks[:, t])
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full[:, t]),
+                atol=2e-4, rtol=2e-4, err_msg=f"position {t}")
+        assert int(cache["pos"]) == T
+
+    def test_windowless_cache_must_cover_sequence(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="roll"):
+            transformer_generate(params, cfg, prompt, 8, max_len=8)
+
+    def test_windowed_generate_with_small_ring(self):
+        cfg = _cfg(attn_window=4)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 4), 0, 64)
+        out, cache = transformer_generate(params, cfg, prompt, 10,
+                                          max_len=4)
+        assert out.shape == (1, 10) and int(cache["pos"]) == 14
+
+    def test_ring_smaller_than_window_rejected(self):
+        cfg = _cfg(attn_window=8)
+        with pytest.raises(ValueError, match="ring"):
+            init_decode_cache(cfg, 1, 4)

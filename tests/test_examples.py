@@ -115,6 +115,15 @@ class TestExamples:
              "--seq-len", "32", "--batch-size", "8", "--steps", "2"])
         assert "tok/s" in out
 
+    def test_generate_kv_cache(self):
+        out = _run_example(
+            "generate.py",
+            ["--n-kv-heads", "2", "--attn-window", "16", "--d-model",
+             "64", "--n-layers", "2", "--n-heads", "4",
+             "--new-tokens", "8"],
+            extra_env={"XLA_FLAGS": ""})
+        assert "generated" in out
+
     def test_elastic_resnet_under_driver(self, tmp_path):
         script = tmp_path / "discover.sh"
         script.write_text("#!/bin/sh\necho localhost:1\n")
