@@ -142,15 +142,15 @@ def _attention_block(lp, x, positions, cfg, tp_axis, sp_axis):
     k = _rope(k, positions, cfg.rope_theta).astype(dt)
     window = cfg.attn_window or None
     if sp_axis is not None:
-        # The ring/Ulysses shard kernels operate on equal head counts
-        # (heads are the all_to_all currency); under GQA repeat kv to
-        # full H here — the wire/FLOP cost is unchanged vs MHA, GQA
-        # still saves its parameters and kv-cache.  Windows ride the
-        # XLA blockwise ring (per-pair position bands) or Ulysses'
-        # locally-full sequence; the flash per-pair engine serves the
-        # window-free configs.
-        k, v = seq_mod.repeat_kv(q, k, v)
+        # Ring attention is GQA-native: the ppermute rotates the SMALL
+        # Hkv blocks around the ring (ICI bytes / group factor) and the
+        # per-pair engines expand heads locally (XLA blockwise) or share
+        # them via index maps (flash kernel).  Ulysses all_to_alls over
+        # heads, so it needs the full head count — repeat there.
+        # Windows ride the XLA blockwise ring's per-pair position bands
+        # or Ulysses' locally-full sequence.
         if cfg.attn_impl == "ulysses":
+            k, v = seq_mod.repeat_kv(q, k, v)
             o = seq_mod.ulysses_attention_shard(q, k, v, sp_axis,
                                                 window=window)
         else:

@@ -38,10 +38,13 @@ def _block_attn_update(q, k, v, o, m, l, q_pos, k_pos, scale, causal,
                        window=None):
     """One online-softmax update of (o, m, l) with a K/V block.
 
-    Shapes: q [B,Tq,H,D], k/v [B,Tk,H,D], o [B,Tq,H,D] f32,
-    m/l [B,H,Tq] f32.  Returns updated (o, m, l).  `window` adds the
-    causal sliding-window band (q - k < window) to the mask.
+    Shapes: q [B,Tq,H,D], k/v [B,Tk,Hkv,D] with H % Hkv == 0 (GQA kv
+    blocks are expanded locally — the ring still rotates the small
+    blocks), o [B,Tq,H,D] f32, m/l [B,H,Tq] f32.  Returns updated
+    (o, m, l).  `window` adds the causal sliding-window band
+    (q - k < window) to the mask.
     """
+    k, v = repeat_kv(q, k, v)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     mask = None

