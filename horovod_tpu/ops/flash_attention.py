@@ -110,21 +110,27 @@ def flash_routed(seq_len: int) -> bool:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _apply_mask(s, qi, ki, bq, bk, causal, window):
-    """Mask scores above the diagonal (causal) and, with a sliding
-    `window`, more than window-1 positions below it.  Only blocks
-    straddling a boundary actually mix masked/unmasked entries; blocks
-    fully outside are skipped by the callers' pl.when gates."""
-    if not causal and window is None:
+def _apply_mask(s, qi, ki, bq, bk, causal, window, qs=None, ks=None):
+    """Mask scores above the diagonal (causal), outside a sliding
+    `window` band, and — with segment ids (packed sequences) — across
+    segment boundaries.  Only blocks straddling a boundary actually mix
+    masked/unmasked entries; causal/window blocks fully outside are
+    skipped by the callers' pl.when gates (segment boundaries are
+    data-dependent, so no static skip)."""
+    if not causal and window is None and qs is None:
         return s
-    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     keep = None
-    if causal:
-        keep = q_pos >= k_pos
-    if window is not None:
-        wkeep = (q_pos - k_pos) < window
-        keep = wkeep if keep is None else jnp.logical_and(keep, wkeep)
+    if causal or window is not None:
+        q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if causal:
+            keep = q_pos >= k_pos
+        if window is not None:
+            wkeep = (q_pos - k_pos) < window
+            keep = wkeep if keep is None else jnp.logical_and(keep, wkeep)
+    if qs is not None:
+        skeep = qs[:, None] == ks[None, :]
+        keep = skeep if keep is None else jnp.logical_and(keep, skeep)
     return jnp.where(keep, s, _NEG)
 
 
@@ -141,9 +147,13 @@ def _block_gate(qi, ki, bq, bk, causal, window):
     return run
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, window,
-                num_kb, bq, bk):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, window,
+                num_kb, bq, bk, has_seg):
+    if has_seg:
+        qs_ref, ks_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        qs_ref = ks_ref = None
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -161,7 +171,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk) f32
-        s = _apply_mask(s, qi, ki, bq, bk, causal, window)
+        s = _apply_mask(s, qi, ki, bq, bk, causal, window,
+                        None if qs_ref is None else qs_ref[0, :, 0],
+                        None if ks_ref is None else ks_ref[0, :, 0])
         m_prev = m_scr[...]                       # (bq, 128) lanes equal
         l_prev = l_scr[...]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
@@ -184,26 +196,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, :, 0] = (m_scr[...] + jnp.log(l_scr[...]))[:, 0]
 
 
-def _fwd(q3, k3, v3, scale, causal, window, group):
+def _fwd(q3, k3, v3, seg, scale, causal, window, group, hq):
     """q3: (B*Hq, T, D), k3/v3: (B*Hkv, T, D) with T % block == 0 and
-    group = Hq // Hkv.  GQA never materializes repeated K/V: the index
+    group = Hq // Hkv; seg None or (B, T) int32 (hq = Hq, for the
+    batch index map).  GQA never materializes repeated K/V: the index
     map points q-head b at kv-head b // group.  Returns (o, lse)."""
     bh, t, d = q3.shape
     bq, bk = _block_sizes(t)
     nq = t // bq
     nk = t // bk
+    has_seg = seg is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               window=window, num_kb=nk, bq=bq, bk=bk)
+                               window=window, num_kb=nk, bq=bq, bk=bk,
+                               has_seg=has_seg)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+        pl.BlockSpec((1, bk, d),
+                     lambda b, qi, ki: (b // group, ki, 0)),
+        pl.BlockSpec((1, bk, d),
+                     lambda b, qi, ki: (b // group, ki, 0)),
+    ]
+    operands = [q3, k3, v3]
+    if has_seg:
+        # Trailing singleton (like the lse output): TPU block tiling
+        # wants the last dim 128-divisible or equal to the array dim,
+        # which bq/bk below 128 would violate in the last position.
+        in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b // hq, qi, 0)),
+            pl.BlockSpec((1, bk, 1), lambda b, qi, ki: (b // hq, ki, 0)),
+        ]
+        operands += [seg, seg]
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, bk, d),
-                         lambda b, qi, ki: (b // group, ki, 0)),
-            pl.BlockSpec((1, bk, d),
-                         lambda b, qi, ki: (b // group, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
@@ -221,7 +247,7 @@ def _fwd(q3, k3, v3, scale, causal, window, group):
         ],
         compiler_params=_tc_params(),
         interpret=_interpret(),
-    )(q3, k3, v3)
+    )(*operands)
     return o, lse
 
 
@@ -230,8 +256,13 @@ def _fwd(q3, k3, v3, scale, causal, window, group):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_scr, *, scale, causal, window,
-                   num_kb, bq, bk):
+                   *rest, scale, causal, window, num_kb, bq, bk,
+                   has_seg):
+    if has_seg:
+        qs_ref, ks_ref, dq_ref, acc_scr = rest
+    else:
+        dq_ref, acc_scr = rest
+        qs_ref = ks_ref = None
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -248,7 +279,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q_ref[0], k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _apply_mask(s, qi, ki, bq, bk, causal, window)
+        s = _apply_mask(s, qi, ki, bq, bk, causal, window,
+                        None if qs_ref is None else qs_ref[0, :, 0],
+                        None if ks_ref is None else ks_ref[0, :, 0])
         p = jnp.exp(s - lse[:, None])             # (bq, bk) f32
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -264,8 +297,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, window, num_qb, bq, bk):
+                    *rest, scale, causal, window, num_qb, bq, bk,
+                    has_seg):
+    if has_seg:
+        qs_ref, ks_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        qs_ref = ks_ref = None
     ki, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -284,7 +322,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
-        s = _apply_mask(s, qi, ki, bq, bk, causal, window)
+        s = _apply_mask(s, qi, ki, bq, bk, causal, window,
+                        None if qs_ref is None else qs_ref[0, :, 0],
+                        None if ks_ref is None else ks_ref[0, :, 0])
         p = jnp.exp(s - lse[:, None])                     # f32
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -304,7 +344,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(res, g):
-    q3, k3, v3, o3, lse, scale, causal, window, group = res
+    (q3, k3, v3, seg, o3, lse, scale, causal, window, group,
+     hq) = res
+    has_seg = seg is not None
     do3 = g[0]                                   # input dtype (MXU rate)
     dlse = g[1]                                              # (bh, t, 1)
     bh, t, d = q3.shape
@@ -324,18 +366,29 @@ def _bwd(res, g):
     kspec = pl.BlockSpec((1, bk, d),
                          lambda b, qi, ki: (b // group, ki, 0))
     rowq = pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0))
+    dq_specs = [qspec, kspec, kspec, qspec, rowq, rowq]
+    dq_operands = [q3, k3, v3, do3, lse, delta]
+    if has_seg:
+        dq_specs += [
+            pl.BlockSpec((1, bq, 1),
+                         lambda b, qi, ki: (b // hq, qi, 0)),
+            pl.BlockSpec((1, bk, 1),
+                         lambda b, qi, ki: (b // hq, ki, 0)),
+        ]
+        dq_operands += [seg, seg]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          window=window, num_kb=nk, bq=bq, bk=bk),
+                          window=window, num_kb=nk, bq=bq, bk=bk,
+                          has_seg=has_seg),
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        in_specs=dq_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=_tc_params(),
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse, delta)
+    )(*dq_operands)
 
     # dk/dv: grid walks (kb outer, qb inner sequential).  Under GQA the
     # kernel produces PER-Q-HEAD partials (f32) and the group-sum
@@ -347,12 +400,23 @@ def _bwd(res, g):
                           lambda b, ki, qi: (b // group, ki, 0))
     ospec2 = pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0))
     rowq2 = pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0))
+    dkv_specs = [qspec2, kspec2, kspec2, qspec2, rowq2, rowq2]
+    dkv_operands = [q3, k3, v3, do3, lse, delta]
+    if has_seg:
+        dkv_specs += [
+            pl.BlockSpec((1, bq, 1),
+                         lambda b, ki, qi: (b // hq, qi, 0)),
+            pl.BlockSpec((1, bk, 1),
+                         lambda b, ki, qi: (b // hq, ki, 0)),
+        ]
+        dkv_operands += [seg, seg]
     out_dt = (k3.dtype, v3.dtype) if group == 1 else (jnp.float32,) * 2
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          window=window, num_qb=nq, bq=bq, bk=bk),
+                          window=window, num_qb=nq, bq=bq, bk=bk,
+                          has_seg=has_seg),
         grid=(bh, nk, nq),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
+        in_specs=dkv_specs,
         out_specs=[ospec2, ospec2],
         out_shape=[jax.ShapeDtypeStruct((bh, t, d), out_dt[0]),
                    jax.ShapeDtypeStruct((bh, t, d), out_dt[1])],
@@ -360,37 +424,39 @@ def _bwd(res, g):
                         pltpu.VMEM((bk, d), jnp.float32)],
         compiler_params=_tc_params(),
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse, delta)
+    )(*dkv_operands)
     if group > 1:
         dk = dk.reshape(-1, group, t, d).sum(axis=1).astype(k3.dtype)
         dv = dv.reshape(-1, group, t, d).sum(axis=1).astype(v3.dtype)
-    return dq, dk, dv
+    return dq, dk, dv, None
 
 
 # ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash3(q3, k3, v3, causal, window, group):
-    return _fwd(q3, k3, v3, 1.0 / math.sqrt(q3.shape[-1]), causal,
-                window, group)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash3(q3, k3, v3, seg, causal, window, group, hq):
+    return _fwd(q3, k3, v3, seg, 1.0 / math.sqrt(q3.shape[-1]), causal,
+                window, group, hq)
 
 
-def _flash3_fwd(q3, k3, v3, causal, window, group):
+def _flash3_fwd(q3, k3, v3, seg, causal, window, group, hq):
     scale = 1.0 / math.sqrt(q3.shape[-1])
-    o, lse = _fwd(q3, k3, v3, scale, causal, window, group)
-    return (o, lse), (q3, k3, v3, o, lse, scale, causal, window, group)
+    o, lse = _fwd(q3, k3, v3, seg, scale, causal, window, group, hq)
+    return (o, lse), (q3, k3, v3, seg, o, lse, scale, causal, window,
+                      group, hq)
 
 
-def _flash3_bwd(causal, window, group, res, g):
+def _flash3_bwd(causal, window, group, hq, res, g):
     return _bwd(res, g)
 
 
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
-def _check_and_to3(q, k, v, window=None, causal=True):
+def _check_and_to3(q, k, v, window=None, causal=True,
+                   segment_ids=None):
     if not PALLAS_AVAILABLE:
         raise RuntimeError(
             "flash_attention requires jax.experimental.pallas, which "
@@ -424,14 +490,23 @@ def _check_and_to3(q, k, v, window=None, causal=True):
         if int(window) < 1:
             raise ValueError(f"flash_attention: window must be >= 1, "
                              f"got {window}")
+    seg3 = None
+    if segment_ids is not None:
+        if tuple(segment_ids.shape) != (B, T):
+            raise ValueError(
+                f"flash_attention: segment_ids must be (batch, seq) = "
+                f"({B}, {T}), got {tuple(segment_ids.shape)}")
+        # Trailing singleton for TPU-legal block tiling (see _fwd).
+        seg3 = jnp.asarray(segment_ids, jnp.int32)[:, :, None]
 
     def to3(x, h):
         return x.transpose(0, 2, 1, 3).reshape(B * h, T, D)
 
-    return (B, T, H, Hkv, D), to3(q, H), to3(k, Hkv), to3(v, Hkv)
+    return (B, T, H, Hkv, D), to3(q, H), to3(k, Hkv), to3(v, Hkv), seg3
 
 
-def flash_attention(q, k, v, causal: bool = True, window=None):
+def flash_attention(q, k, v, causal: bool = True, window=None,
+                    segment_ids=None):
     """Flash attention on [B, T, H, D] (same convention as
     parallel/sequence.py), differentiable, O(T) memory.
 
@@ -447,22 +522,27 @@ def flash_attention(q, k, v, causal: bool = True, window=None):
 
     `window` (requires causal): sliding-window attention — each query
     sees at most the last `window` keys; blocks fully outside the band
-    are skipped on both sides, so compute scales O(T * window)."""
+    are skipped on both sides, so compute scales O(T * window).
+
+    `segment_ids` ([B, T] int): packed-sequence block-diagonal masking —
+    tokens attend only within their own segment, so multiple documents
+    packed into one row never cross-attend."""
     window = None if window is None else int(window)
-    (B, T, H, Hkv, D), q3, k3, v3 = _check_and_to3(q, k, v, window,
-                                                   causal)
-    o3, _ = _flash3(q3, k3, v3, causal, window, H // Hkv)
+    (B, T, H, Hkv, D), q3, k3, v3, seg = _check_and_to3(
+        q, k, v, window, causal, segment_ids)
+    o3, _ = _flash3(q3, k3, v3, seg, causal, window, H // Hkv, H)
     return o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 
-def flash_attention_lse(q, k, v, causal: bool = True, window=None):
+def flash_attention_lse(q, k, v, causal: bool = True, window=None,
+                        segment_ids=None):
     """Like `flash_attention` but also returns the per-row logsumexp
     (f32, [B, T, H]) — the merge weight ring attention needs to combine
     per-pair partial results (both outputs are differentiable)."""
     window = None if window is None else int(window)
-    (B, T, H, Hkv, D), q3, k3, v3 = _check_and_to3(q, k, v, window,
-                                                   causal)
-    o3, lse3 = _flash3(q3, k3, v3, causal, window, H // Hkv)
+    (B, T, H, Hkv, D), q3, k3, v3, seg = _check_and_to3(
+        q, k, v, window, causal, segment_ids)
+    o3, lse3 = _flash3(q3, k3, v3, seg, causal, window, H // Hkv, H)
     o = o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
     lse = lse3.reshape(B, H, T).transpose(0, 2, 1)
     return o, lse

@@ -206,7 +206,7 @@ def repeat_kv(q, k, v):
 
 
 def full_attention(q, k, v, causal: bool = True, q_offset: int = 0,
-                   window=None):
+                   window=None, segment_ids=None):
     """Production dense attention [B,T,H,D] (used by Ulysses locally).
 
     Routing (`ops.flash_attention.flash_routed`): compatible shapes
@@ -224,7 +224,8 @@ def full_attention(q, k, v, causal: bool = True, q_offset: int = 0,
     if (fa.flash_routed(q.shape[1]) and q_offset == 0 and
             q.shape[1] == k.shape[1] and q.shape[1] % 128 == 0 and
             (window is None or causal)):
-        return fa.flash_attention(q, k, v, causal=causal, window=window)
+        return fa.flash_attention(q, k, v, causal=causal, window=window,
+                                  segment_ids=segment_ids)
     # Oracle path handles GQA (head repeat) and window natively.
     # The f32-cast oracle IS the production short-T path: an r04 on-chip
     # A/B of a bf16-matmul variant (preferred_element_type=f32, bf16
@@ -233,11 +234,12 @@ def full_attention(q, k, v, causal: bool = True, q_offset: int = 0,
     # the hand-lowered mixed-precision version, so there is no separate
     # "production" dense kernel to maintain.
     return dense_attention_oracle(q, k, v, causal=causal,
-                                  q_offset=q_offset, window=window)
+                                  q_offset=q_offset, window=window,
+                                  segment_ids=segment_ids)
 
 
 def dense_attention_oracle(q, k, v, causal: bool = True, q_offset: int = 0,
-                           window=None):
+                           window=None, segment_ids=None):
     """Numerical oracle: the O(T^2) dense softmax attention, guaranteed
     never to route through the flash kernel regardless of
     HOROVOD_FLASH_ATTENTION — the fixed point flash is tested against.
@@ -264,6 +266,18 @@ def dense_attention_oracle(q, k, v, causal: bool = True, q_offset: int = 0,
         mask = wmask if mask is None else (mask & wmask)
     if mask is not None:
         s = jnp.where(mask[None, None], s, _NEG)
+    if segment_ids is not None:
+        # Packed sequences: block-diagonal within each row's segments.
+        # segment_ids covers the KEY sequence; queries read their ids at
+        # q_offset (the decode-style Tq != Tk call).
+        if tuple(segment_ids.shape) != (B, Tk):
+            raise ValueError(
+                f"segment_ids must be (batch, key_len) = ({B}, {Tk}), "
+                f"got {tuple(segment_ids.shape)}")
+        q_seg = lax.dynamic_slice_in_dim(segment_ids, q_offset, Tq,
+                                         axis=1)
+        smask = (q_seg[:, :, None] == segment_ids[:, None, :])
+        s = jnp.where(smask[:, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
