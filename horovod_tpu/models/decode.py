@@ -19,8 +19,14 @@ parallel/sequence.py):
     slot `pos % max_len` is overwritten and the band mask works on the
     reconstructed absolute position of each slot.
 
-Dense configs only (`moe_every == 0`) — MoE decode routing is a
-different machine (top-k gather per token) and is not built here.
+MoE configs decode with NO-CAPACITY top-1 routing (`_moe_tokens`):
+every token reaches its chosen expert — inference has no step-global
+token budget, so training's capacity eviction (a load-balancing
+device, not a semantic) does not apply.  Decode logits equal the
+training forward whenever training's capacity dropped nothing (the
+test anchor uses capacity_factor = n_experts).  Expert compute runs
+all-experts-then-mask (static shapes; E x the single-token MLP cost,
+negligible at decode and acceptable at prefill for modest E).
 
 Layout: cache k/v are [L, B, max_len, Hkv, Dh] in `cfg.compute_dtype`,
 `pos` a scalar int32 count of tokens already absorbed.  All steps are
@@ -41,6 +47,7 @@ from jax import lax
 from ..parallel import sequence as seq_mod
 from .transformer import (
     TransformerConfig,
+    _is_moe_layer,
     _mlp_block,
     _rmsnorm,
     _rope,
@@ -54,9 +61,6 @@ def init_decode_cache(cfg: TransformerConfig, batch: int,
     `max_len` is the ring capacity: without a window it must cover the
     whole sequence; with `cfg.attn_window` it may be as small as the
     window (the ring then rolls forever)."""
-    if cfg.moe_every:
-        raise NotImplementedError(
-            "decode cache supports dense configs only (moe_every=0)")
     if cfg.attn_window and max_len < cfg.attn_window:
         raise ValueError(
             f"max_len {max_len} < attn_window {cfg.attn_window}: the "
@@ -115,7 +119,48 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig):
     o = o.reshape(B, 1, Hq, Dh).astype(dt)
     out = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(dt))
     x = x + out.astype(x.dtype)
-    x = _mlp_block(lp, x, cfg, None)
+    return x, ck, cv
+
+
+def _moe_tokens(mp, scale, x, cfg: TransformerConfig):
+    """No-capacity top-1 MoE for decode/prefill: x [B, T, D] ->
+    residual-added output.  All experts run on all tokens and the
+    result is masked by the routing one-hot (static shapes)."""
+    dt = cfg.compute_dtype
+    B, T, D = x.shape
+    h = _rmsnorm(scale, x).reshape(B * T, D).astype(dt)
+    logits = h @ mp["gate"]["kernel"].astype(dt)            # [N, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    eidx = jnp.argmax(probs, axis=-1)                       # [N]
+    gate = jnp.take_along_axis(probs, eidx[:, None], -1)[:, 0]
+    he = jax.nn.relu(jnp.einsum("nd,edf->enf", h,
+                                mp["wi"].astype(dt)))       # [E, N, F]
+    oe = jnp.einsum("enf,efd->end", he, mp["wo"].astype(dt))
+    onehot = jax.nn.one_hot(eidx, oe.shape[0], dtype=jnp.float32)
+    out = jnp.einsum("ne,end->nd", onehot * gate[:, None],
+                     oe.astype(jnp.float32))
+    return x + out.reshape(B, T, D).astype(x.dtype)
+
+
+def _mixed_layer_walk(params, ck, cv, x, attn_fn, cfg):
+    """Unrolled dense/MoE layer walk shared by decode and prefill
+    (mirrors transformer_ref_apply): attn_fn(lp, ck_i, cv_i, x) ->
+    (x, ck_i, cv_i) supplies the step- or prompt-shaped attention."""
+    moe_idx = 0
+    for i in range(cfg.n_layers):
+        lp = jax.tree_util.tree_map(lambda p: p[i], params["blocks"])
+        x, cki, cvi = attn_fn(lp, ck[i], cv[i], x)
+        ck = ck.at[i].set(cki)
+        cv = cv.at[i].set(cvi)
+        if _is_moe_layer(cfg, i):
+            mp = jax.tree_util.tree_map(lambda p: p[moe_idx],
+                                        params["moe"])
+            # No-capacity routing in decode AND prefill (see module
+            # docstring) — the two paths stay self-consistent.
+            x = _moe_tokens(mp, lp["ln2"]["scale"], x, cfg)
+            moe_idx += 1
+        else:
+            x = _mlp_block(lp, x, cfg, None)
     return x, ck, cv
 
 
@@ -132,13 +177,23 @@ def transformer_decode_step(params: Dict, cache: Dict, tokens,
     x = params["embed"][tokens].astype(dt)[:, None, :]    # [B,1,D]
     pos = cache["pos"]
 
-    def layer_step(x, inputs):
-        lp, ck, cv = inputs
-        x, ck, cv = _decode_layer(lp, ck, cv, x, pos, cfg)
-        return x, (ck, cv)
+    if not cfg.moe_every:
+        # Homogeneous dense layers: scan over the stacked params.
+        def layer_step(x, inputs):
+            lp, ck, cv = inputs
+            x, ck, cv = _decode_layer(lp, ck, cv, x, pos, cfg)
+            x = _mlp_block(lp, x, cfg, None)
+            return x, (ck, cv)
 
-    x, (ck, cv) = lax.scan(layer_step, x,
-                           (params["blocks"], cache["k"], cache["v"]))
+        x, (ck, cv) = lax.scan(layer_step, x,
+                               (params["blocks"], cache["k"],
+                                cache["v"]))
+    else:
+        # Mixed dense/MoE: unrolled walk (n_layers is static).
+        x, ck, cv = _mixed_layer_walk(
+            params, cache["k"], cache["v"], x,
+            lambda lp, cki, cvi, x: _decode_layer(lp, cki, cvi, x, pos,
+                                                  cfg), cfg)
     x = _rmsnorm(params["final_norm"]["scale"], x)
     logits = jnp.einsum("bod,vd->bov", x.astype(dt),
                         params["embed"].astype(dt),
@@ -161,8 +216,7 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
     x = params["embed"][prompt].astype(dt)                # [B,T0,D]
     positions = jnp.arange(T0)
 
-    def layer_step(x, inputs):
-        lp, ck, cv = inputs
+    def attn(lp, ck, cv, x):
         h = _rmsnorm(lp["ln1"]["scale"], x)
         q = jnp.einsum("btd,dhk->bthk", h, lp["wq"].astype(dt))
         k = jnp.einsum("btd,dhk->bthk", h, lp["wk"].astype(dt))
@@ -174,12 +228,22 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
         o = seq_mod.full_attention(q, k, v, causal=True, window=window)
         out = jnp.einsum("bthk,hkd->btd", o.astype(dt),
                          lp["wo"].astype(dt))
-        x = x + out.astype(x.dtype)
-        x = _mlp_block(lp, x, cfg, None)
-        return x, (ck, cv)
+        return x + out.astype(x.dtype), ck, cv
 
-    x, (ck, cv) = lax.scan(layer_step, x,
-                           (params["blocks"], cache["k"], cache["v"]))
+    if not cfg.moe_every:
+        def layer_step(x, inputs):
+            lp, ck, cv = inputs
+            x, ck, cv = attn(lp, ck, cv, x)
+            x = _mlp_block(lp, x, cfg, None)
+            return x, (ck, cv)
+
+        x, (ck, cv) = lax.scan(layer_step, x,
+                               (params["blocks"], cache["k"],
+                                cache["v"]))
+    else:
+        x, ck, cv = _mixed_layer_walk(
+            params, cache["k"], cache["v"], x,
+            lambda lp, cki, cvi, x: attn(lp, cki, cvi, x), cfg)
     x = _rmsnorm(params["final_norm"]["scale"], x[:, -1:])
     logits = jnp.einsum("bod,vd->bov", x.astype(dt),
                         params["embed"].astype(dt),

@@ -50,10 +50,43 @@ class TestDecodeStep:
         small = init_decode_cache(_cfg(n_kv_heads=1), 2, 16)
         assert small["k"].size * 4 == big["k"].size
 
-    def test_moe_config_rejected(self):
+    def test_moe_decode_matches_teacher_forcing(self):
+        # capacity_factor = n_experts -> training capacity drops nothing,
+        # so the no-capacity decode routing must match the training
+        # forward exactly.
+        cfg = _cfg(moe_every=2, n_experts=4, capacity_factor=4.0)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+        full, _ = transformer_ref_apply(params, toks, cfg)
+        cache = init_decode_cache(cfg, 2, 8)
+        step = jax.jit(
+            lambda c, t: transformer_decode_step(params, c, t, cfg))
+        for t in range(8):
+            lg, cache = step(cache, toks[:, t])
+            np.testing.assert_allclose(
+                np.asarray(lg), np.asarray(full[:, t]),
+                atol=3e-4, rtol=3e-4, err_msg=f"position {t}")
+
+    def test_moe_prefill_matches_teacher_forcing(self):
+        from horovod_tpu.models import transformer_prefill
+
+        cfg = _cfg(moe_every=2, n_experts=4, capacity_factor=4.0)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0, 64)
+        full, _ = transformer_ref_apply(params, toks, cfg)
+        cache = init_decode_cache(cfg, 2, 8)
+        logits, cache = transformer_prefill(params, cache, toks, cfg)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, -1]),
+                                   atol=3e-4, rtol=3e-4)
+
+    def test_moe_generate_runs(self):
         cfg = _cfg(moe_every=2, n_experts=2)
-        with pytest.raises(NotImplementedError, match="dense"):
-            init_decode_cache(cfg, 1, 8)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 3), 0, 64)
+        out, cache = transformer_generate(params, cfg, prompt, 5)
+        assert out.shape == (1, 5) and int(cache["pos"]) == 8
+        assert bool((out >= 0).all()) and bool((out < 64).all())
 
 
 class TestGenerate:
