@@ -38,6 +38,7 @@ from .transformer import (  # noqa: F401
 )
 from .decode import (  # noqa: F401
     init_decode_cache,
+    make_decode_step,
     transformer_decode_step,
     transformer_generate,
     transformer_prefill,

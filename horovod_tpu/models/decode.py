@@ -81,21 +81,25 @@ def _slot_positions(pos, S):
     return pos - ((pos - j) % S)
 
 
-def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig):
-    """One layer's attention+MLP for a single new token position.
+def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
+                  tp_axis=None):
+    """One layer's attention for a single new token position.
 
-    x [B, 1, D]; ck/cv [B, S, Hkv, Dh] (this layer's ring slices).
-    Returns (x, ck, cv) with slot `pos % S` overwritten.
+    x [B, 1, D]; ck/cv [B, S, Hkv, Dh] (this layer's ring slices —
+    LOCAL head counts under tensor parallelism; head dims are derived
+    from the weights, not cfg, so tp shards just work).  Returns
+    (x, ck, cv) with slot `pos % S` overwritten.
     """
     dt = cfg.compute_dtype
     B, S = ck.shape[0], ck.shape[1]
-    Hq, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.d_head
-    g = Hq // Hkv
+    Dh = cfg.d_head
 
     h = _rmsnorm(lp["ln1"]["scale"], x)
     q = jnp.einsum("bod,dhk->bohk", h, lp["wq"].astype(dt))
     k = jnp.einsum("bod,dhk->bohk", h, lp["wk"].astype(dt))
     v = jnp.einsum("bod,dhk->bohk", h, lp["wv"].astype(dt))
+    Hq, Hkv = q.shape[2], k.shape[2]
+    g = Hq // Hkv
     positions = pos[None]                          # [1]
     q = _rope(q, positions, cfg.rope_theta).astype(dt)
     k = _rope(k, positions, cfg.rope_theta).astype(dt)
@@ -118,6 +122,8 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig):
     o = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv.astype(jnp.float32))
     o = o.reshape(B, 1, Hq, Dh).astype(dt)
     out = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(dt))
+    if tp_axis is not None:
+        out = lax.psum(out, tp_axis)   # row-parallel wo
     x = x + out.astype(x.dtype)
     return x, ck, cv
 
@@ -128,11 +134,11 @@ def _moe_tokens(mp, scale, x, cfg: TransformerConfig):
     result is masked by the routing one-hot (static shapes)."""
     dt = cfg.compute_dtype
     B, T, D = x.shape
+    from ..parallel.moe import top1_route
+
     h = _rmsnorm(scale, x).reshape(B * T, D).astype(dt)
     logits = h @ mp["gate"]["kernel"].astype(dt)            # [N, E]
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    eidx = jnp.argmax(probs, axis=-1)                       # [N]
-    gate = jnp.take_along_axis(probs, eidx[:, None], -1)[:, 0]
+    _, eidx, gate = top1_route(logits)
     he = jax.nn.relu(jnp.einsum("nd,edf->enf", h,
                                 mp["wi"].astype(dt)))       # [E, N, F]
     oe = jnp.einsum("enf,efd->end", he, mp["wo"].astype(dt))
@@ -142,7 +148,7 @@ def _moe_tokens(mp, scale, x, cfg: TransformerConfig):
     return x + out.reshape(B, T, D).astype(x.dtype)
 
 
-def _mixed_layer_walk(params, ck, cv, x, attn_fn, cfg):
+def _mixed_layer_walk(params, ck, cv, x, attn_fn, cfg, tp_axis=None):
     """Unrolled dense/MoE layer walk shared by decode and prefill
     (mirrors transformer_ref_apply): attn_fn(lp, ck_i, cv_i, x) ->
     (x, ck_i, cv_i) supplies the step- or prompt-shaped attention."""
@@ -157,15 +163,17 @@ def _mixed_layer_walk(params, ck, cv, x, attn_fn, cfg):
                                         params["moe"])
             # No-capacity routing in decode AND prefill (see module
             # docstring) — the two paths stay self-consistent.
+            # MoE weights are replicated over tp (pspecs shard them
+            # over ep only), so the routed output is tp-consistent.
             x = _moe_tokens(mp, lp["ln2"]["scale"], x, cfg)
             moe_idx += 1
         else:
-            x = _mlp_block(lp, x, cfg, None)
+            x = _mlp_block(lp, x, cfg, tp_axis)
     return x, ck, cv
 
 
 def transformer_decode_step(params: Dict, cache: Dict, tokens,
-                            cfg: TransformerConfig):
+                            cfg: TransformerConfig, tp_axis=None):
     """Absorb one token per sequence; return (logits [B, V], cache).
 
     `tokens` [B] int32.  The cache is a ring: with `cfg.attn_window`
@@ -181,8 +189,8 @@ def transformer_decode_step(params: Dict, cache: Dict, tokens,
         # Homogeneous dense layers: scan over the stacked params.
         def layer_step(x, inputs):
             lp, ck, cv = inputs
-            x, ck, cv = _decode_layer(lp, ck, cv, x, pos, cfg)
-            x = _mlp_block(lp, x, cfg, None)
+            x, ck, cv = _decode_layer(lp, ck, cv, x, pos, cfg, tp_axis)
+            x = _mlp_block(lp, x, cfg, tp_axis)
             return x, (ck, cv)
 
         x, (ck, cv) = lax.scan(layer_step, x,
@@ -193,7 +201,8 @@ def transformer_decode_step(params: Dict, cache: Dict, tokens,
         x, ck, cv = _mixed_layer_walk(
             params, cache["k"], cache["v"], x,
             lambda lp, cki, cvi, x: _decode_layer(lp, cki, cvi, x, pos,
-                                                  cfg), cfg)
+                                                  cfg, tp_axis),
+            cfg, tp_axis)
     x = _rmsnorm(params["final_norm"]["scale"], x)
     logits = jnp.einsum("bod,vd->bov", x.astype(dt),
                         params["embed"].astype(dt),
@@ -202,7 +211,7 @@ def transformer_decode_step(params: Dict, cache: Dict, tokens,
 
 
 def transformer_prefill(params: Dict, cache: Dict, prompt,
-                        cfg: TransformerConfig):
+                        cfg: TransformerConfig, tp_axis=None):
     """Absorb the whole prompt [B, T0] in ONE batched forward (the
     training attention path), filling ring slots 0..T0-1.  Returns
     (last-position logits [B, V], cache).  Requires a fresh cache
@@ -228,13 +237,15 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
         o = seq_mod.full_attention(q, k, v, causal=True, window=window)
         out = jnp.einsum("bthk,hkd->btd", o.astype(dt),
                          lp["wo"].astype(dt))
+        if tp_axis is not None:
+            out = lax.psum(out, tp_axis)
         return x + out.astype(x.dtype), ck, cv
 
     if not cfg.moe_every:
         def layer_step(x, inputs):
             lp, ck, cv = inputs
             x, ck, cv = attn(lp, ck, cv, x)
-            x = _mlp_block(lp, x, cfg, None)
+            x = _mlp_block(lp, x, cfg, tp_axis)
             return x, (ck, cv)
 
         x, (ck, cv) = lax.scan(layer_step, x,
@@ -243,7 +254,8 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
     else:
         x, ck, cv = _mixed_layer_walk(
             params, cache["k"], cache["v"], x,
-            lambda lp, cki, cvi, x: attn(lp, cki, cvi, x), cfg)
+            lambda lp, cki, cvi, x: attn(lp, cki, cvi, x), cfg,
+            tp_axis)
     x = _rmsnorm(params["final_norm"]["scale"], x[:, -1:])
     logits = jnp.einsum("bod,vd->bov", x.astype(dt),
                         params["embed"].astype(dt),
@@ -296,5 +308,81 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
     return toks.T, cache                                  # [B, max_new]
 
 
+def make_decode_step(mesh, cfg: TransformerConfig):
+    """Sharded inference: build (decode_step, prefill, shard_params,
+    shard_cache, shard_tokens) over a dp x tp mesh.
+
+    - batch shards over `dp`; attention heads and the KV cache's head
+      axis shard over `tp` (n_heads % tp == 0 and kv_heads % tp == 0 —
+      the GQA+TP constraint from transformer_pspecs);
+    - wo/wd are row-parallel (one psum per layer, the decode analog of
+      the training block's tensor parallelism);
+    - `ep` is not supported at decode (MoE weights stay replicated and
+      route with the no-capacity inference semantics).
+    """
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .transformer import transformer_pspecs
+
+    axes = {a: mesh.shape.get(a, 1) > 1 for a in mesh.axis_names}
+    if axes.get("ep") and cfg.moe_every:
+        raise NotImplementedError(
+            "expert-parallel decode is not supported; decode MoE runs "
+            "replicated (drop ep from the mesh)")
+    if axes.get("pp") or axes.get("sp"):
+        raise NotImplementedError(
+            "decode shards over dp/tp only (no pp/sp schedule at "
+            "one-token granularity)")
+    tp_axis = "tp" if axes.get("tp") else None
+    dp = "dp" if axes.get("dp") else None
+
+    def _clean(spec):
+        # transformer_pspecs names tp/ep unconditionally; drop axes the
+        # inference mesh doesn't carry.
+        def keep(e):
+            if isinstance(e, tuple):
+                kept = tuple(a for a in e if a in mesh.axis_names)
+                return kept or None
+            return e if (e is None or e in mesh.axis_names) else None
+        return P(*[keep(e) for e in spec])
+
+    pspecs = jax.tree_util.tree_map(
+        _clean, transformer_pspecs(cfg, 1),
+        is_leaf=lambda x: isinstance(x, P))
+    tok_spec = P(dp)
+    logits_spec = P(dp, None)
+    cache_spec = {
+        "k": P(None, dp, None, tp_axis, None),
+        "v": P(None, dp, None, tp_axis, None),
+        "pos": P(),
+    }
+
+    step = jax.jit(shard_map(
+        lambda p, c, t: transformer_decode_step(p, c, t, cfg, tp_axis),
+        mesh=mesh, in_specs=(pspecs, cache_spec, tok_spec),
+        out_specs=(logits_spec, cache_spec), check_vma=False))
+    prefill = jax.jit(shard_map(
+        lambda p, c, t: transformer_prefill(p, c, t, cfg, tp_axis),
+        mesh=mesh,
+        in_specs=(pspecs, cache_spec, P(dp, None)),
+        out_specs=(logits_spec, cache_spec), check_vma=False))
+
+    def shard_params(params):
+        return jax.tree_util.tree_map(
+            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+            params, pspecs)
+
+    def shard_cache(cache):
+        return {k: jax.device_put(v, NamedSharding(mesh, cache_spec[k]))
+                for k, v in cache.items()}
+
+    def shard_tokens(tokens):
+        return jax.device_put(tokens, NamedSharding(mesh, tok_spec))
+
+    return step, prefill, shard_params, shard_cache, shard_tokens
+
+
 __all__ = ["init_decode_cache", "transformer_decode_step",
-           "transformer_prefill", "transformer_generate"]
+           "transformer_prefill", "transformer_generate",
+           "make_decode_step"]

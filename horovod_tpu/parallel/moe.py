@@ -39,12 +39,22 @@ def moe_init(key, n_experts: int, d_model: int, d_ff: int,
     }
 
 
+def top1_route(logits):
+    """Shared top-1 routing triplet: (probs f32, expert_idx, gate).
+    Training (_gating) and inference (models/decode._moe_tokens) MUST
+    route identically — softmax dtype and argmax tie-breaking included —
+    for decode/teacher-forcing logit parity; this helper makes that
+    invariant structural."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], -1)[:, 0]
+    return probs, expert_idx, gate
+
+
 def _gating(logits, n_experts: int, capacity: int):
     """Top-1 gating → dispatch [T, E, C] (bool) and combine [T, E, C]
     (f32 weights).  T = local token count."""
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)                  # [T]
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], -1)[:, 0]  # [T]
+    probs, expert_idx, gate = top1_route(logits)
     onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
     # Position of each token within its expert's queue.
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0          # [T, E]

@@ -190,3 +190,66 @@ class TestRingCacheAndPrefill:
         cfg = _cfg(attn_window=8)
         with pytest.raises(ValueError, match="ring"):
             init_decode_cache(cfg, 1, 4)
+
+
+class TestShardedDecode:
+    """make_decode_step: KV-cache decode over a dp x tp mesh must equal
+    single-device decode bit-for-near (distributed inference)."""
+
+    def _mesh(self, **shape):
+        from jax.sharding import Mesh
+
+        n = 1
+        for v in shape.values():
+            n *= v
+        if len(jax.devices()) < n:
+            pytest.skip(f"needs {n} virtual devices")
+        devs = np.array(jax.devices()[:n]).reshape(*shape.values())
+        return Mesh(devs, tuple(shape.keys()))
+
+    @pytest.mark.parametrize("shape,kw", [
+        ({"dp": 2, "tp": 2}, {}),
+        ({"tp": 2}, {"n_kv_heads": 2}),
+        ({"dp": 2}, {"moe_every": 2, "n_experts": 2}),
+        ({"tp": 2}, {"moe_every": 2, "n_experts": 2}),
+    ], ids=["dp2tp2", "tp2-gqa", "dp2-moe", "tp2-moe"])
+    def test_matches_single_device(self, shape, kw):
+        from horovod_tpu.models import make_decode_step
+
+        cfg = _cfg(**kw)
+        mesh = self._mesh(**shape)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, 64)
+
+        # single-device reference chain
+        ref_cache = init_decode_cache(cfg, 2, 10)
+        from horovod_tpu.models import transformer_prefill
+        ref_lg, ref_cache = transformer_prefill(params, ref_cache,
+                                                toks, cfg)
+
+        step, prefill, shard_params, shard_cache, shard_tokens = \
+            make_decode_step(mesh, cfg)
+        sp = shard_params(params)
+        sc = shard_cache(init_decode_cache(cfg, 2, 10))
+        lg, sc = prefill(sp, sc, toks)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(ref_lg),
+                                   atol=3e-4, rtol=3e-4)
+        nxt = jnp.argmax(lg, axis=-1)
+        for _ in range(3):
+            ref_lg, ref_cache = transformer_decode_step(
+                params, ref_cache, nxt, cfg)
+            lg, sc = step(sp, sc, shard_tokens(nxt))
+            np.testing.assert_allclose(np.asarray(lg),
+                                       np.asarray(ref_lg),
+                                       atol=3e-4, rtol=3e-4)
+            nxt = jnp.argmax(lg, axis=-1)
+
+    def test_unsupported_axes_raise(self):
+        from horovod_tpu.models import make_decode_step
+
+        mesh = self._mesh(sp=2)
+        with pytest.raises(NotImplementedError, match="dp/tp"):
+            make_decode_step(mesh, _cfg())
+        mesh = self._mesh(ep=2)
+        with pytest.raises(NotImplementedError, match="ep"):
+            make_decode_step(mesh, _cfg(moe_every=2, n_experts=2))
