@@ -33,6 +33,7 @@ def main():
     p.add_argument("--prompt-len", type=int, default=8)
     p.add_argument("--new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-p", type=float, default=1.0)
     args = p.parse_args()
 
     cfg = TransformerConfig(
@@ -44,11 +45,15 @@ def main():
     prompt = jax.random.randint(jax.random.PRNGKey(1),
                                 (args.batch, args.prompt_len), 0, cfg.vocab_size)
 
+    if args.top_p < 1.0 and not args.temperature:
+        raise SystemExit(
+            "--top-p needs --temperature > 0 (greedy decoding ignores "
+            "the nucleus)")
     rng = jax.random.PRNGKey(2) if args.temperature else None
     t0 = time.perf_counter()
     out, cache = transformer_generate(
         params, cfg, prompt, args.new_tokens,
-        temperature=args.temperature, rng=rng)
+        temperature=args.temperature, top_p=args.top_p, rng=rng)
     out.block_until_ready()
     dt = time.perf_counter() - t0
     n = args.batch * args.new_tokens

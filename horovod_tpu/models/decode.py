@@ -267,13 +267,16 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
 def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
                          max_new_tokens: int,
                          temperature: float = 0.0,
+                         top_p: float = 1.0,
                          rng: Optional[jax.Array] = None,
                          max_len: Optional[int] = None
                          ) -> Tuple[jax.Array, Dict]:
     """Generate `max_new_tokens` continuations of `prompt` [B, T0].
 
     Greedy when temperature == 0 (default), else softmax sampling at
-    the given temperature (requires `rng`).  Returns (tokens
+    the given temperature (requires `rng`); `top_p < 1` restricts
+    sampling to the smallest set of tokens whose cumulative probability
+    reaches top_p (nucleus sampling).  Returns (tokens
     [B, max_new_tokens], final cache).  Prefill is one batched forward;
     generation is one `lax.scan` — two compiled programs total.
 
@@ -287,13 +290,32 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
             f"(only windowed configs may roll the cache)")
     if temperature and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     cache = init_decode_cache(cfg, B, max_len)
     last_logits, cache = transformer_prefill(params, cache, prompt, cfg)
 
     def pick(logits, key):
-        if temperature:
-            return jax.random.categorical(key, logits / temperature)
-        return jnp.argmax(logits, axis=-1)
+        if not temperature:
+            return jnp.argmax(logits, axis=-1)
+        logits = logits / temperature
+        if top_p < 1.0:
+            # Nucleus: sample IN SORTED SPACE (mask the tail ranks,
+            # draw a rank, map back through sort_idx) — same
+            # distribution as masking in vocab order, without paying a
+            # per-token O(B*V) scatter inside the generation scan.
+            sort_idx = jnp.argsort(-logits, axis=-1)
+            sorted_logits = jnp.take_along_axis(logits, sort_idx, -1)
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep ranks where the cumulative mass BEFORE them < top_p
+            # (rank 0 always kept — no all-masked row exists)
+            keep_sorted = (cum - probs) < top_p
+            masked = jnp.where(keep_sorted, sorted_logits, -jnp.inf)
+            rank = jax.random.categorical(key, masked)
+            return jnp.take_along_axis(
+                sort_idx, rank[:, None], -1)[:, 0]
+        return jax.random.categorical(key, logits)
 
     keys = (jax.random.split(rng, max_new_tokens) if rng is not None
             else jnp.zeros((max_new_tokens, 2), jnp.uint32))

@@ -253,3 +253,36 @@ class TestShardedDecode:
         mesh = self._mesh(ep=2)
         with pytest.raises(NotImplementedError, match="ep"):
             make_decode_step(mesh, _cfg(moe_every=2, n_experts=2))
+
+
+class TestTopP:
+    def test_top_p_validation(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 2), jnp.int32)
+        with pytest.raises(ValueError, match="top_p"):
+            transformer_generate(params, cfg, prompt, 2, temperature=1.0,
+                                 top_p=0.0, rng=jax.random.PRNGKey(0))
+
+    def test_top_p_small_is_greedy(self):
+        # top_p -> 0+ keeps only the argmax token, so sampling at any
+        # temperature reproduces the greedy chain.
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 3), 0, 64)
+        greedy, _ = transformer_generate(params, cfg, prompt, 5)
+        nucleus, _ = transformer_generate(params, cfg, prompt, 5,
+                                          temperature=2.0, top_p=1e-6,
+                                          rng=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(nucleus),
+                                      np.asarray(greedy))
+
+    def test_top_p_sampling_runs(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 2), jnp.int32)
+        out, _ = transformer_generate(params, cfg, prompt, 4,
+                                      temperature=1.0, top_p=0.9,
+                                      rng=jax.random.PRNGKey(0))
+        assert out.shape == (1, 4)
+        assert bool((out >= 0).all()) and bool((out < 64).all())
