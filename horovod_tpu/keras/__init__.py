@@ -16,6 +16,7 @@ Keras frontend in `horovod_tpu.tensorflow.keras`.
 from ..tensorflow.keras import *  # noqa: F401,F403
 from ..tensorflow.keras import (  # noqa: F401
     DistributedOptimizer,
+    PartialDistributedOptimizer,
     load_model,
 )
 from . import callbacks  # noqa: F401  — the local submodules, so

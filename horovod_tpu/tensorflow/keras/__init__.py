@@ -60,6 +60,36 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
         _hvd_avg_agg = bool(average_aggregated_gradients)
         _hvd_sparse_as_dense = bool(sparse_as_dense)
         _hvd_predivide = float(gradient_predivide_factor)
+        _hvd_local_layers = ()   # PartialDistributedOptimizer fills this
+
+        def _hvd_local_refs(self):
+            """Variable refs excluded from sync, resolved lazily so
+            layers may build after the optimizer wraps."""
+            # Keyed by id(): Keras-3 variables have no .ref(), and the
+            # layer's variable objects ARE the ones Keras passes to
+            # apply_gradients.
+            refs = set()
+            for entry in self._hvd_local_layers:
+                vs = getattr(entry, "trainable_variables", None)
+                for v in (vs if vs is not None else [entry]):
+                    refs.add(id(v))
+            return refs
+
+        def _hvd_allreduce_partial(self, grads, tvars):
+            """_allreduce_grads, skipping variables owned by local
+            layers (their gradients apply as-is on every rank)."""
+            refs = self._hvd_local_refs()
+            # With no local refs every flag is False and the masked
+            # call below degenerates to the plain _allreduce_grads —
+            # one call site, no special case.
+            flags = [v is not None and id(v) in refs for v in tvars]
+            synced = _allreduce_grads(
+                [None if f else g for g, f in zip(grads, flags)],
+                self._hvd_op, self._hvd_compression,
+                self._hvd_process_set, self._hvd_sparse_as_dense,
+                gradient_predivide_factor=self._hvd_predivide)
+            return [g if f else s
+                    for g, s, f in zip(grads, synced, flags)]
 
         def _hvd_reduce_then(self, grads, tvars, apply_fn):
             """Allreduce-and-apply now (bpps==1), or accumulate and do
@@ -79,10 +109,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                 # Preserve the wrapped optimizer's return value (Keras
                 # contract: apply_gradients returns the iteration
                 # counter).
-                return _apply_inner(_allreduce_grads(
-                    grads, self._hvd_op, self._hvd_compression,
-                    self._hvd_process_set, self._hvd_sparse_as_dense,
-                    gradient_predivide_factor=self._hvd_predivide))
+                return _apply_inner(
+                    self._hvd_allreduce_partial(grads, tvars))
 
             if getattr(self, "_hvd_accum_vars", None) is None:
                 # First trace: create the aggregation slots.
@@ -102,10 +130,8 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
                 else:
                     local = [tf.convert_to_tensor(acc)
                              for acc in self._hvd_accum_vars]
-                _apply_inner(_allreduce_grads(
-                    local, self._hvd_op, self._hvd_compression,
-                    self._hvd_process_set, self._hvd_sparse_as_dense,
-                    gradient_predivide_factor=self._hvd_predivide))
+                _apply_inner(
+                    self._hvd_allreduce_partial(local, tvars))
                 for acc in self._hvd_accum_vars:
                     acc.assign(tf.zeros_like(acc))
                 return tf.convert_to_tensor(self.iterations)
@@ -138,8 +164,15 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
             grads = list(grads)
             tvars = (list(trainable_variables)
                      if trainable_variables is not None else None)
+            # Keras 3 allows apply(grads) with the optimizer's stored
+            # variables implied — resolve them so local-layer flags
+            # (PartialDistributedOptimizer) still match by identity.
+            flag_vars = tvars
+            if flag_vars is None:
+                stored = getattr(self, "_trainable_variables", None)
+                flag_vars = list(stored) if stored else grads
             return self._hvd_reduce_then(
-                grads, tvars if tvars is not None else grads,
+                grads, flag_vars,
                 lambda reduced: super(
                     _DistributedKerasOptimizer, self).apply(
                         reduced, tvars, **kwargs))
@@ -148,6 +181,25 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
         name or "Distributed" + cls.__name__)
     cfg = optimizer.get_config()
     return _DistributedKerasOptimizer.from_config(cfg)
+
+
+def PartialDistributedOptimizer(optimizer, local_layers=None, **kwargs):
+    """Reference horovod/tensorflow/keras `PartialDistributedOptimizer`:
+    a DistributedOptimizer that SKIPS synchronization for the variables
+    of `local_layers` — those train with purely local gradients (e.g.
+    per-rank embeddings or heads), everything else allreduces as usual.
+
+    `local_layers` takes Keras layers (their `trainable_variables`,
+    resolved lazily so layers may build after wrapping) or variables
+    directly.  All DistributedOptimizer kwargs apply.
+
+    Serialization boundary: the local-layer set references live layer
+    objects and does NOT survive model save/load — `load_model`
+    rewraps with a plain DistributedOptimizer; re-apply
+    PartialDistributedOptimizer (and recompile) after loading."""
+    opt = DistributedOptimizer(optimizer, **kwargs)
+    opt._hvd_local_layers = tuple(local_layers or ())
+    return opt
 
 
 def _distributed_from_config_class(cls, compression, **dist_kwargs):
@@ -179,6 +231,9 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
     serialized class name is ``Distributed<Base>``).  `custom_objects`
     entries take precedence, matching the reference's merge order.
     Extra keyword arguments are forwarded to `DistributedOptimizer`.
+    A PartialDistributedOptimizer's local-layer set does not survive
+    serialization — models load with a plain DistributedOptimizer
+    (re-apply the partial wrapper after loading).
     """
     import inspect
 

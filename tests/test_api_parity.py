@@ -51,12 +51,14 @@ SURFACES = {
     "horovod_tpu.tensorflow.keras": [
         "init", "shutdown", "size", "rank", "local_size", "local_rank",
         "allreduce", "allgather", "broadcast", "broadcast_object",
-        "DistributedOptimizer", "load_model", "callbacks", "elastic",
+        "DistributedOptimizer", "PartialDistributedOptimizer",
+        "load_model", "callbacks", "elastic",
         "Average", "Sum", "Adasum", "Compression",
         "mpi_built", "gloo_built", "nccl_built",
     ],
     "horovod_tpu.keras": [
-        "init", "size", "rank", "DistributedOptimizer", "load_model",
+        "init", "size", "rank", "DistributedOptimizer",
+        "PartialDistributedOptimizer", "load_model",
         "callbacks", "elastic", "Compression",
     ],
     "horovod_tpu.torch": BASICS + OPS_COMMON + [

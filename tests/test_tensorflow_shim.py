@@ -823,3 +823,66 @@ class TestElasticKerasCallbacks:
         model.train_on_batch(x, y)
         state.restore()
         assert int(model.optimizer.iterations.numpy()) == it0
+
+
+class TestPartialDistributedOptimizer:
+    """Reference horovod/tensorflow/keras PartialDistributedOptimizer:
+    local layers' variables skip the allreduce."""
+
+    def test_local_layer_grads_skip_sync(self, monkeypatch):
+        import horovod_tpu.tensorflow.keras as K
+
+        seen = []
+        orig = K._allreduce_grads
+
+        def spy(grads, *a, **kw):
+            seen.append([g is None for g in grads])
+            return orig(grads, *a, **kw)
+
+        monkeypatch.setattr(K, "_allreduce_grads", spy)
+        tf.keras.utils.set_random_seed(0)
+        local = tf.keras.layers.Dense(2, name="local_head")
+        model = tf.keras.Sequential([
+            tf.keras.layers.Input((4,)),
+            tf.keras.layers.Dense(8, activation="relu"),
+            local,
+        ])
+        opt = hvd_keras.PartialDistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1), local_layers=[local])
+        model.compile(optimizer=opt, loss="mse")
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+        w_local_before = [w.numpy().copy() for w in local.weights]
+        model.train_on_batch(x, y)
+        # the allreduce saw None exactly at the local layer's grads
+        assert seen and sum(seen[-1]) == len(local.trainable_variables)
+        # and the local layer still TRAINED (raw gradient applied)
+        changed = any(not np.allclose(a.numpy(), b)
+                      for a, b in zip(local.weights, w_local_before))
+        assert changed
+
+    def test_no_local_layers_is_plain_distributed(self):
+        opt = hvd_keras.PartialDistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1))
+        v = tf.Variable([1.0, 1.0])
+        opt.apply_gradients([(tf.constant([2.0, 2.0]), v)])
+        np.testing.assert_allclose(v.numpy(), [0.8, 0.8])
+
+    def test_variables_accepted_directly(self, monkeypatch):
+        import horovod_tpu.tensorflow.keras as K
+
+        seen = []
+        orig = K._allreduce_grads
+
+        def spy(grads, *a, **kw):
+            seen.append([g is None for g in grads])
+            return orig(grads, *a, **kw)
+
+        monkeypatch.setattr(K, "_allreduce_grads", spy)
+        v1 = tf.Variable([1.0, 1.0])
+        v2 = tf.Variable([2.0, 2.0])
+        opt = hvd_keras.PartialDistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1), local_layers=[v2])
+        opt.apply_gradients([(tf.constant([1.0, 1.0]), v1),
+                             (tf.constant([1.0, 1.0]), v2)])
+        assert seen[-1] == [False, True]
