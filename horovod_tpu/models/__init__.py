@@ -39,6 +39,7 @@ from .transformer import (  # noqa: F401
 from .decode import (  # noqa: F401
     init_decode_cache,
     make_decode_step,
+    transformer_beam_search,
     transformer_decode_step,
     transformer_generate,
     transformer_prefill,

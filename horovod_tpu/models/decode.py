@@ -42,6 +42,7 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..parallel import sequence as seq_mod
@@ -264,6 +265,17 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
                           "pos": cache["pos"] + T0}
 
 
+def _resolve_max_len(cfg, T0, max_new_tokens, max_len):
+    """Shared generate/beam cache-capacity rule: default to the full
+    sequence; allow a smaller rolling ring only for windowed configs."""
+    max_len = max_len or (T0 + max_new_tokens)
+    if T0 + max_new_tokens > max_len and not cfg.attn_window:
+        raise ValueError(
+            f"max_len {max_len} < prompt {T0} + new {max_new_tokens} "
+            f"(only windowed configs may roll the cache)")
+    return max_len
+
+
 def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
                          max_new_tokens: int,
                          temperature: float = 0.0,
@@ -283,11 +295,7 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
     `max_len` defaults to T0 + max_new_tokens; with `cfg.attn_window`
     it may be as small as max(window, T0) — the ring rolls."""
     B, T0 = prompt.shape
-    max_len = max_len or (T0 + max_new_tokens)
-    if T0 + max_new_tokens > max_len and not cfg.attn_window:
-        raise ValueError(
-            f"max_len {max_len} < prompt {T0} + new {max_new_tokens} "
-            f"(only windowed configs may roll the cache)")
+    max_len = _resolve_max_len(cfg, T0, max_new_tokens, max_len)
     if temperature and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")
     if not 0.0 < top_p <= 1.0:
@@ -405,6 +413,93 @@ def make_decode_step(mesh, cfg: TransformerConfig):
     return step, prefill, shard_params, shard_cache, shard_tokens
 
 
+def transformer_beam_search(params: Dict, cfg: TransformerConfig,
+                            prompt, max_new_tokens: int,
+                            beam_width: int = 4,
+                            length_penalty: float = 0.0,
+                            max_len: Optional[int] = None):
+    """Beam search over the KV-cache decode path.
+
+    prompt [B, T0] -> (tokens [B, W, max_new], scores [B, W]) sorted
+    best-first; scores are sums of chosen-token logprobs.  All beams
+    decode the full max_new_tokens (no EOS truncation), so lengths are
+    equal and `length_penalty` only NORMALIZES the reported scores
+    (score / len**penalty, the GNMT formula) — it cannot re-rank
+    equal-length beams and exists for score comparability across runs
+    of different lengths.
+
+    The cache carries B*W rows (beam-major within batch); each step
+    selects the top-W of the W*V continuations per batch and GATHERS
+    the parent beams' cache rows, the standard reorder.  One lax.scan.
+    """
+    B, T0 = prompt.shape
+    W = int(beam_width)
+    if W < 1:
+        raise ValueError(f"beam_width must be >= 1, got {W}")
+    V = cfg.vocab_size
+    max_len = _resolve_max_len(cfg, T0, max_new_tokens, max_len)
+
+    # Prefill ONCE per sequence, then tile each cache row W times
+    # (beam-major: row b*W + w is beam w of sequence b).
+    cache = init_decode_cache(cfg, B, max_len)
+    logits, cache = transformer_prefill(params, cache, prompt, cfg)
+
+    def tile(x, axis):
+        return jnp.repeat(x, W, axis=axis)
+
+    cache = {"k": tile(cache["k"], 1), "v": tile(cache["v"], 1),
+             "pos": cache["pos"]}
+    logp = jax.nn.log_softmax(logits, axis=-1)              # [B, V]
+    # First step: top-W distinct tokens seed the beams.
+    seed_lp, seed_tok = jax.lax.top_k(logp, W)              # [B, W]
+    scores = seed_lp.reshape(B * W)
+    tok = seed_tok.reshape(B * W)
+
+    def gen_step(carry, _):
+        cache, scores, tok = carry
+        logits, cache = transformer_decode_step(params, cache, tok, cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1)          # [B*W, V]
+        cand = scores[:, None] + logp                       # [B*W, V]
+        cand = cand.reshape(B, W * V)
+        new_scores, flat_idx = jax.lax.top_k(cand, W)       # [B, W]
+        parent = flat_idx // V                              # beam index
+        new_tok = flat_idx % V
+        # Gather parent beams' cache rows (batch-major offsets).
+        rows = (jnp.arange(B)[:, None] * W + parent).reshape(B * W)
+        cache = {"k": cache["k"][:, rows], "v": cache["v"][:, rows],
+                 "pos": cache["pos"]}
+        return ((cache, new_scores.reshape(B * W),
+                 new_tok.reshape(B * W)),
+                (new_tok.reshape(B * W), rows))
+
+    (cache, scores, tok), (toks, parents) = lax.scan(
+        gen_step, (cache, scores, tok), None,
+        length=max_new_tokens - 1)
+
+    # Reconstruct each surviving beam's token path by walking the
+    # parent pointers backward (host-side numpy — the scan above is the
+    # compiled part; this makes transformer_beam_search eager-only).
+    toks = jnp.concatenate([seed_tok.reshape(1, B * W), toks], axis=0)
+    paths = np.zeros((max_new_tokens, B * W), np.int64)
+    live = np.arange(B * W)
+    toks_np = np.asarray(toks)
+    parents_np = np.asarray(parents)
+    for t in range(max_new_tokens - 1, 0, -1):
+        paths[t] = toks_np[t, live]
+        live = parents_np[t - 1, live]
+    paths[0] = toks_np[0, live]
+    out = jnp.asarray(paths.T).reshape(B, W, max_new_tokens)
+    scores = scores.reshape(B, W)
+    if length_penalty:
+        # Equal-length beams: a pure normalization of the reported
+        # scores (see docstring) — ranking is unchanged.
+        scores = scores / (float(max_new_tokens) ** length_penalty)
+    order = jnp.argsort(-scores, axis=-1)
+    out = jnp.take_along_axis(out, order[:, :, None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return out, scores
+
+
 __all__ = ["init_decode_cache", "transformer_decode_step",
            "transformer_prefill", "transformer_generate",
-           "make_decode_step"]
+           "transformer_beam_search", "make_decode_step"]

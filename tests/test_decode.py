@@ -286,3 +286,62 @@ class TestTopP:
                                       rng=jax.random.PRNGKey(0))
         assert out.shape == (1, 4)
         assert bool((out >= 0).all()) and bool((out < 64).all())
+
+
+class TestBeamSearch:
+    def test_width_one_equals_greedy(self):
+        from horovod_tpu.models import transformer_beam_search
+
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 64)
+        greedy, _ = transformer_generate(params, cfg, prompt, 6)
+        beams, scores = transformer_beam_search(params, cfg, prompt, 6,
+                                                beam_width=1)
+        assert beams.shape == (2, 1, 6)
+        np.testing.assert_array_equal(np.asarray(beams[:, 0]),
+                                      np.asarray(greedy))
+
+    def test_scores_are_true_chain_logprobs(self):
+        # Each returned beam's score must equal the sum of the chosen
+        # tokens' logprobs under teacher forcing of that beam.
+        from horovod_tpu.models import transformer_beam_search
+
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 64)
+        N = 5
+        beams, scores = transformer_beam_search(params, cfg, prompt, N,
+                                                beam_width=3)
+        for w in range(3):
+            seq = jnp.concatenate([prompt, beams[:, w]], axis=1)
+            logits, _ = transformer_ref_apply(params, seq, cfg)
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            picked = jnp.take_along_axis(
+                lp[:, 3:-1], seq[:, 4:, None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            want = float(picked.sum())
+            assert abs(want - float(scores[0, w])) < 5e-3, (w, want,
+                                                            scores)
+
+    def test_best_beam_at_least_greedy(self):
+        from horovod_tpu.models import transformer_beam_search
+
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 3), 0, 64)
+        N = 6
+        _, s1 = transformer_beam_search(params, cfg, prompt, N,
+                                        beam_width=1)
+        _, s4 = transformer_beam_search(params, cfg, prompt, N,
+                                        beam_width=4)
+        assert bool((s4[:, 0] >= s1[:, 0] - 1e-5).all())
+
+    def test_width_validation(self):
+        from horovod_tpu.models import transformer_beam_search
+
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 2), jnp.int32)
+        with pytest.raises(ValueError, match="beam_width"):
+            transformer_beam_search(params, cfg, prompt, 2, beam_width=0)
