@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from horovod_tpu.models import (
     TransformerConfig,
+    transformer_beam_search,
     transformer_generate,
     transformer_init,
 )
@@ -34,6 +35,8 @@ def main():
     p.add_argument("--new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--beam", type=int, default=0,
+                   help="beam width (0 = greedy/sampling path)")
     args = p.parse_args()
 
     cfg = TransformerConfig(
@@ -49,18 +52,31 @@ def main():
         raise SystemExit(
             "--top-p needs --temperature > 0 (greedy decoding ignores "
             "the nucleus)")
+    if args.beam and (args.temperature or args.top_p < 1.0):
+        raise SystemExit(
+            "--beam is deterministic; drop --temperature/--top-p")
     rng = jax.random.PRNGKey(2) if args.temperature else None
     t0 = time.perf_counter()
-    out, cache = transformer_generate(
-        params, cfg, prompt, args.new_tokens,
-        temperature=args.temperature, top_p=args.top_p, rng=rng)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-    n = args.batch * args.new_tokens
-    print(f"generated {n} tokens in {dt:.2f}s "
-          f"({n / dt:.0f} tok/s incl. compile); cache pos "
-          f"{int(cache['pos'])}, kv heads {cfg.kv_heads}")
-    print("first sequence:", out[0].tolist())
+    if args.beam:
+        out, scores = transformer_beam_search(
+            params, cfg, prompt, args.new_tokens, beam_width=args.beam)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
+        n = args.batch * args.new_tokens * args.beam
+        print(f"beam {args.beam}: {n} tokens in {dt:.2f}s; best score "
+              f"{float(scores[0, 0]):.3f}")
+        print("best sequence:", out[0, 0].tolist())
+    else:
+        out, cache = transformer_generate(
+            params, cfg, prompt, args.new_tokens,
+            temperature=args.temperature, top_p=args.top_p, rng=rng)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
+        n = args.batch * args.new_tokens
+        print(f"generated {n} tokens in {dt:.2f}s "
+              f"({n / dt:.0f} tok/s incl. compile); cache pos "
+              f"{int(cache['pos'])}, kv heads {cfg.kv_heads}")
+        print("first sequence:", out[0].tolist())
 
 
 if __name__ == "__main__":

@@ -436,6 +436,9 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
     W = int(beam_width)
     if W < 1:
         raise ValueError(f"beam_width must be >= 1, got {W}")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
     V = cfg.vocab_size
     max_len = _resolve_max_len(cfg, T0, max_new_tokens, max_len)
 
@@ -494,9 +497,8 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
         # Equal-length beams: a pure normalization of the reported
         # scores (see docstring) — ranking is unchanged.
         scores = scores / (float(max_new_tokens) ** length_penalty)
-    order = jnp.argsort(-scores, axis=-1)
-    out = jnp.take_along_axis(out, order[:, :, None], axis=1)
-    scores = jnp.take_along_axis(scores, order, axis=1)
+    # Already sorted best-first: lax.top_k emits descending scores and
+    # the normalization above is order-preserving.
     return out, scores
 
 
