@@ -124,6 +124,14 @@ class TestExamples:
             extra_env={"XLA_FLAGS": ""})
         assert "generated" in out
 
+    def test_generate_beam(self):
+        out = _run_example(
+            "generate.py",
+            ["--d-model", "64", "--n-layers", "2", "--n-heads", "4",
+             "--new-tokens", "6", "--beam", "2"],
+            extra_env={"XLA_FLAGS": ""})
+        assert "best score" in out
+
     def test_elastic_resnet_under_driver(self, tmp_path):
         script = tmp_path / "discover.sh"
         script.write_text("#!/bin/sh\necho localhost:1\n")
