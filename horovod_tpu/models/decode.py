@@ -149,6 +149,23 @@ def _moe_tokens(mp, scale, x, cfg: TransformerConfig):
     return x + out.reshape(B, T, D).astype(x.dtype)
 
 
+def _layer_walk(params, ck, cv, x, attn_fn, cfg, tp_axis=None):
+    """Layer walk shared by decode and prefill: homogeneous dense
+    configs scan over the stacked params; mixed dense/MoE configs take
+    the unrolled walk.  attn_fn(lp, ck_i, cv_i, x) -> (x, ck_i, cv_i)
+    supplies the step- or prompt-shaped attention."""
+    if not cfg.moe_every:
+        def layer_step(x, inputs):
+            lp, cki, cvi = inputs
+            x, cki, cvi = attn_fn(lp, cki, cvi, x)
+            x = _mlp_block(lp, x, cfg, tp_axis)
+            return x, (cki, cvi)
+
+        x, (ck, cv) = lax.scan(layer_step, x, (params["blocks"], ck, cv))
+        return x, ck, cv
+    return _mixed_layer_walk(params, ck, cv, x, attn_fn, cfg, tp_axis)
+
+
 def _mixed_layer_walk(params, ck, cv, x, attn_fn, cfg, tp_axis=None):
     """Unrolled dense/MoE layer walk shared by decode and prefill
     (mirrors transformer_ref_apply): attn_fn(lp, ck_i, cv_i, x) ->
@@ -186,24 +203,11 @@ def transformer_decode_step(params: Dict, cache: Dict, tokens,
     x = params["embed"][tokens].astype(dt)[:, None, :]    # [B,1,D]
     pos = cache["pos"]
 
-    if not cfg.moe_every:
-        # Homogeneous dense layers: scan over the stacked params.
-        def layer_step(x, inputs):
-            lp, ck, cv = inputs
-            x, ck, cv = _decode_layer(lp, ck, cv, x, pos, cfg, tp_axis)
-            x = _mlp_block(lp, x, cfg, tp_axis)
-            return x, (ck, cv)
-
-        x, (ck, cv) = lax.scan(layer_step, x,
-                               (params["blocks"], cache["k"],
-                                cache["v"]))
-    else:
-        # Mixed dense/MoE: unrolled walk (n_layers is static).
-        x, ck, cv = _mixed_layer_walk(
-            params, cache["k"], cache["v"], x,
-            lambda lp, cki, cvi, x: _decode_layer(lp, cki, cvi, x, pos,
-                                                  cfg, tp_axis),
-            cfg, tp_axis)
+    x, ck, cv = _layer_walk(
+        params, cache["k"], cache["v"], x,
+        lambda lp, cki, cvi, x: _decode_layer(lp, cki, cvi, x, pos,
+                                              cfg, tp_axis),
+        cfg, tp_axis)
     x = _rmsnorm(params["final_norm"]["scale"], x)
     logits = jnp.einsum("bod,vd->bov", x.astype(dt),
                         params["embed"].astype(dt),
@@ -300,6 +304,10 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
         raise ValueError("sampling (temperature > 0) needs rng")
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_p < 1.0 and not temperature:
+        raise ValueError(
+            "top_p < 1 needs temperature > 0 (greedy decoding ignores "
+            "the nucleus)")
     cache = init_decode_cache(cfg, B, max_len)
     last_logits, cache = transformer_prefill(params, cache, prompt, cfg)
 

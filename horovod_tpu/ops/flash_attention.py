@@ -59,18 +59,28 @@ _NEG = -1e30
 _BLOCK = 128  # default q/k block rows (= lane width)
 
 
-def _block_sizes(t: int):
-    """(block_q, block_k) from HOROVOD_FLASH_BLOCK_Q/K (default 128).
+def _fit_block(req: int, t: int) -> int:
+    """Largest 128-multiple divisor of t not exceeding req (t % 128 == 0
+    is validated upstream).  A requested tile that does not divide this
+    T must not make a previously-working shape fail — a T=384 call with
+    HOROVOD_FLASH_BLOCK_Q=256 runs at 128, it does not raise."""
+    if req >= t:
+        return t
+    for m in range(min(req, t) // _BLOCK, 0, -1):
+        if t % (m * _BLOCK) == 0:
+            return m * _BLOCK
+    return min(req, t)
 
-    Clamped to T so short sequences never over-tile; both must divide T
-    (callers validate T % 128 == 0 and the env values are powers of two
-    in every supported sweep config)."""
-    bq = min(util.env_int("FLASH_BLOCK_Q", _BLOCK), t)
-    bk = min(util.env_int("FLASH_BLOCK_K", _BLOCK), t)
+
+def _block_sizes(t: int):
+    """(block_q, block_k) from HOROVOD_FLASH_BLOCK_Q/K (default 128),
+    clamped to the largest dividing tile for this T (see _fit_block)."""
+    bq = util.env_int("FLASH_BLOCK_Q", _BLOCK)
+    bk = util.env_int("FLASH_BLOCK_K", _BLOCK)
     if bq <= 0 or bk <= 0:
         raise ValueError(
             f"HOROVOD_FLASH_BLOCK_Q/K must be positive, got ({bq}, {bk})")
-    return bq, bk
+    return _fit_block(bq, t), _fit_block(bk, t)
 
 
 def _tc_params():
@@ -478,11 +488,6 @@ def _check_and_to3(q, k, v, window=None, causal=True,
     if T % _BLOCK:
         raise ValueError(
             f"flash_attention needs seq len % {_BLOCK} == 0, got {T}")
-    bq, bk = _block_sizes(T)
-    if T % bq or T % bk:
-        raise ValueError(
-            f"flash_attention: HOROVOD_FLASH_BLOCK_Q/K ({bq}, {bk}) "
-            f"must divide seq len {T}")
     if window is not None:
         if not causal:
             raise ValueError(

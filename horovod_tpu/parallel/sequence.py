@@ -274,6 +274,12 @@ def dense_attention_oracle(q, k, v, causal: bool = True, q_offset: int = 0,
             raise ValueError(
                 f"segment_ids must be (batch, key_len) = ({B}, {Tk}), "
                 f"got {tuple(segment_ids.shape)}")
+        if q_offset < 0 or q_offset + Tq > Tk:
+            # dynamic_slice would silently CLAMP an out-of-range start,
+            # masking queries with another position's segment id.
+            raise ValueError(
+                f"q_offset {q_offset} + Tq {Tq} out of range for "
+                f"key_len {Tk}")
         q_seg = lax.dynamic_slice_in_dim(segment_ids, q_offset, Tq,
                                          axis=1)
         smask = (q_seg[:, :, None] == segment_ids[:, None, :])

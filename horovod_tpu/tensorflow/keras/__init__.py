@@ -274,8 +274,21 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
             opt, compression=compression, **dist_kwargs)
         if getattr(opt, "built", False):
             wrapped.build(model.trainable_variables)
-            for dst, src in zip(wrapped.variables, opt.variables):
-                dst.assign(src)
+            if len(wrapped.variables) == len(opt.variables):
+                for dst, src in zip(wrapped.variables, opt.variables):
+                    dst.assign(src)
+            else:
+                # Keras restored a partial optimizer (its own "Skipping
+                # variable loading" case): a prefix copy could misalign
+                # slots silently, so keep the fresh state and say so.
+                import warnings
+
+                warnings.warn(
+                    f"load_model: restored optimizer has "
+                    f"{len(opt.variables)} variables but the wrapped "
+                    f"optimizer builds {len(wrapped.variables)}; slot "
+                    f"state NOT transferred (fresh optimizer state)",
+                    stacklevel=2)
         model.optimizer = wrapped
     return model
 

@@ -345,3 +345,12 @@ class TestBeamSearch:
         prompt = jnp.zeros((1, 2), jnp.int32)
         with pytest.raises(ValueError, match="beam_width"):
             transformer_beam_search(params, cfg, prompt, 2, beam_width=0)
+
+
+class TestGenerateValidation:
+    def test_top_p_without_temperature_rejected(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 2), jnp.int32)
+        with pytest.raises(ValueError, match="temperature"):
+            transformer_generate(params, cfg, prompt, 2, top_p=0.9)

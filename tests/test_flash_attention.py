@@ -97,11 +97,20 @@ class TestBlockSizes:
                 a, b, atol=3e-5 * max(1.0, scale), rtol=1e-4,
                 err_msg=f"d{name}")
 
-    def test_non_dividing_block_raises(self, monkeypatch):
+    def test_non_dividing_block_clamps(self, monkeypatch):
+        # A requested tile that does not divide T must not break a
+        # previously-working shape: 192 clamps to 128 for T=256.
         monkeypatch.setenv("HOROVOD_FLASH_BLOCK_Q", "192")
         q, k, v = qkv()
-        with pytest.raises(ValueError, match="must divide"):
-            fa.flash_attention(q, k, v)
+        np.testing.assert_allclose(
+            fa.flash_attention(q, k, v),
+            seq.dense_attention_oracle(q, k, v, causal=True),
+            atol=2e-5, rtol=2e-5)
+        assert fa._block_sizes(256) == (128, 128)
+        assert fa._block_sizes(384) == (128, 128)
+        monkeypatch.setenv("HOROVOD_FLASH_BLOCK_Q", "256")
+        assert fa._block_sizes(384) == (128, 128)   # 256 ∤ 384
+        assert fa._block_sizes(512) == (256, 128)
 
     def test_blocks_clamp_to_short_seq(self, monkeypatch):
         monkeypatch.setenv("HOROVOD_FLASH_BLOCK_Q", "512")
