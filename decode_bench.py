@@ -1,0 +1,128 @@
+"""KV-cache decode benchmark: prefill and per-token decode throughput.
+
+Measures the inference path (models/decode.py) the way bench.py
+measures training: wall-clock per compiled step, warmup discarded,
+JSON line per config on stdout, human table on stderr.  Configs cover
+the levers that matter at decode: GQA (cache bytes / group), sliding
+window (band-masked ring), and batch.
+
+Each config runs in a fresh killable subprocess (the wedged-tunnel
+defense from flash_sweep.py) so a hang kills one child, not the sweep.
+
+Usage:  python decode_bench.py            # real chip
+        JAX_PLATFORMS=cpu python decode_bench.py --tiny   # smoke
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+# (tag, cfg_kwargs, batch, prompt_len, new_tokens)
+CONFIGS = [
+    ("mha",        {},                                   8, 512, 64),
+    ("gqa4",       {"n_kv_heads": 2},                    8, 512, 64),
+    ("mqa",        {"n_kv_heads": 1},                    8, 512, 64),
+    ("gqa+win1k",  {"n_kv_heads": 2, "attn_window": 1024}, 8, 512, 64),
+]
+
+CHILD_CODE = r"""
+import json, sys, time
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+
+if {tiny!r} == "1":
+    jax.config.update("jax_platforms", "cpu")
+
+from horovod_tpu.models import (
+    TransformerConfig, transformer_init, transformer_prefill,
+    transformer_decode_step, init_decode_cache)
+
+kw = json.loads(sys.argv[1])
+B, T0, N = (int(a) for a in sys.argv[2:5])
+d_model = 256 if {tiny!r} == "1" else 1024
+layers = 2 if {tiny!r} == "1" else 8
+cfg = TransformerConfig(
+    vocab_size=8192, d_model=d_model, n_heads=d_model // 64, d_head=64,
+    d_ff=4 * d_model, n_layers=layers, **kw)
+params = transformer_init(jax.random.PRNGKey(0), cfg)
+prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T0), 0,
+                            cfg.vocab_size)
+
+cache = init_decode_cache(cfg, B, T0 + N)
+pf = jax.jit(lambda c, p: transformer_prefill(params, c, p, cfg))
+step = jax.jit(lambda c, t: transformer_decode_step(params, c, t, cfg))
+
+# prefill timing (compile excluded via a throwaway warmup)
+lg, warm = pf(init_decode_cache(cfg, B, T0 + N), prompt)
+jax.block_until_ready(lg)
+t0 = time.perf_counter()
+lg, cache = pf(cache, prompt)
+jax.block_until_ready(lg)
+t_prefill = time.perf_counter() - t0
+
+# decode timing: warmup 4 steps, time N
+tok = jnp.argmax(lg, axis=-1)
+for _ in range(4):
+    lg, cache = step(cache, tok)
+    tok = jnp.argmax(lg, axis=-1)
+jax.block_until_ready(lg)
+t0 = time.perf_counter()
+for _ in range(N):
+    lg, cache = step(cache, tok)
+    tok = jnp.argmax(lg, axis=-1)
+jax.block_until_ready(lg)
+dt = time.perf_counter() - t0
+kv_mb = cache["k"].size * cache["k"].dtype.itemsize * 2 / 1e6
+print(json.dumps({{
+    "prefill_ms": t_prefill * 1e3,
+    "prefill_tok_s": B * T0 / t_prefill,
+    "decode_ms_tok": dt / N * 1e3,
+    "decode_tok_s": B * N / dt,
+    "kv_cache_mb": kv_mb,
+}}))
+"""
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--tiny", action="store_true",
+                   help="small config / CPU smoke")
+    args = p.parse_args()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = CHILD_CODE.format(repo=repo, tiny="1" if args.tiny else "0")
+    for tag, kw, B, T0, N in CONFIGS:
+        if args.tiny:
+            B, T0, N = 2, 64, 8
+            if kw.get("attn_window"):
+                kw = dict(kw, attn_window=32)
+        env = dict(os.environ)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code, json.dumps(kw),
+                 str(B), str(T0), str(N)],
+                capture_output=True, text=True, timeout=900, env=env)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"config": tag, "error": "timeout"}),
+                  flush=True)
+            continue
+        if r.returncode != 0:
+            print(json.dumps({"config": tag, "error": "error"}),
+                  flush=True)
+            print(f"{tag}: {r.stderr[-300:]}", file=sys.stderr,
+                  flush=True)
+            continue
+        res = json.loads(r.stdout.strip().splitlines()[-1])
+        print(json.dumps({"config": tag, "B": B, "T0": T0, **res}),
+              flush=True)
+        print(f"{tag:10s} prefill {res['prefill_ms']:8.1f} ms "
+              f"({res['prefill_tok_s']:9.0f} tok/s)  decode "
+              f"{res['decode_ms_tok']:6.2f} ms/tok "
+              f"({res['decode_tok_s']:7.0f} tok/s)  kv "
+              f"{res['kv_cache_mb']:7.1f} MB",
+              file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()

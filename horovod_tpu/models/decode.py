@@ -246,21 +246,9 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
             out = lax.psum(out, tp_axis)
         return x + out.astype(x.dtype), ck, cv
 
-    if not cfg.moe_every:
-        def layer_step(x, inputs):
-            lp, ck, cv = inputs
-            x, ck, cv = attn(lp, ck, cv, x)
-            x = _mlp_block(lp, x, cfg, tp_axis)
-            return x, (ck, cv)
-
-        x, (ck, cv) = lax.scan(layer_step, x,
-                               (params["blocks"], cache["k"],
-                                cache["v"]))
-    else:
-        x, ck, cv = _mixed_layer_walk(
-            params, cache["k"], cache["v"], x,
-            lambda lp, cki, cvi, x: attn(lp, cki, cvi, x), cfg,
-            tp_axis)
+    x, ck, cv = _layer_walk(
+        params, cache["k"], cache["v"], x,
+        lambda lp, cki, cvi, x: attn(lp, cki, cvi, x), cfg, tp_axis)
     x = _rmsnorm(params["final_norm"]["scale"], x[:, -1:])
     logits = jnp.einsum("bod,vd->bov", x.astype(dt),
                         params["embed"].astype(dt),
@@ -300,6 +288,8 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
     it may be as small as max(window, T0) — the ring rolls."""
     B, T0 = prompt.shape
     max_len = _resolve_max_len(cfg, T0, max_new_tokens, max_len)
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")
     if not 0.0 < top_p <= 1.0:

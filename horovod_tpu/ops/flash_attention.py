@@ -69,7 +69,10 @@ def _fit_block(req: int, t: int) -> int:
     for m in range(min(req, t) // _BLOCK, 0, -1):
         if t % (m * _BLOCK) == 0:
             return m * _BLOCK
-    return min(req, t)
+    # req < 128: _BLOCK always divides T (callers validate T % 128 == 0)
+    # — never return a non-dividing tile, that would leave grid rows
+    # unwritten.
+    return _BLOCK
 
 
 def _block_sizes(t: int):
