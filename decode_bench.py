@@ -50,12 +50,12 @@ params = transformer_init(jax.random.PRNGKey(0), cfg)
 prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T0), 0,
                             cfg.vocab_size)
 
-cache = init_decode_cache(cfg, B, T0 + N)
+cache = init_decode_cache(cfg, B, T0 + N + 4)  # + warmup steps
 pf = jax.jit(lambda c, p: transformer_prefill(params, c, p, cfg))
 step = jax.jit(lambda c, t: transformer_decode_step(params, c, t, cfg))
 
 # prefill timing (compile excluded via a throwaway warmup)
-lg, warm = pf(init_decode_cache(cfg, B, T0 + N), prompt)
+lg, warm = pf(init_decode_cache(cfg, B, T0 + N + 4), prompt)
 jax.block_until_ready(lg)
 t0 = time.perf_counter()
 lg, cache = pf(cache, prompt)
@@ -97,18 +97,18 @@ def main():
             B, T0, N = 2, 64, 8
             if kw.get("attn_window"):
                 kw = dict(kw, attn_window=32)
-        env = dict(os.environ)
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code, json.dumps(kw),
                  str(B), str(T0), str(N)],
-                capture_output=True, text=True, timeout=900, env=env)
+                capture_output=True, text=True, timeout=900)
         except subprocess.TimeoutExpired:
             print(json.dumps({"config": tag, "error": "timeout"}),
                   flush=True)
             continue
         if r.returncode != 0:
-            print(json.dumps({"config": tag, "error": "error"}),
+            print(json.dumps({"config": tag,
+                              "error": f"exit {r.returncode}"}),
                   flush=True)
             print(f"{tag}: {r.stderr[-300:]}", file=sys.stderr,
                   flush=True)

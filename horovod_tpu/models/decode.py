@@ -56,22 +56,66 @@ from .transformer import (
 
 
 def init_decode_cache(cfg: TransformerConfig, batch: int,
-                      max_len: int) -> Dict:
+                      max_len: int, quantize=None) -> Dict:
     """Empty KV cache for `batch` sequences.
 
     `max_len` is the ring capacity: without a window it must cover the
     whole sequence; with `cfg.attn_window` it may be as small as the
-    window (the ring then rolls forever)."""
+    window (the ring then rolls forever).
+
+    `quantize="int8"` stores k/v as int8 with per-vector f32 scales
+    (max-abs over the head dim) — ~1/4 the cache bytes of an f32
+    compute dtype at ~0.4% per-vector quantization error, the
+    decode-side sibling of the int8 wire compression
+    (ops/quantized.py).  Reads dequantize inside the attention einsums;
+    writes quantize one vector per step."""
     if cfg.attn_window and max_len < cfg.attn_window:
         raise ValueError(
             f"max_len {max_len} < attn_window {cfg.attn_window}: the "
             f"ring would evict positions still inside the band")
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8', "
+                         f"got {quantize!r}")
     shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.d_head)
+    if quantize == "int8":
+        kv = lambda: {"q": jnp.zeros(shape, jnp.int8),
+                      "scale": jnp.zeros(shape[:-1], jnp.float32)}
+        return {"k": kv(), "v": kv(),
+                "pos": jnp.zeros((), jnp.int32)}
     return {
         "k": jnp.zeros(shape, cfg.compute_dtype),
         "v": jnp.zeros(shape, cfg.compute_dtype),
         "pos": jnp.zeros((), jnp.int32),
     }
+
+
+def _quant_vec(x):
+    """Per-vector int8: scale = max|x| / 127 over the trailing dim."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / 127.0, 1e-12)
+    q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+def _cache_write(c, val, slot):
+    """Write `val` (one position at decode, the whole prompt at
+    prefill — the slice length comes from val) into a possibly
+    quantized cache slice starting at `slot`."""
+    if isinstance(c, dict):
+        q, scale = _quant_vec(val)
+        return {"q": lax.dynamic_update_slice(c["q"], q,
+                                              (0, slot, 0, 0)),
+                "scale": lax.dynamic_update_slice(c["scale"], scale,
+                                                  (0, slot, 0))}
+    return lax.dynamic_update_slice(c, val, (0, slot, 0, 0))
+
+
+def _tree_idx(t, i):
+    return jax.tree_util.tree_map(lambda a: a[i], t)
+
+
+def _tree_set(t, i, v):
+    return jax.tree_util.tree_map(lambda a, b: a.at[i].set(b), t, v)
 
 
 def _slot_positions(pos, S):
@@ -92,7 +136,8 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
     (x, ck, cv) with slot `pos % S` overwritten.
     """
     dt = cfg.compute_dtype
-    B, S = ck.shape[0], ck.shape[1]
+    _shape_src = ck["q"] if isinstance(ck, dict) else ck
+    B, S = _shape_src.shape[0], _shape_src.shape[1]
     Dh = cfg.d_head
 
     h = _rmsnorm(lp["ln1"]["scale"], x)
@@ -106,21 +151,37 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
     k = _rope(k, positions, cfg.rope_theta).astype(dt)
 
     slot = pos % S
-    ck = lax.dynamic_update_slice(ck, k, (0, slot, 0, 0))
-    cv = lax.dynamic_update_slice(cv, v, (0, slot, 0, 0))
+    ck = _cache_write(ck, k, slot)
+    cv = _cache_write(cv, v, slot)
 
     # Grouped attention against the ring: q [B,1,Hkv,g,Dh] x
     # cache [B,S,Hkv,Dh] — the repeated kv heads never materialize.
+    # Under an int8 cache the per-vector scales FACTOR OUT of the
+    # contractions (scale is constant over Dh), so they multiply the
+    # [..,S]-shaped scores/probs instead of a Dh-times-larger
+    # dequantized cache copy.
     qg = q.reshape(B, 1, Hkv, g, Dh)
-    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
-                   ck.astype(jnp.float32)) / (Dh ** 0.5)   # [B,Hkv,g,1,S]
+    if isinstance(ck, dict):
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                       ck["q"].astype(jnp.float32))
+        s = s * ck["scale"].transpose(0, 2, 1)[:, :, None, None, :]
+        s = s / (Dh ** 0.5)
+    else:
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                       ck.astype(jnp.float32)) / (Dh ** 0.5)
     abs_pos = _slot_positions(pos, S)
     valid = (abs_pos >= 0) & (abs_pos <= pos)
     if cfg.attn_window:
         valid = valid & ((pos - abs_pos) < cfg.attn_window)
     s = jnp.where(valid[None, None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv.astype(jnp.float32))
+    if isinstance(cv, dict):
+        pv = p * cv["scale"].transpose(0, 2, 1)[:, :, None, None, :]
+        o = jnp.einsum("bhgqk,bkhd->bqhgd", pv,
+                       cv["q"].astype(jnp.float32))
+    else:
+        o = jnp.einsum("bhgqk,bkhd->bqhgd", p,
+                       cv.astype(jnp.float32))
     o = o.reshape(B, 1, Hq, Dh).astype(dt)
     out = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(dt))
     if tp_axis is not None:
@@ -173,9 +234,9 @@ def _mixed_layer_walk(params, ck, cv, x, attn_fn, cfg, tp_axis=None):
     moe_idx = 0
     for i in range(cfg.n_layers):
         lp = jax.tree_util.tree_map(lambda p: p[i], params["blocks"])
-        x, cki, cvi = attn_fn(lp, ck[i], cv[i], x)
-        ck = ck.at[i].set(cki)
-        cv = cv.at[i].set(cvi)
+        x, cki, cvi = attn_fn(lp, _tree_idx(ck, i), _tree_idx(cv, i), x)
+        ck = _tree_set(ck, i, cki)
+        cv = _tree_set(cv, i, cvi)
         if _is_moe_layer(cfg, i):
             mp = jax.tree_util.tree_map(lambda p: p[moe_idx],
                                         params["moe"])
@@ -223,7 +284,8 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
     (pos == 0) and T0 <= max_len."""
     dt = cfg.compute_dtype
     B, T0 = prompt.shape
-    S = cache["k"].shape[2]
+    _ck0 = cache["k"]
+    S = (_ck0["q"] if isinstance(_ck0, dict) else _ck0).shape[2]
     if T0 > S:
         raise ValueError(f"prompt length {T0} > cache max_len {S}")
     window = cfg.attn_window or None
@@ -237,8 +299,11 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
         v = jnp.einsum("btd,dhk->bthk", h, lp["wv"].astype(dt))
         q = _rope(q, positions, cfg.rope_theta).astype(dt)
         k = _rope(k, positions, cfg.rope_theta).astype(dt)
-        ck = lax.dynamic_update_slice(ck, k, (0, 0, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v, (0, 0, 0, 0))
+
+        # The prompt pass itself attends at full precision; decode
+        # steps read the quantized store (documented lossy boundary).
+        ck = _cache_write(ck, k, 0)
+        cv = _cache_write(cv, v, 0)
         o = seq_mod.full_attention(q, k, v, causal=True, window=window)
         out = jnp.einsum("bthk,hkd->btd", o.astype(dt),
                          lp["wo"].astype(dt))
@@ -273,8 +338,8 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
                          temperature: float = 0.0,
                          top_p: float = 1.0,
                          rng: Optional[jax.Array] = None,
-                         max_len: Optional[int] = None
-                         ) -> Tuple[jax.Array, Dict]:
+                         max_len: Optional[int] = None,
+                         quantize=None) -> Tuple[jax.Array, Dict]:
     """Generate `max_new_tokens` continuations of `prompt` [B, T0].
 
     Greedy when temperature == 0 (default), else softmax sampling at
@@ -298,7 +363,7 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
         raise ValueError(
             "top_p < 1 needs temperature > 0 (greedy decoding ignores "
             "the nucleus)")
-    cache = init_decode_cache(cfg, B, max_len)
+    cache = init_decode_cache(cfg, B, max_len, quantize=quantize)
     last_logits, cache = transformer_prefill(params, cache, prompt, cfg)
 
     def pick(logits, key):
@@ -336,7 +401,7 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
     return toks.T, cache                                  # [B, max_new]
 
 
-def make_decode_step(mesh, cfg: TransformerConfig):
+def make_decode_step(mesh, cfg: TransformerConfig, quantize=None):
     """Sharded inference: build (decode_step, prefill, shard_params,
     shard_cache, shard_tokens) over a dp x tp mesh.
 
@@ -380,11 +445,10 @@ def make_decode_step(mesh, cfg: TransformerConfig):
         is_leaf=lambda x: isinstance(x, P))
     tok_spec = P(dp)
     logits_spec = P(dp, None)
-    cache_spec = {
-        "k": P(None, dp, None, tp_axis, None),
-        "v": P(None, dp, None, tp_axis, None),
-        "pos": P(),
-    }
+    kv_spec = P(None, dp, None, tp_axis, None)
+    if quantize == "int8":
+        kv_spec = {"q": kv_spec, "scale": P(None, dp, None, tp_axis)}
+    cache_spec = {"k": kv_spec, "v": kv_spec, "pos": P()}
 
     step = jax.jit(shard_map(
         lambda p, c, t: transformer_decode_step(p, c, t, cfg, tp_axis),
@@ -402,8 +466,9 @@ def make_decode_step(mesh, cfg: TransformerConfig):
             params, pspecs)
 
     def shard_cache(cache):
-        return {k: jax.device_put(v, NamedSharding(mesh, cache_spec[k]))
-                for k, v in cache.items()}
+        return jax.tree_util.tree_map(
+            lambda v, sp: jax.device_put(v, NamedSharding(mesh, sp)),
+            cache, cache_spec)
 
     def shard_tokens(tokens):
         return jax.device_put(tokens, NamedSharding(mesh, tok_spec))
@@ -415,7 +480,8 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
                             prompt, max_new_tokens: int,
                             beam_width: int = 4,
                             length_penalty: float = 0.0,
-                            max_len: Optional[int] = None):
+                            max_len: Optional[int] = None,
+                            quantize=None):
     """Beam search over the KV-cache decode path.
 
     prompt [B, T0] -> (tokens [B, W, max_new], scores [B, W]) sorted
@@ -442,13 +508,14 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
 
     # Prefill ONCE per sequence, then tile each cache row W times
     # (beam-major: row b*W + w is beam w of sequence b).
-    cache = init_decode_cache(cfg, B, max_len)
+    cache = init_decode_cache(cfg, B, max_len, quantize=quantize)
     logits, cache = transformer_prefill(params, cache, prompt, cfg)
 
-    def tile(x, axis):
-        return jnp.repeat(x, W, axis=axis)
+    def tile(t):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.repeat(a, W, axis=1), t)
 
-    cache = {"k": tile(cache["k"], 1), "v": tile(cache["v"], 1),
+    cache = {"k": tile(cache["k"]), "v": tile(cache["v"]),
              "pos": cache["pos"]}
     logp = jax.nn.log_softmax(logits, axis=-1)              # [B, V]
     # First step: top-W distinct tokens seed the beams.
@@ -467,7 +534,9 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
         new_tok = flat_idx % V
         # Gather parent beams' cache rows (batch-major offsets).
         rows = (jnp.arange(B)[:, None] * W + parent).reshape(B * W)
-        cache = {"k": cache["k"][:, rows], "v": cache["v"][:, rows],
+        gather = lambda t: jax.tree_util.tree_map(
+            lambda a: a[:, rows], t)
+        cache = {"k": gather(cache["k"]), "v": gather(cache["v"]),
                  "pos": cache["pos"]}
         return ((cache, new_scores.reshape(B * W),
                  new_tok.reshape(B * W)),
