@@ -19,12 +19,14 @@ import os
 import subprocess
 import sys
 
-# (tag, cfg_kwargs, batch, prompt_len, new_tokens)
+# (tag, cfg_kwargs, quantize, batch, prompt_len, new_tokens)
 CONFIGS = [
-    ("mha",        {},                                   8, 512, 64),
-    ("gqa4",       {"n_kv_heads": 2},                    8, 512, 64),
-    ("mqa",        {"n_kv_heads": 1},                    8, 512, 64),
-    ("gqa+win1k",  {"n_kv_heads": 2, "attn_window": 1024}, 8, 512, 64),
+    ("mha",        {},                      None,   8, 512, 64),
+    ("gqa4",       {"n_kv_heads": 2},       None,   8, 512, 64),
+    ("mqa",        {"n_kv_heads": 1},       None,   8, 512, 64),
+    ("gqa+win1k",  {"n_kv_heads": 2,
+                    "attn_window": 1024},   None,   8, 512, 64),
+    ("gqa4+int8",  {"n_kv_heads": 2},       "int8", 8, 512, 64),
 ]
 
 CHILD_CODE = r"""
@@ -40,6 +42,7 @@ from horovod_tpu.models import (
     transformer_decode_step, init_decode_cache)
 
 kw = json.loads(sys.argv[1])
+quantize = sys.argv[5] or None
 B, T0, N = (int(a) for a in sys.argv[2:5])
 d_model = 256 if {tiny!r} == "1" else 1024
 layers = 2 if {tiny!r} == "1" else 8
@@ -50,12 +53,14 @@ params = transformer_init(jax.random.PRNGKey(0), cfg)
 prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T0), 0,
                             cfg.vocab_size)
 
-cache = init_decode_cache(cfg, B, T0 + N + 4)  # + warmup steps
+cache = init_decode_cache(cfg, B, T0 + N + 4,  # + warmup steps
+                          quantize=quantize)
 pf = jax.jit(lambda c, p: transformer_prefill(params, c, p, cfg))
 step = jax.jit(lambda c, t: transformer_decode_step(params, c, t, cfg))
 
 # prefill timing (compile excluded via a throwaway warmup)
-lg, warm = pf(init_decode_cache(cfg, B, T0 + N + 4), prompt)
+lg, warm = pf(init_decode_cache(cfg, B, T0 + N + 4,
+                                quantize=quantize), prompt)
 jax.block_until_ready(lg)
 t0 = time.perf_counter()
 lg, cache = pf(cache, prompt)
@@ -74,7 +79,8 @@ for _ in range(N):
     tok = jnp.argmax(lg, axis=-1)
 jax.block_until_ready(lg)
 dt = time.perf_counter() - t0
-kv_mb = cache["k"].size * cache["k"].dtype.itemsize * 2 / 1e6
+kv_mb = sum(a.size * a.dtype.itemsize for a in
+            jax.tree_util.tree_leaves((cache["k"], cache["v"]))) / 1e6
 print(json.dumps({{
     "prefill_ms": t_prefill * 1e3,
     "prefill_tok_s": B * T0 / t_prefill,
@@ -92,7 +98,7 @@ def main():
     args = p.parse_args()
     repo = os.path.dirname(os.path.abspath(__file__))
     code = CHILD_CODE.format(repo=repo, tiny="1" if args.tiny else "0")
-    for tag, kw, B, T0, N in CONFIGS:
+    for tag, kw, quantize, B, T0, N in CONFIGS:
         if args.tiny:
             B, T0, N = 2, 64, 8
             if kw.get("attn_window"):
@@ -100,7 +106,7 @@ def main():
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code, json.dumps(kw),
-                 str(B), str(T0), str(N)],
+                 str(B), str(T0), str(N), quantize or ""],
                 capture_output=True, text=True, timeout=900)
         except subprocess.TimeoutExpired:
             print(json.dumps({"config": tag, "error": "timeout"}),
