@@ -63,22 +63,23 @@ def init_decode_cache(cfg: TransformerConfig, batch: int,
     whole sequence; with `cfg.attn_window` it may be as small as the
     window (the ring then rolls forever).
 
-    `quantize="int8"` stores k/v as int8 with per-vector f32 scales
-    (max-abs over the head dim) — ~1/4 the cache bytes of an f32
-    compute dtype at ~0.4% per-vector quantization error, the
-    decode-side sibling of the int8 wire compression
-    (ops/quantized.py).  Reads dequantize inside the attention einsums;
-    writes quantize one vector per step."""
+    `quantize="int8"` (or `"fp8_e4m3"`, the v5e-native float8) stores
+    k/v in the 1-byte payload with per-vector f32 scales (max-abs over
+    the head dim) — ~1/4 the cache bytes of an f32 compute dtype, the
+    decode-side sibling of the int8/fp8 wire compression
+    (ops/quantized.py).  The scales factor into the attention
+    contractions; writes quantize one vector per step."""
     if cfg.attn_window and max_len < cfg.attn_window:
         raise ValueError(
             f"max_len {max_len} < attn_window {cfg.attn_window}: the "
             f"ring would evict positions still inside the band")
-    if quantize not in (None, "int8"):
-        raise ValueError(f"quantize must be None or 'int8', "
-                         f"got {quantize!r}")
+    if quantize not in (None, "int8", "fp8_e4m3"):
+        raise ValueError(f"quantize must be None, 'int8', or "
+                         f"'fp8_e4m3', got {quantize!r}")
     shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.d_head)
-    if quantize == "int8":
-        kv = lambda: {"q": jnp.zeros(shape, jnp.int8),
+    if quantize is not None:
+        qdt = jnp.int8 if quantize == "int8" else jnp.float8_e4m3fn
+        kv = lambda: {"q": jnp.zeros(shape, qdt),
                       "scale": jnp.zeros(shape[:-1], jnp.float32)}
         return {"k": kv(), "v": kv(),
                 "pos": jnp.zeros((), jnp.int32)}
@@ -89,11 +90,16 @@ def init_decode_cache(cfg: TransformerConfig, batch: int,
     }
 
 
-def _quant_vec(x):
-    """Per-vector int8: scale = max|x| / 127 over the trailing dim."""
+def _quant_vec(x, qdt):
+    """Per-vector quantization to `qdt` (int8 or fp8_e4m3): scale =
+    max|x| / payload_max over the trailing dim, so the largest element
+    lands at the payload's edge and nothing saturates."""
+    payload_max = 127.0 if qdt == jnp.int8 else 448.0
     xf = x.astype(jnp.float32)
-    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / 127.0, 1e-12)
-    q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / payload_max,
+                        1e-12)
+    scaled = xf / scale[..., None]
+    q = (jnp.round(scaled) if qdt == jnp.int8 else scaled).astype(qdt)
     return q, scale
 
 
@@ -102,7 +108,7 @@ def _cache_write(c, val, slot):
     prefill — the slice length comes from val) into a possibly
     quantized cache slice starting at `slot`."""
     if isinstance(c, dict):
-        q, scale = _quant_vec(val)
+        q, scale = _quant_vec(val, c["q"].dtype)
         return {"q": lax.dynamic_update_slice(c["q"], q,
                                               (0, slot, 0, 0)),
                 "scale": lax.dynamic_update_slice(c["scale"], scale,
@@ -446,7 +452,7 @@ def make_decode_step(mesh, cfg: TransformerConfig, quantize=None):
     tok_spec = P(dp)
     logits_spec = P(dp, None)
     kv_spec = P(None, dp, None, tp_axis, None)
-    if quantize == "int8":
+    if quantize is not None:    # int8 and fp8_e4m3 share the layout
         kv_spec = {"q": kv_spec, "scale": P(None, dp, None, tp_axis)}
     cache_spec = {"k": kv_spec, "v": kv_spec, "pos": P()}
 

@@ -432,6 +432,41 @@ class TestQuantizedCache:
                                    np.asarray(ref_lg2),
                                    atol=3e-4, rtol=3e-4)
 
+    def test_fp8_cache_close_to_full_precision(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 64)
+        cf = init_decode_cache(cfg, 1, 8)
+        cq = init_decode_cache(cfg, 1, 8, quantize="fp8_e4m3")
+        assert cq["k"]["q"].dtype == jnp.float8_e4m3fn
+        worst = 0.0
+        for t in range(8):
+            lf, cf = transformer_decode_step(params, cf, toks[:, t], cfg)
+            lq, cq = transformer_decode_step(params, cq, toks[:, t], cfg)
+            denom = float(jnp.max(jnp.abs(lf))) or 1.0
+            worst = max(worst,
+                        float(jnp.max(jnp.abs(lf - lq))) / denom)
+        assert 0.0 < worst < 0.08, worst   # e4m3 ~2 mantissa bits
+
     def test_bad_quantize_rejected(self):
         with pytest.raises(ValueError, match="quantize"):
             init_decode_cache(_cfg(), 1, 8, quantize="fp4")
+
+
+def test_sharded_fp8_cache_builds_and_steps():
+    from jax.sharding import Mesh
+    from horovod_tpu.models import make_decode_step
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cfg = _cfg(n_kv_heads=2)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 64)
+    step, prefill, shard_params, shard_cache, shard_tokens = \
+        make_decode_step(mesh, cfg, quantize="fp8_e4m3")
+    sp = shard_params(params)
+    sc = shard_cache(init_decode_cache(cfg, 2, 6, quantize="fp8_e4m3"))
+    lg, sc = prefill(sp, sc, toks)
+    lg, sc = step(sp, sc, shard_tokens(jnp.argmax(lg, axis=-1)))
+    assert bool(jnp.isfinite(lg).all())
