@@ -69,10 +69,14 @@ def init_decode_cache(cfg: TransformerConfig, batch: int,
     decode-side sibling of the int8/fp8 wire compression
     (ops/quantized.py).  The scales factor into the attention
     contractions; writes quantize one vector per step."""
-    if cfg.attn_window and max_len < cfg.attn_window:
-        raise ValueError(
-            f"max_len {max_len} < attn_window {cfg.attn_window}: the "
-            f"ring would evict positions still inside the band")
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    # A cache SMALLER than the window is fine as long as the ring never
+    # wraps (total tokens <= max_len) — eviction only matters past
+    # max_len.  The wrap-capable entry points (transformer_generate /
+    # transformer_beam_search via _resolve_max_len) enforce
+    # max_len >= attn_window exactly when the sequence will wrap; raw
+    # decode_step callers own the contract (see its docstring).
     if quantize not in (None, "int8", "fp8_e4m3"):
         raise ValueError(f"quantize must be None, 'int8', or "
                          f"'fp8_e4m3', got {quantize!r}")
@@ -262,9 +266,11 @@ def transformer_decode_step(params: Dict, cache: Dict, tokens,
     """Absorb one token per sequence; return (logits [B, V], cache).
 
     `tokens` [B] int32.  The cache is a ring: with `cfg.attn_window`
-    set, decoding may continue past `max_len` indefinitely; without a
-    window the caller must size `max_len` to the full sequence (older
-    positions would be silently evicted otherwise).
+    set and max_len >= the window, decoding may continue past `max_len`
+    indefinitely; without a window — or with a cache smaller than the
+    window — the caller must keep the TOTAL sequence within `max_len`
+    (older positions would be silently evicted otherwise; the
+    generate/beam entry points enforce this via _resolve_max_len).
     """
     dt = cfg.compute_dtype
     x = params["embed"][tokens].astype(dt)[:, None, :]    # [B,1,D]
@@ -294,6 +300,15 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
     S = (_ck0["q"] if isinstance(_ck0, dict) else _ck0).shape[2]
     if T0 > S:
         raise ValueError(f"prompt length {T0} > cache max_len {S}")
+    # Prefill writes the prompt at slot 0; a warm cache (pos != 0)
+    # would silently desync slot <-> absolute-position bookkeeping.
+    # Enforce eagerly whenever pos is concrete (inside jit pos is a
+    # tracer and the contract is on the caller).
+    if not isinstance(cache["pos"], jax.core.Tracer):
+        if int(cache["pos"]) != 0:
+            raise ValueError(
+                f"transformer_prefill requires a fresh cache "
+                f"(pos == 0), got pos = {int(cache['pos'])}")
     window = cfg.attn_window or None
     x = params["embed"][prompt].astype(dt)                # [B,T0,D]
     positions = jnp.arange(T0)
@@ -332,10 +347,20 @@ def _resolve_max_len(cfg, T0, max_new_tokens, max_len):
     """Shared generate/beam cache-capacity rule: default to the full
     sequence; allow a smaller rolling ring only for windowed configs."""
     max_len = max_len or (T0 + max_new_tokens)
-    if T0 + max_new_tokens > max_len and not cfg.attn_window:
-        raise ValueError(
-            f"max_len {max_len} < prompt {T0} + new {max_new_tokens} "
-            f"(only windowed configs may roll the cache)")
+    if T0 + max_new_tokens > max_len:
+        if not cfg.attn_window:
+            raise ValueError(
+                f"max_len {max_len} < prompt {T0} + new "
+                f"{max_new_tokens} (only windowed configs may roll "
+                f"the cache)")
+        if max_len < cfg.attn_window:
+            raise ValueError(
+                f"max_len {max_len} < attn_window {cfg.attn_window} "
+                f"and the sequence ({T0} + {max_new_tokens} tokens) "
+                f"wraps the ring: positions still inside the band "
+                f"would be evicted — size max_len >= "
+                f"max(attn_window, prompt length) = "
+                f"{max(cfg.attn_window, T0)}")
     return max_len
 
 

@@ -468,6 +468,20 @@ def _flash3_bwd(causal, window, group, hq, res, g):
 _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
+def validate_window(window, causal):
+    """Shared window/causal contract for EVERY attention entry point
+    (flash kernel, dense oracle, ring) — one definition so the three
+    paths cannot drift (r4 review)."""
+    if window is None:
+        return
+    if not causal:
+        raise ValueError(
+            "window requires causal=True (a non-causal symmetric band "
+            "is not implemented)")
+    if int(window) < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+
+
 def _check_and_to3(q, k, v, window=None, causal=True,
                    segment_ids=None):
     if not PALLAS_AVAILABLE:
@@ -491,13 +505,7 @@ def _check_and_to3(q, k, v, window=None, causal=True,
     if T % _BLOCK:
         raise ValueError(
             f"flash_attention needs seq len % {_BLOCK} == 0, got {T}")
-    if window is not None:
-        if not causal:
-            raise ValueError(
-                "flash_attention: window requires causal=True")
-        if int(window) < 1:
-            raise ValueError(f"flash_attention: window must be >= 1, "
-                             f"got {window}")
+    validate_window(window, causal)
     seg3 = None
     if segment_ids is not None:
         if tuple(segment_ids.shape) != (B, T):

@@ -141,8 +141,7 @@ def ring_attention_shard(q, k, v, axis: str, causal: bool = True,
     """
     from ..ops import flash_attention as fa
 
-    if window is not None and int(window) < 1:
-        raise ValueError(f"window must be >= 1, got {window}")
+    fa.validate_window(window, causal)
     if (window is None and fa.flash_routed(q.shape[1])
             and q.shape[1] % 128 == 0):
         # The flash per-pair engine has no q_offset/window banding; the
@@ -248,8 +247,9 @@ def dense_attention_oracle(q, k, v, causal: bool = True, q_offset: int = 0,
     q, Hq % Hkv == 0, q head h attending kv head h // (Hq//Hkv)) and
     causal sliding-window masking (`window`: each query sees at most the
     last `window` keys)."""
-    if window is not None and int(window) < 1:
-        raise ValueError(f"window must be >= 1, got {window}")
+    from ..ops.flash_attention import validate_window
+
+    validate_window(window, causal)
     B, Tq, Hq, D = q.shape
     Tk = k.shape[1]
     k, v = repeat_kv(q, k, v)

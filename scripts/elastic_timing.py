@@ -1,0 +1,159 @@
+"""Time an elastic membership change on the real chip (VERDICT r4 #8).
+
+SURVEY §7 hard-part #1 is the recompile-on-membership-change cost: when
+the world changes, every worker tears the runtime down, re-rendezvous,
+and runs a NEW compiled step program (new mesh / new global batch).
+The elastic integration tests exercise this on the CPU sim; this script
+puts a NUMBER on it on the real TPU, single-chip (the recompile is the
+device-dependent term; rendezvous is host-side and measured separately
+by the np=2/4/8 launcher tests).
+
+It drives the REAL code path — `@hvd.elastic.run`, TpuState
+commit/restore/sync, `_reset()` (shutdown + re-init) — by raising
+HostsUpdatedInterrupt from inside the loop, then measures two recovery
+flavors:
+
+  recover_same_world_s — membership event that keeps the world size
+                        (a replaced worker): runtime re-init + state
+                        sync + step rebuild for IDENTICAL shapes.  The
+                        XLA-level compilation cache may shortcut the
+                        compile; what survives is measured, not assumed.
+  recover_resized_s   — the world size changed, so the new program has
+                        a new global batch: re-init + sync + a genuine
+                        XLA recompile of the training step + first
+                        step.  This is the number SURVEY §7 calls THE
+                        hard part.
+
+Output: one JSON line on stdout; diagnostics on stderr.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+from horovod_tpu.models import resnet_apply, resnet_init
+
+DEPTH = int(os.environ.get("ELASTIC_TIMING_DEPTH", "50"))
+BATCH = int(os.environ.get("ELASTIC_TIMING_BATCH", "64"))
+STEADY_STEPS = 6
+
+
+def log(msg):
+    print(f"[elastic_timing] {msg}", file=sys.stderr, flush=True)
+
+
+def make_step(cfg, opt):
+    @hvd.data_parallel
+    def train_step(model, opt_state, batch):
+        xb, yb = batch
+
+        def loss_fn(p):
+            logits, ns = resnet_apply(
+                {"params": p, "batch_stats": model["batch_stats"],
+                 "config": cfg}, xb, train=True)
+            onehot = jax.nn.one_hot(yb, 10)
+            return -jnp.mean(
+                jnp.sum(jax.nn.log_softmax(logits) * onehot, -1)), ns
+
+        (loss, ns), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(model["params"])
+        updates, opt_state2 = opt.update(grads, opt_state,
+                                         model["params"])
+        params = optax.apply_updates(model["params"], updates)
+        return {"params": params, "batch_stats": ns}, opt_state2, loss
+
+    return train_step
+
+
+def batch_for(n):
+    x = jnp.asarray(np.random.rand(n, 224, 224, 3).astype(np.float32))
+    y = jnp.asarray(np.random.randint(0, 10, size=n))
+    return x, y
+
+
+def main():
+    hvd.init()
+    log(f"platform={jax.devices()[0].platform} size={hvd.size()}")
+    v = resnet_init(jax.random.PRNGKey(0), DEPTH, num_classes=10)
+    opt = optax.sgd(0.01, momentum=0.9)
+    state = hvd.elastic.TpuState(
+        params={"params": v["params"], "batch_stats": v["batch_stats"]},
+        opt_state=opt.init(v["params"]), phase=0)
+
+    timings = {}
+    marks = {}
+
+    @hvd.elastic.run
+    def train(state):
+        step = make_step(v["config"], opt)
+        # Phase-dependent global batch: phase 2 changes the shape, which
+        # is what a changed world size does to the per-program batch.
+        n = BATCH if state.phase < 2 else BATCH + 32
+        batch = batch_for(n)
+        model = state.params
+        opt_state = state.opt_state
+
+        # First step after (re)entry: this IS the recovery endpoint.
+        t0 = time.perf_counter()
+        model, opt_state, loss = step(model, opt_state, batch)
+        jax.block_until_ready(loss)
+        t_first = time.perf_counter() - t0
+        if state.phase == 1:
+            timings["recover_same_world_s"] = (
+                time.perf_counter() - marks["interrupt1"])
+        elif state.phase == 2:
+            timings["recover_resized_s"] = (
+                time.perf_counter() - marks["interrupt2"])
+        timings.setdefault(f"first_step_phase{state.phase}_s", t_first)
+
+        ts = []
+        for _ in range(STEADY_STEPS):
+            t0 = time.perf_counter()
+            model, opt_state, loss = step(model, opt_state, batch)
+            jax.block_until_ready(loss)
+            ts.append(time.perf_counter() - t0)
+        timings.setdefault(f"steady_phase{state.phase}_ms",
+                           1e3 * float(np.median(ts)))
+
+        state.params = model
+        state.opt_state = opt_state
+        state.commit()
+
+        if state.phase == 0:
+            state.phase = 1
+            state.commit()
+            log("raising HostsUpdatedInterrupt #1 (same world size)")
+            marks["interrupt1"] = time.perf_counter()
+            raise HostsUpdatedInterrupt()
+        if state.phase == 1:
+            state.phase = 2
+            state.commit()
+            log("raising HostsUpdatedInterrupt #2 (resized world -> "
+                "new global batch, recompile)")
+            marks["interrupt2"] = time.perf_counter()
+            raise HostsUpdatedInterrupt()
+        return state
+
+    t_all = time.perf_counter()
+    train(state)
+    timings["total_s"] = time.perf_counter() - t_all
+    timings["platform"] = jax.devices()[0].platform
+    timings["model"] = f"resnet{DEPTH}"
+    timings["batch"] = BATCH
+    print(json.dumps({k: (round(v, 3) if isinstance(v, float) else v)
+                      for k, v in timings.items()}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,7 @@ from horovod_tpu.models import (
     transformer_decode_step,
     transformer_generate,
     transformer_init,
+    transformer_prefill,
     transformer_ref_apply,
 )
 
@@ -186,10 +187,40 @@ class TestRingCacheAndPrefill:
                                           max_len=4)
         assert out.shape == (1, 10) and int(cache["pos"]) == 14
 
-    def test_ring_smaller_than_window_rejected(self):
+    def test_ring_smaller_than_window(self):
+        # A cache smaller than the window is legal as long as the ring
+        # never wraps (r4 advisor): init accepts it, a NON-wrapping
+        # generate works, and a WRAPPING generate is rejected eagerly.
         cfg = _cfg(attn_window=8)
-        with pytest.raises(ValueError, match="ring"):
-            init_decode_cache(cfg, 1, 4)
+        init_decode_cache(cfg, 1, 4)           # no raise
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 2), 0, 64)
+        out, cache = transformer_generate(params, cfg, prompt, 2,
+                                          max_len=4)
+        assert out.shape == (1, 2) and int(cache["pos"]) == 4
+        with pytest.raises(ValueError, match="wraps the ring"):
+            transformer_generate(params, cfg, prompt, 6, max_len=4)
+
+    def test_short_ring_matches_full_cache_when_not_wrapping(self):
+        # Same tokens whether the cache is exactly-sized (< window) or
+        # generously sized: a non-wrapping short ring changes nothing.
+        cfg = _cfg(attn_window=8)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 3), 0, 64)
+        out_short, _ = transformer_generate(params, cfg, prompt, 3,
+                                            max_len=6)
+        out_full, _ = transformer_generate(params, cfg, prompt, 3,
+                                           max_len=32)
+        assert (np.asarray(out_short) == np.asarray(out_full)).all()
+
+    def test_prefill_requires_fresh_cache(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 4), 0, 64)
+        cache = init_decode_cache(cfg, 1, 16)
+        _, warm = transformer_prefill(params, cache, prompt, cfg)
+        with pytest.raises(ValueError, match="fresh cache"):
+            transformer_prefill(params, warm, prompt, cfg)
 
 
 class TestShardedDecode:

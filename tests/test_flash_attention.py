@@ -335,9 +335,15 @@ class TestGQAWindow:
             atol=2e-5, rtol=2e-5)
 
     def test_window_requires_causal(self):
+        # All three entry points agree (r4 advisor: the dense paths used
+        # to silently accept the combination with different semantics).
         q, k, v = qkv(T=128)
         with pytest.raises(ValueError, match="causal"):
             fa.flash_attention(q, k, v, causal=False, window=64)
+        with pytest.raises(ValueError, match="causal"):
+            seq.dense_attention_oracle(q, k, v, causal=False, window=64)
+        with pytest.raises(ValueError, match="causal"):
+            seq.full_attention(q, k, v, causal=False, window=64)
 
     def test_bad_gqa_heads_raise(self):
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
