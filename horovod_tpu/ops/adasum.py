@@ -22,8 +22,15 @@ eager path compiles the whole tree as one XLA program over the
 rank-sharded stacked array.  Low-precision inputs are accumulated at f32
 (SURVEY.md hard-part #3: Adasum numerics at bf16).
 
-Requires power-of-two rank counts, as upstream's VHDD core does for the
-in-node ladder.
+Rank counts beyond powers of two (upstream's VHDD core is pow-2-only;
+upstream covers real topologies by composing hierarchical MPI Adasum)
+use a pow-2-subgroup + residual scheme (r5): with n = 2^k + r, the r
+residual ranks first FOLD their gradients into ranks 0..r-1 with one
+Adasum pair combine each, the leading 2^k ranks run the standard
+ladder, and the result is sent back to the residual ranks.  This is the
+same binary tree with unbalanced leaves — the f64 reference model
+(`adasum_reference`) defines the semantics for every n and the
+implementations are tested against it.
 """
 
 from __future__ import annotations
@@ -72,15 +79,27 @@ def _pair_combine_batched(a, b):
     return jax.vmap(_pair_combine)(a, b)
 
 
+def _pow2_floor(n: int) -> int:
+    k = 1
+    while k * 2 <= n:
+        k *= 2
+    return k
+
+
 def adasum_tree_reduce(xs):
     """Reduce (n, *s) stacked gradients with the Adasum binary tree.
 
-    Pure function of the stacked array; usable under jit.  `n` must be a
-    power of two.
+    Pure function of the stacked array; usable under jit.  Non-pow-2 `n`
+    folds the n - 2^k residual entries into the first ranks with one
+    pair combine each (unbalanced leaves), then runs the balanced tree.
     """
     n = xs.shape[0]
     if n & (n - 1):
-        raise HorovodTpuError(f"Adasum requires power-of-two ranks, got {n}")
+        k = _pow2_floor(n)
+        r = n - k
+        folded = _pair_combine_batched(xs[:r], xs[k:])
+        xs = jnp.concatenate([folded, xs[r:k]], axis=0)
+        n = k
     while n > 1:
         xs = _pair_combine_batched(xs[0::2], xs[1::2])
         n //= 2
@@ -94,21 +113,40 @@ def adasum_in_axis(x, axis_name: str = GLOBAL_AXIS):
     rank r XOR 2^k and combines, lower index as `a`.  After log2(n) levels
     every rank holds the tree-combined result — the same value
     `adasum_tree_reduce` computes.
+
+    Non-pow-2 axis sizes bracket the ladder with the residual fold:
+    ranks 2^k..n-1 ppermute their gradient to ranks 0..r-1 (one extra
+    pair combine there), sit out the ladder, and receive the final
+    result with one last ppermute — same semantics as the unbalanced
+    tree in `adasum_reference`, two extra ICI hops total.
     """
     n = lax.axis_size(axis_name)
-    if n & (n - 1):
-        raise HorovodTpuError(f"Adasum requires power-of-two ranks, got {n}")
     idx = lax.axis_index(axis_name)
     v = x
+    k = _pow2_floor(n)
+    r = n - k
+    if r:
+        # Fold residual ranks' gradients into ranks 0..r-1.  Non-target
+        # ranks receive zeros from the partial permute; their combine
+        # result is discarded by the where.
+        perm = [(k + i, i) for i in range(r)]
+        w = lax.ppermute(v, axis_name, perm=perm)
+        v = jnp.where(idx < r, _pair_combine(v, w), v)
     d = 1
-    while d < n:
-        perm = [(i, i ^ d) for i in range(n)]
+    while d < k:
+        perm = [(i, i ^ d) for i in range(k)]
         w = lax.ppermute(v, axis_name, perm=perm)
         is_lower = ((idx & d) == 0)
         a = jnp.where(is_lower, v, w)
         b = jnp.where(is_lower, w, v)
-        v = _pair_combine(a, b)
+        combined = _pair_combine(a, b)
+        v = jnp.where(idx < k, combined, v) if r else combined
         d *= 2
+    if r:
+        # Ship the result back to the residual ranks.
+        perm = [(i, k + i) for i in range(r)]
+        w = lax.ppermute(v, axis_name, perm=perm)
+        v = jnp.where(idx >= k, w, v)
     return v
 
 
@@ -138,9 +176,13 @@ def adasum_allreduce(
 
 
 def adasum_reference(arrays):
-    """NumPy reference model of the Adasum recursion (mirrors the numerical
-    model in test_adasum_pytorch.py / test_adasum_tensorflow.py; used by
-    tests to validate the distributed implementations)."""
+    """NumPy f64 reference model of the Adasum recursion (mirrors the
+    numerical model in test_adasum_pytorch.py / test_adasum_tensorflow.py;
+    used by tests to validate the distributed implementations).
+
+    Defines the semantics for EVERY n: non-pow-2 counts fold the
+    residual arrays into the head with one pair combine each, then run
+    the balanced binary tree over the remaining 2^k."""
     arrays = [np.asarray(a, np.float64) for a in arrays]
 
     def pair(a, b):
@@ -151,6 +193,12 @@ def adasum_reference(arrays):
         cb = 1.0 - dot / (2 * nb) if nb > _EPS else 1.0
         return ca * a + cb * b
 
+    n = len(arrays)
+    if n & (n - 1):
+        k = _pow2_floor(n)
+        r = n - k
+        arrays = ([pair(arrays[i], arrays[k + i]) for i in range(r)]
+                  + arrays[r:k])
     while len(arrays) > 1:
         arrays = [pair(arrays[i], arrays[i + 1])
                   for i in range(0, len(arrays), 2)]

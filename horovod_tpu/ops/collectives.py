@@ -277,16 +277,34 @@ def _is_tracer(x: Any) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
-def _tracer_set_guard(kind: str, process_set: Optional[ProcessSet]) -> None:
-    """In-jit paths that cannot honor a rank subset must refuse it loudly
-    (reference: process_set.cc semantics apply to every op; silently
-    reducing over the whole axis would be a wrong-answer path)."""
-    if process_set is not None and process_set.process_set_id != 0:
+def _tracer_set_groups(kind: str, process_set: Optional[ProcessSet],
+                       ax: str):
+    """axis_index_groups partition for an in-jit collective over a rank
+    subset (reference: process_set.cc semantics apply to every op).
+
+    XLA's grouped collectives need equal-size groups covering the axis
+    exactly once, so the set's ranks form one group and the complement
+    is partitioned into same-size filler groups.  Every rank still
+    executes the collective (SPMD), but only MEMBER ranks' outputs are
+    meaningful — matching the reference, where non-members simply never
+    call the op.  Requires |set| to divide the axis size; anything else
+    (and only that) stays a loud refusal."""
+    if process_set is None or process_set.process_set_id == 0:
+        return None
+    _tracer_require_global_axis(ax)
+    world = lax.axis_size(ax)
+    members = [int(r) for r in process_set.ranks]
+    n = len(members)
+    if world % n != 0:
         raise HorovodTpuError(
-            f"{kind} with a non-global process_set inside jit is not "
-            f"supported; run it on the eager path, or restrict the "
-            f"computation with shard_map over the set's sub-mesh"
+            f"{kind} with a non-global process_set inside jit requires "
+            f"the set size ({n}) to divide the axis size ({world}): XLA "
+            f"axis_index_groups needs equal-size groups.  Run it on the "
+            f"eager path, or restrict the computation with shard_map "
+            f"over the set's sub-mesh"
         )
+    rest = [r for r in range(world) if r not in set(members)]
+    return [members] + [rest[i:i + n] for i in range(0, len(rest), n)]
 
 
 def _tracer_require_global_axis(ax: str) -> None:
@@ -694,9 +712,10 @@ def allgather(
     sliced on the way out).
     """
     if _is_tracer(tensor):
-        _tracer_set_guard("allgather", process_set)
         ax = axis_name or GLOBAL_AXIS
-        return lax.all_gather(tensor, ax, tiled=True)
+        groups = _tracer_set_groups("allgather", process_set, ax)
+        return lax.all_gather(tensor, ax, tiled=True,
+                              axis_index_groups=groups)
 
     ps = _resolve_set(process_set)
     with _joinable("allgather", [tensor], process_set=ps):
@@ -850,15 +869,15 @@ def alltoall(
     (received, received_splits) like the reference.
     """
     if _is_tracer(tensor):
-        _tracer_set_guard("alltoall", process_set)
         if splits is not None:
             raise HorovodTpuError(
                 "alltoall with splits is not supported inside jit; uneven "
                 "splits require host-side size exchange (use the eager API)"
             )
         ax = axis_name or GLOBAL_AXIS
+        groups = _tracer_set_groups("alltoall", process_set, ax)
         return lax.all_to_all(tensor, ax, split_axis=0, concat_axis=0,
-                              tiled=True)
+                              tiled=True, axis_index_groups=groups)
 
     ps = _resolve_set(process_set)
     n = ps.size()
@@ -1012,11 +1031,14 @@ def reducescatter(
             f"reducescatter supports Sum and Average, got {op}"
         )
     if _is_tracer(tensor):
-        _tracer_set_guard("reducescatter", process_set)
         ax = axis_name or GLOBAL_AXIS
-        out = lax.psum_scatter(tensor, ax, tiled=True)
+        groups = _tracer_set_groups("reducescatter", process_set, ax)
+        out = lax.psum_scatter(tensor, ax, tiled=True,
+                               axis_index_groups=groups)
         if op is Average:
-            out = (out / lax.axis_size(ax)).astype(tensor.dtype)
+            div = (len(groups[0]) if groups is not None
+                   else lax.axis_size(ax))
+            out = (out / div).astype(tensor.dtype)
         return out
 
     ps = _resolve_set(process_set)

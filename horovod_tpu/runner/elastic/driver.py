@@ -52,10 +52,54 @@ logger = logging.getLogger("horovod_tpu.runner.elastic")
 DISCOVERY_INTERVAL_S = 1.0
 
 
+class ExecTransport:
+    """Worker spawn/teardown seam.
+
+    The driver owns membership and generations; HOW a worker process is
+    started on its host is a transport decision: local fork / ssh (the
+    default below, the reference's gloo_run path) or a Ray actor pinned
+    to the node (`horovod_tpu.ray.RayTransport`, the reference's
+    ElasticRayExecutor).  A handle must expose `poll() -> rc|None`; the
+    transport owns termination of its handles.
+    """
+
+    def command_for(self, slot: SlotInfo, settings: Settings,
+                    env: Dict[str, str]) -> List[str]:
+        raise NotImplementedError
+
+    def execute(self, cmd: List[str], env: Dict[str, str],
+                prefix: str) -> object:
+        raise NotImplementedError
+
+    def terminate(self, handles: List[object]) -> None:
+        raise NotImplementedError
+
+
+class LocalSshTransport(ExecTransport):
+    """Default transport: direct exec for local slots, ssh for remote
+    (build_command), process-group teardown via safe_exec."""
+
+    def command_for(self, slot, settings, env):
+        return build_command(slot, settings, env)
+
+    def execute(self, cmd, env, prefix):
+        return safe_exec.execute(cmd, env=env, prefix=prefix,
+                                 background=True)
+
+    def terminate(self, handles):
+        pids = [h.pid for h in handles if h.poll() is None]
+        if pids:
+            # One shared grace deadline for the whole group — serial
+            # terminate() would stall the monitor loop N*5s.
+            safe_exec.terminate_trees(pids)
+
+
 class ElasticDriver:
-    def __init__(self, settings: Settings, discovery: HostDiscovery):
+    def __init__(self, settings: Settings, discovery: HostDiscovery,
+                 transport: Optional[ExecTransport] = None):
         self.settings = settings
         self.discovery = discovery
+        self.transport = transport or LocalSshTransport()
         self.registry = WorkerStateRegistry()
         self.server = RendezvousServer(verbose=settings.verbose)
         self.gen = -1
@@ -191,23 +235,22 @@ class ElasticDriver:
                 "HOROVOD_ELASTIC_JOINING": "1" if self.gen > 0 else "0",
             })
             env.pop("HOROVOD_COORDINATOR_ADDR", None)
-            cmd = build_command(slot, self.settings, env)
-            handle = safe_exec.execute(
-                cmd, env=env, prefix=f"{slot.rank}", background=True)
+            cmd = self.transport.command_for(slot, self.settings, env)
+            handle = self.transport.execute(cmd, env=env,
+                                            prefix=f"{slot.rank}")
             self.workers[(host, slot_idx)] = (handle, slot.rank, self.gen)
-            logger.info("spawned worker %s:%d rank=%d pid=%d",
-                        host, slot_idx, slot.rank, handle.pid)
+            logger.info("spawned worker %s:%d rank=%d pid=%s",
+                        host, slot_idx, slot.rank,
+                        getattr(handle, "pid", "?"))
 
     def _kill_removed_workers(self) -> None:
         doomed = []
         for key, (handle, rank, _) in list(self.workers.items()):
             if key not in self.assignments and handle.poll() is None:
                 logger.info("terminating worker %s (no longer assigned)", key)
-                doomed.append(handle.pid)
+                doomed.append(handle)
         if doomed:
-            # One shared grace deadline for the whole group — serial
-            # terminate() would stall the monitor loop N*5s.
-            safe_exec.terminate_trees(doomed)
+            self.transport.terminate(doomed)
 
     # -- main loop -------------------------------------------------------
 
@@ -246,8 +289,8 @@ class ElasticDriver:
                 result_hook(self.server)
             return rc
         finally:
-            safe_exec.terminate_trees([
-                h.pid for h, _, _ in self.workers.values()
+            self.transport.terminate([
+                h for h, _, _ in self.workers.values()
                 if h.poll() is None])
             self.server.stop()
 
@@ -328,11 +371,18 @@ class ElasticDriver:
             time.sleep(0.2)
 
 
-def elastic_run(settings: Settings, result_hook=None) -> int:
-    """Entry from launch.py for `--host-discovery-script` runs."""
-    if not settings.host_discovery_script:
-        raise HorovodTpuError("elastic runs require --host-discovery-script")
-    discovery = HostDiscoveryScript(
-        settings.host_discovery_script,
-        default_slots=settings.slots_per_host or 1)
-    return ElasticDriver(settings, discovery).run(result_hook)
+def elastic_run(settings: Settings, result_hook=None,
+                discovery: Optional[HostDiscovery] = None,
+                transport: Optional[ExecTransport] = None) -> int:
+    """Entry from launch.py for `--host-discovery-script` runs; also the
+    programmatic entry for alternative discovery/transport backends
+    (Ray: `horovod_tpu.ray.ElasticRayExecutor`)."""
+    if discovery is None:
+        if not settings.host_discovery_script:
+            raise HorovodTpuError(
+                "elastic runs require --host-discovery-script (or a "
+                "HostDiscovery instance)")
+        discovery = HostDiscoveryScript(
+            settings.host_discovery_script,
+            default_slots=settings.slots_per_host or 1)
+    return ElasticDriver(settings, discovery, transport).run(result_hook)

@@ -301,15 +301,23 @@ class ElasticExecutor:
     and returns the surviving ranks' results.
     """
 
-    def __init__(self, discovery_script: str, min_np: int = 1,
+    def __init__(self, discovery_script, min_np: int = 1,
                  max_np: Optional[int] = None, slots: int = 1,
                  verbose: int = 0, extra_env: Optional[dict] = None,
                  start_timeout: float = 120.0,
                  ssh_port: Optional[int] = None,
                  ssh_identity_file: Optional[str] = None,
                  network_interfaces: Optional[str] = None,
-                 output_filename: Optional[str] = None):
-        self._script = discovery_script
+                 output_filename: Optional[str] = None,
+                 transport=None):
+        # `discovery_script` is a path (reference CLI surface) or a
+        # HostDiscovery instance (programmatic backends: Ray).
+        from .elastic.discovery import HostDiscovery
+        self._discovery = (discovery_script
+                           if isinstance(discovery_script, HostDiscovery)
+                           else None)
+        self._script = None if self._discovery else discovery_script
+        self._transport = transport
         self._min_np = min_np
         self._max_np = max_np
         self._slots = slots
@@ -363,7 +371,9 @@ class ElasticExecutor:
                     results.append(pickle.loads(base64.b64decode(raw)))
 
         try:
-            rc = elastic_run(settings, result_hook=collect)
+            rc = elastic_run(settings, result_hook=collect,
+                             discovery=self._discovery,
+                             transport=self._transport)
         finally:
             try:
                 os.unlink(func_file)

@@ -106,7 +106,15 @@ def main():
                    if st.get(n, {}).get("status") != "done"
                    and st.get(n, {}).get("attempts", 0) < MAX_ATTEMPTS]
         if not pending:
-            log("queue complete")
+            done = [n for n, _, _ in QUEUE
+                    if st.get(n, {}).get("status") == "done"]
+            failed = [n for n, _, _ in QUEUE if n not in done]
+            if failed:
+                log(f"queue exhausted: {len(done)} done {done}, "
+                    f"{len(failed)} FAILED after {MAX_ATTEMPTS} "
+                    f"attempts each: {failed}")
+                sys.exit(1)
+            log(f"queue complete: all {len(done)} items done")
             return
         if not probe():
             time.sleep(PROBE_INTERVAL)

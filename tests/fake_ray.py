@@ -1,0 +1,114 @@
+"""In-process fake `ray` module (reference test pattern: SURVEY §4
+`test_ray_elastic.py` runs against a fake local cluster).
+
+Implements the slice of the Ray API `horovod_tpu.ray` uses — actor
+creation via `ray.remote(cls)` / `.options()` / `.remote()`, method
+futures resolved by `ray.get`, `ray.nodes()` cluster state, `ray.kill`
+— with actors as plain in-process objects and method calls executed
+synchronously.  Cluster state (`nodes`) is a mutable list so tests can
+drive membership changes mid-run; every actor method call is recorded
+in `calls` for orchestration assertions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+class _Future:
+    def __init__(self, value=None, exc=None):
+        self.value = value
+        self.exc = exc
+
+
+class _ActorMethod:
+    def __init__(self, fake, handle, name):
+        self._fake = fake
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        if not self._handle._alive:
+            return _Future(exc=RuntimeError("actor is dead"))
+        self._fake.calls.append((self._handle, self._name, args, kwargs))
+        try:
+            return _Future(
+                value=getattr(self._handle._impl, self._name)(
+                    *args, **kwargs))
+        except BaseException as e:  # noqa: BLE001 — ships to ray.get
+            return _Future(exc=e)
+
+
+class _ActorHandle:
+    def __init__(self, fake, impl):
+        self._fake = fake
+        self._impl = impl
+        self._alive = True
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ActorMethod(self._fake, self, name)
+
+
+class _RemoteClass:
+    def __init__(self, fake, cls, opts=None):
+        self._fake = fake
+        self._cls = cls
+        self._opts = dict(opts or {})
+
+    def options(self, **opts):
+        return _RemoteClass(self._fake, self._cls,
+                            {**self._opts, **opts})
+
+    def remote(self, *args, **kwargs):
+        handle = _ActorHandle(self._fake, self._cls(*args, **kwargs))
+        handle._opts = self._opts
+        self._fake.actors.append(handle)
+        return handle
+
+
+class FakeRay:
+    """Duck-typed stand-in for the `ray` module."""
+
+    def __init__(self, nodes: List[Dict[str, Any]] = None):
+        self._nodes = nodes if nodes is not None else [{
+            "Alive": True,
+            "NodeManagerHostname": "127.0.0.1",
+            "NodeManagerAddress": "127.0.0.1",
+            "Resources": {"CPU": 2},
+        }]
+        self._initialized = False
+        self.actors: List[_ActorHandle] = []
+        self.calls: List[tuple] = []
+
+    # -- module surface --------------------------------------------------
+    def init(self, *args, **kwargs):
+        self._initialized = True
+
+    def is_initialized(self):
+        return self._initialized
+
+    def shutdown(self):
+        self._initialized = False
+
+    def nodes(self):
+        return [dict(n) for n in self._nodes]
+
+    def set_nodes(self, nodes):
+        self._nodes = nodes
+
+    def remote(self, *args, **kwargs):
+        if args and isinstance(args[0], type):
+            return _RemoteClass(self, args[0])
+        return lambda cls: _RemoteClass(self, cls)
+
+    def get(self, token, timeout=None):
+        if isinstance(token, list):
+            return [self.get(t) for t in token]
+        if token.exc is not None:
+            raise token.exc
+        return token.value
+
+    def kill(self, handle):
+        handle._alive = False

@@ -73,11 +73,41 @@ def test_adasum_in_axis_matches_tree(mesh):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_adasum_requires_power_of_two():
+@pytest.mark.parametrize("n", [3, 5, 6, 7])
+def test_adasum_tree_reduce_non_pow2(n):
+    # r5: non-pow-2 counts fold residuals into the head, then run the
+    # balanced tree — validated against the f64 reference for every n.
+    gs = grads(seed=10 + n)[:n]
+    out = adasum_tree_reduce(jnp.stack(gs))
+    np.testing.assert_allclose(np.asarray(out), adasum_reference(gs),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("n", [3, 5, 6])
+def test_adasum_in_axis_non_pow2(n, mesh):
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    gs = grads(seed=20 + n)[:n]
+    stacked = jnp.stack(gs)
+    sub = Mesh(np.array(jax.devices()[:n]), (hvd.GLOBAL_AXIS,))
+
+    def f(x):
+        return adasum_in_axis(x[0], hvd.GLOBAL_AXIS)
+
+    sm = shard_map(f, mesh=sub, in_specs=(P(hvd.GLOBAL_AXIS),),
+                   out_specs=P(), check_vma=False)
+    out = jax.jit(sm)(stacked)
+    np.testing.assert_allclose(np.asarray(out), adasum_reference(gs),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_non_pow2_process_set_eager():
     ps = hvd.add_process_set([0, 1, 2])
     try:
-        with pytest.raises(Exception):
-            hvd.allreduce(PerRank(grads()[:3]), op=hvd.Adasum,
-                          process_set=ps)
+        gs = grads(seed=9)[:3]
+        out = hvd.allreduce(PerRank(gs), op=hvd.Adasum, process_set=ps)
+        np.testing.assert_allclose(np.asarray(out), adasum_reference(gs),
+                                   rtol=1e-4, atol=1e-5)
     finally:
         hvd.remove_process_set(ps)
