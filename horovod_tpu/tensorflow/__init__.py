@@ -740,7 +740,15 @@ class _DistributedOptimizer:
         tvars = [v for _, v in gv]
         if self._bpps > 1:
             # Local accumulation (reference: backward_passes_per_step /
-            # LocalGradientAggregationHelper) — eager-mode only.
+            # LocalGradientAggregationHelper) — eager-mode only.  The
+            # reference also aggregates inside tf.compat.v1 graphs
+            # (gradient_aggregation.py); that path is a documented
+            # exclusion here (docs/MIGRATION.md "TF1 / graph mode").
+            if not tf.executing_eagerly():
+                raise RuntimeError(
+                    "backward_passes_per_step > 1 requires eager "
+                    "execution; TF1/graph-mode local aggregation is a "
+                    "documented exclusion (docs/MIGRATION.md)")
             nps = [None if g is None else _to_np(g) for g in grads]
             if self._acc is None:
                 self._acc = nps

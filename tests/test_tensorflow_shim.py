@@ -341,6 +341,21 @@ class TestKerasOptimizer:
         np.testing.assert_array_equal(w0, w1)
         assert not np.allclose(w1, w2)
 
+    def test_backward_passes_graph_mode_is_documented_exclusion(self):
+        # TF1/graph-mode local aggregation is excluded by decision
+        # (docs/MIGRATION.md); the boundary must be loud, not a numpy
+        # conversion failure deep in the accumulate path.
+        v = tf.Variable([1.0])
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.5), backward_passes_per_step=2)
+
+        @tf.function
+        def step():
+            opt.apply_gradients([(tf.constant([1.0]), v)])
+
+        with pytest.raises(Exception, match="eager"):
+            step()
+
     def test_model_fit_trains(self):
         # Reference: test_tensorflow2_keras train_model assertion — one
         # fit epoch under the wrapped optimizer reduces the loss.
