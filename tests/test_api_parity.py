@@ -130,3 +130,12 @@ def test_runner_surface():
 
     for s in ["run", "run_elastic"]:
         assert hasattr(spark, s), s
+
+
+def test_ray_surface():
+    # Reference: horovod/ray/__init__.py exports (SURVEY §2.5 Ray row).
+    import horovod_tpu.ray as ray_mod
+
+    for s in ["RayExecutor", "ElasticRayExecutor", "RayHostDiscovery",
+              "RayTransport", "assign_ranks", "ray_available"]:
+        assert hasattr(ray_mod, s), s

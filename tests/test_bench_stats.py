@@ -1,7 +1,7 @@
 """Statistics of the bench's gate metric (sim_scaling_efficiency):
-median-of-pairs, raw (unclamped) per-pair ratios, central-3 spread on
-widened runs, and adaptive widening — the machinery the r03 verdict
-asked to be gate-quality."""
+paired runs, eff>1.0 rejection, trimmed median, central-3 spread,
+bootstrap CI, and adaptive widening — the estimator the r04 verdict
+asked to be gate-quality (task 4)."""
 
 import os
 import sys
@@ -26,29 +26,40 @@ def _feed(monkeypatch, times):
 
 class TestSimScalingStats:
     def test_median_of_three_pairs(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "3")
         _feed(monkeypatch, [(1.0, 8.9), (1.0, 8.7), (1.0, 8.8)])
-        median, spread, effs = bench.sim_scaling_efficiency(runs=3)
+        median, spread, effs, ci, rejected = \
+            bench.sim_scaling_efficiency(runs=3)
         assert effs == pytest.approx([8 / 8.9, 8 / 8.7, 8 / 8.8])
         assert median == pytest.approx(8 / 8.8)
         assert spread == pytest.approx(8 / 8.7 - 8 / 8.9)
+        assert rejected == 0
+        assert min(effs) <= ci[0] <= ci[1] <= max(effs)
 
-    def test_ratios_stay_raw_above_one(self, monkeypatch):
-        # Contention-inflated t1 pushes a pair above 1.0: the raw value
-        # must be kept (clamping per pair would bias the median up).
-        # Widening disabled so exactly 3 pairs are consumed.
+    def test_pairs_above_one_rejected(self, monkeypatch):
+        # Contention-inflated t1 pushes a pair above 1.0: superlinear
+        # scaling is impossible on the shared-core mesh, so the pair is
+        # an invalid measurement and must be DISCARDED (r04 verdict) —
+        # neither kept (blows the spread) nor clamped (biases up).
         monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "3")
-        _feed(monkeypatch, [(1.5, 8.0), (1.0, 8.9), (1.0, 9.0)])
-        median, spread, effs = bench.sim_scaling_efficiency(runs=3)
-        assert effs[0] == pytest.approx(1.5)
+        _feed(monkeypatch, [(1.5, 8.0), (1.0, 8.9), (1.0, 9.0),
+                            (1.0, 8.8)])
+        median, spread, effs, ci, rejected = \
+            bench.sim_scaling_efficiency(runs=3)
+        assert rejected == 1
+        assert all(e <= 1.0 for e in effs)
+        assert len(effs) == 3
         assert median == pytest.approx(8 / 8.9)
 
-    def test_adaptive_widening_and_central3_spread(self, monkeypatch):
-        # Blown spread after 3 pairs -> widen to 5; spread over the
-        # central 3 order statistics.
+    def test_adaptive_widening_and_trimmed_median(self, monkeypatch):
+        # Blown spread after 3 pairs -> widen to 5; the trimmed median
+        # (drop min/max) equals the middle order statistic; spread over
+        # the central 3.
         monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "5")
         _feed(monkeypatch, [(1.0, 8.0), (0.5, 8.0), (1.0, 8.2),
                             (1.0, 8.4), (1.0, 8.6)])
-        median, spread, effs = bench.sim_scaling_efficiency(runs=3)
+        median, spread, effs, ci, rejected = \
+            bench.sim_scaling_efficiency(runs=3)
         assert len(effs) == 5
         s = sorted(effs)
         assert median == pytest.approx(s[2])
@@ -60,5 +71,29 @@ class TestSimScalingStats:
         it = iter(seq)
         monkeypatch.setattr(bench, "_run_sim",
                             lambda n, dist, timeout: next(it))
-        median, spread, effs = bench.sim_scaling_efficiency(runs=3)
+        median, spread, effs, ci, rejected = \
+            bench.sim_scaling_efficiency(runs=3)
         assert len(effs) == 3   # the failed attempt was retried
+        assert rejected == 0
+
+    def test_ci_deterministic_and_ordered(self, monkeypatch):
+        # The bootstrap seed is fixed: the CI is a function of the data,
+        # not of the run.
+        times = [(1.0, 8.9), (1.0, 8.7), (1.0, 8.8), (1.0, 8.6),
+                 (1.0, 8.75), (1.0, 8.85), (1.0, 8.65)]
+        monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "7")
+        _feed(monkeypatch, times)
+        r1 = bench.sim_scaling_efficiency(runs=7)
+        _feed(monkeypatch, times)
+        r2 = bench.sim_scaling_efficiency(runs=7)
+        assert r1[3] == r2[3]
+        assert r1[3][0] <= r1[0] <= r1[3][1]
+
+    def test_too_few_valid_pairs_returns_none(self, monkeypatch):
+        # Every pair invalid -> no estimate rather than a fabricated one.
+        monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "3")
+        seq = [1.5, 8.0] * 10 + [8.0]
+        it = iter(seq)
+        monkeypatch.setattr(bench, "_run_sim",
+                            lambda n, dist, timeout: next(it))
+        assert bench.sim_scaling_efficiency(runs=3) is None
