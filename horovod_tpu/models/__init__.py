@@ -41,8 +41,10 @@ from .decode import (  # noqa: F401
     make_decode_step,
     transformer_beam_search,
     transformer_decode_step,
+    transformer_extend,
     transformer_generate,
     transformer_prefill,
+    transformer_speculative_generate,
 )
 
 

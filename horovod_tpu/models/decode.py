@@ -38,6 +38,7 @@ Prefill is ONE batched forward through the training attention path
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -138,17 +139,21 @@ def _slot_positions(pos, S):
 
 def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
                   tp_axis=None):
-    """One layer's attention for a single new token position.
+    """One layer's attention for a CHUNK of c new token positions
+    (c == 1 is the plain decode step; c > 1 serves `transformer_extend`
+    and the speculative verify pass).
 
-    x [B, 1, D]; ck/cv [B, S, Hkv, Dh] (this layer's ring slices —
+    x [B, c, D]; ck/cv [B, S, Hkv, Dh] (this layer's ring slices —
     LOCAL head counts under tensor parallelism; head dims are derived
     from the weights, not cfg, so tp shards just work).  Returns
-    (x, ck, cv) with slot `pos % S` overwritten.
+    (x, ck, cv) with slots `pos % S .. (pos+c-1) % S` overwritten.
+    Chunks with c > 1 must not wrap the ring (the c == 1 step may).
     """
     dt = cfg.compute_dtype
     _shape_src = ck["q"] if isinstance(ck, dict) else ck
     B, S = _shape_src.shape[0], _shape_src.shape[1]
     Dh = cfg.d_head
+    c = x.shape[1]
 
     h = _rmsnorm(lp["ln1"]["scale"], x)
     q = jnp.einsum("bod,dhk->bohk", h, lp["wq"].astype(dt))
@@ -156,7 +161,7 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
     v = jnp.einsum("bod,dhk->bohk", h, lp["wv"].astype(dt))
     Hq, Hkv = q.shape[2], k.shape[2]
     g = Hq // Hkv
-    positions = pos[None]                          # [1]
+    positions = pos + jnp.arange(c)                # [c]
     q = _rope(q, positions, cfg.rope_theta).astype(dt)
     k = _rope(k, positions, cfg.rope_theta).astype(dt)
 
@@ -164,13 +169,13 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
     ck = _cache_write(ck, k, slot)
     cv = _cache_write(cv, v, slot)
 
-    # Grouped attention against the ring: q [B,1,Hkv,g,Dh] x
+    # Grouped attention against the ring: q [B,c,Hkv,g,Dh] x
     # cache [B,S,Hkv,Dh] — the repeated kv heads never materialize.
     # Under an int8 cache the per-vector scales FACTOR OUT of the
     # contractions (scale is constant over Dh), so they multiply the
     # [..,S]-shaped scores/probs instead of a Dh-times-larger
     # dequantized cache copy.
-    qg = q.reshape(B, 1, Hkv, g, Dh)
+    qg = q.reshape(B, c, Hkv, g, Dh)
     if isinstance(ck, dict):
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
                        ck["q"].astype(jnp.float32))
@@ -179,11 +184,18 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
     else:
         s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
                        ck.astype(jnp.float32)) / (Dh ** 0.5)
-    abs_pos = _slot_positions(pos, S)
-    valid = (abs_pos >= 0) & (abs_pos <= pos)
+    # Per-query causal mask over reconstructed absolute positions:
+    # query i (absolute pos+i) sees slots holding abs <= pos+i.  The
+    # chunk's own keys were just written, so intra-chunk causality
+    # falls out of the same comparison.
+    abs_pos = _slot_positions(pos + c - 1, S)           # [S]
+    q_pos = positions                                    # [c]
+    valid = (abs_pos[None, :] >= 0) & \
+        (abs_pos[None, :] <= q_pos[:, None])             # [c, S]
     if cfg.attn_window:
-        valid = valid & ((pos - abs_pos) < cfg.attn_window)
-    s = jnp.where(valid[None, None, None, None, :], s, -1e30)
+        valid = valid & ((q_pos[:, None] - abs_pos[None, :])
+                         < cfg.attn_window)
+    s = jnp.where(valid[None, None, None, :, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     if isinstance(cv, dict):
         pv = p * cv["scale"].transpose(0, 2, 1)[:, :, None, None, :]
@@ -192,7 +204,7 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
     else:
         o = jnp.einsum("bhgqk,bkhd->bqhgd", p,
                        cv.astype(jnp.float32))
-    o = o.reshape(B, 1, Hq, Dh).astype(dt)
+    o = o.reshape(B, c, Hq, Dh).astype(dt)
     out = jnp.einsum("bthk,hkd->btd", o, lp["wo"].astype(dt))
     if tp_axis is not None:
         out = lax.psum(out, tp_axis)   # row-parallel wo
@@ -286,6 +298,263 @@ def transformer_decode_step(params: Dict, cache: Dict, tokens,
                         params["embed"].astype(dt),
                         preferred_element_type=jnp.float32)
     return logits[:, 0], {"k": ck, "v": cv, "pos": pos + 1}
+
+
+def transformer_extend(params: Dict, cache: Dict, tokens,
+                       cfg: TransformerConfig, tp_axis=None):
+    """Absorb a CHUNK of c tokens [B, c] at cache position pos; return
+    (logits [B, c, V], cache) — the per-position next-token logits the
+    speculative verify pass needs (reference: none; standard
+    draft-verify decoding a la speculative sampling).
+
+    The chunk must fit without wrapping the ring: pos % max_len + c <=
+    max_len (enforced eagerly when pos is concrete).  c == 1 is
+    numerically identical to `transformer_decode_step`.
+    """
+    dt = cfg.compute_dtype
+    B, c = tokens.shape
+    _ck0 = cache["k"]
+    S = (_ck0["q"] if isinstance(_ck0, dict) else _ck0).shape[2]
+    pos = cache["pos"]
+    if not isinstance(pos, jax.core.Tracer):
+        if int(pos) % S + c > S:
+            raise ValueError(
+                f"extend chunk of {c} tokens at pos {int(pos)} would "
+                f"wrap the ring (max_len {S}); split the chunk or size "
+                f"the cache larger")
+    x = params["embed"][tokens].astype(dt)                # [B,c,D]
+    x, ck, cv = _layer_walk(
+        params, cache["k"], cache["v"], x,
+        lambda lp, cki, cvi, x: _decode_layer(lp, cki, cvi, x, pos,
+                                              cfg, tp_axis),
+        cfg, tp_axis)
+    x = _rmsnorm(params["final_norm"]["scale"], x)
+    logits = jnp.einsum("bod,vd->bov", x.astype(dt),
+                        params["embed"].astype(dt),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": ck, "v": cv, "pos": pos + c}
+
+
+def transformer_speculative_generate(
+        params: Dict, cfg: TransformerConfig,
+        draft_params: Dict, draft_cfg: TransformerConfig,
+        prompt, max_new_tokens: int, gamma: int = 4,
+        temperature: float = 0.0,
+        rng: Optional[jax.Array] = None,
+        max_len: Optional[int] = None):
+    """Speculative decoding: a small DRAFT model proposes `gamma` tokens
+    per round, the TARGET model scores them all in ONE chunked forward
+    (`transformer_extend`), and the longest valid prefix is accepted.
+
+    - temperature == 0 (greedy): accept while the draft token equals the
+      target argmax; the first mismatch position is replaced by the
+      target's own argmax.  Output is EXACTLY the target-only greedy
+      sequence (tested token-for-token).
+    - temperature > 0: standard speculative SAMPLING (Leviathan et al. /
+      Chen et al.): draft token x accepted with probability
+      min(1, p_target(x)/p_draft(x)); on first rejection, resample from
+      norm(max(0, p - q)).  The output distribution equals target-only
+      sampling.
+
+    Single-sequence only (B == 1): per-sequence acceptance lengths
+    diverge under batching and would need ragged cache positions.
+    Returns (tokens [1, max_new_tokens], stats dict with
+    `rounds`, `accept_rate`).  The round loop runs in Python; the two
+    model passes per round are the compiled pieces (draft scan +
+    target chunk extend), so wall-clock per round is one draft scan of
+    gamma steps + ONE target dispatch — the latency win when the
+    target is dispatch- or memory-bound.
+
+    Both models must share the vocabulary; `cfg.attn_window` is not
+    supported (rollback across a rolling ring would evict live slots).
+    """
+    B, T0 = prompt.shape
+    if B != 1:
+        raise ValueError(
+            f"speculative decoding supports batch 1, got {B} "
+            f"(per-sequence acceptance lengths diverge)")
+    if cfg.attn_window or draft_cfg.attn_window:
+        raise ValueError(
+            "speculative decoding does not support attn_window configs")
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            f"draft/target vocab mismatch: {draft_cfg.vocab_size} vs "
+            f"{cfg.vocab_size}")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng")
+    # +gamma headroom: a round may write gamma speculative slots past
+    # the final accepted position before rolling back.  The rollback
+    # machinery assumes the ring never wraps, so an undersized explicit
+    # max_len must be rejected here — inside jit the extend wrap guard
+    # cannot fire, and dynamic_update_slice would silently CLAMP the
+    # write over live slots.
+    need = T0 + max_new_tokens + gamma + 1
+    cap = max_len or need
+    if cap < need:
+        raise ValueError(
+            f"max_len {cap} < prompt {T0} + max_new {max_new_tokens} + "
+            f"gamma {gamma} + 1: speculative rounds write up to gamma "
+            f"slots past the accepted frontier before rolling back")
+    cache = init_decode_cache(cfg, 1, cap)
+    dcache = init_decode_cache(draft_cfg, 1, cap)
+
+    # Loop invariant (restored at the end of every round): every
+    # DECIDED token is fed into both caches, and tlast/dlast are the
+    # [V] logits (numpy, host) for the next undecided position.
+    # Prefill establishes it for the prompt.
+    tlast, cache = transformer_prefill(params, cache, prompt, cfg)
+    dlast, dcache = transformer_prefill(draft_params, dcache, prompt,
+                                        draft_cfg)
+    tlast, dlast = np.asarray(tlast[0]), np.asarray(dlast[0])
+
+    # Compiled programs are module-cached per (cfg, ...) with params as
+    # TRACED ARGUMENTS — repeat calls with the same configs reuse the
+    # executables and the weights are not baked in as constants.
+    extend = _spec_extend_fn(cfg)
+    tstep = _spec_step_fn(cfg)
+    dstep = _spec_step_fn(draft_cfg)
+
+    def _at(c, pos):
+        return {"k": c["k"], "v": c["v"],
+                "pos": jnp.asarray(pos, jnp.int32)}
+
+    # Single-use key discipline: one branch seeds the host
+    # accept/resample stream, the other drives the draft-sampling keys.
+    host_key = None
+    if rng is not None:
+        rng, host_key = jax.random.split(rng)
+    rng_np = np.random.default_rng(
+        int(jax.random.randint(host_key, (), 0, 2**31 - 1))
+        if host_key is not None else 0)
+
+    def _host_pick(logits_np):
+        if not temperature:
+            return int(np.argmax(logits_np))
+        p = _softmax_np(logits_np / temperature)
+        return int(rng_np.choice(len(p), p=p))
+
+    out: list = []
+    rounds = 0
+    accepted_total = 0
+    proposed_total = 0
+    base = T0                       # first undecided position (host)
+    while len(out) < max_new_tokens:
+        rounds += 1
+        # Always propose a full gamma chunk — a shorter final round
+        # would compile a SECOND (dscan, extend) shape pair just to
+        # absorb the tail; the cache reserves gamma headroom past the
+        # frontier and the final truncation discards any surplus.
+        n = gamma
+        # --- draft proposes n tokens in ONE compiled scan -----------
+        # qlogits[i] is the distribution d_i was drawn from; the scan
+        # feeds every drafted token (the rollback below erases the
+        # speculative tail either way).
+        keys = (jax.random.split(rng, n + 1) if rng is not None
+                else jnp.zeros((n + 1, 2), jnp.uint32))
+        rng = keys[0] if rng is not None else None
+        dscan = _spec_draft_scan(draft_cfg, n, bool(temperature))
+        drafts_d, qlogits_d, dcache = dscan(
+            draft_params, dcache, jnp.asarray(dlast), keys[1:],
+            jnp.float32(temperature or 1.0))
+        drafts = [int(t) for t in np.asarray(drafts_d)]
+        qlogits = np.asarray(qlogits_d)            # [n, V]
+        proposed_total += n
+        # --- target scores all n in ONE chunked forward -------------
+        # Row i predicts position base+1+i; position base is judged by
+        # tlast, so target distributions are [tlast, rows 0..n-2] and
+        # row n-1 supplies the all-accepted bonus position base+n.
+        tlogits_d, cache = extend(params, cache,
+                                  jnp.asarray([drafts], jnp.int32))
+        tlogits = np.asarray(tlogits_d[0])         # [n, V]
+        tdists = [tlast] + [tlogits[i] for i in range(n - 1)]
+        n_acc = 0
+        extra = None
+        for i in range(n):
+            if not temperature:
+                t_tok = int(np.argmax(tdists[i]))
+                if drafts[i] == t_tok:
+                    n_acc += 1
+                    continue
+                extra = t_tok
+                break
+            p = _softmax_np(tdists[i] / temperature)
+            q = _softmax_np(qlogits[i] / temperature)
+            if rng_np.uniform() < min(
+                    1.0, float(p[drafts[i]]) / max(float(q[drafts[i]]),
+                                                   1e-20)):
+                n_acc += 1
+                continue
+            resid = np.maximum(p - q, 0.0)
+            resid = resid / max(resid.sum(), 1e-20)
+            extra = int(rng_np.choice(len(resid), p=resid))
+            break
+        if extra is None:
+            # All n accepted: row n-1 prices position base+n for free.
+            extra = _host_pick(tlogits[n - 1])
+        accepted_total += n_acc
+        out.extend(drafts[:n_acc])
+        if len(out) < max_new_tokens:
+            out.append(extra)
+            # --- restore the invariant: feed the extra token --------
+            # Both caches fed d_0..d_{n-1} (pos base+n).  Roll both to
+            # the accepted frontier and feed `extra`; stale speculative
+            # slots beyond it are masked (abs-pos reconstruction) and
+            # later overwritten.
+            feed = jnp.asarray([extra], jnp.int32)
+            tl, cache = tstep(params, _at(cache, base + n_acc), feed)
+            dl, dcache = dstep(draft_params, _at(dcache, base + n_acc),
+                               feed)
+            tlast, dlast = np.asarray(tl[0]), np.asarray(dl[0])
+            base = base + n_acc + 1
+        else:
+            base = base + n_acc
+    toks = jnp.asarray(out[:max_new_tokens], jnp.int32)[None]
+    stats = {"rounds": rounds,
+             "accept_rate": accepted_total / max(1, proposed_total)}
+    return toks, stats
+
+
+def _softmax_np(x):
+    e = np.exp(x - np.max(x))
+    return e / e.sum()
+
+
+@functools.lru_cache(maxsize=None)
+def _spec_extend_fn(cfg: TransformerConfig):
+    return jax.jit(lambda p, c, t: transformer_extend(p, c, t, cfg))
+
+
+@functools.lru_cache(maxsize=None)
+def _spec_step_fn(cfg: TransformerConfig):
+    return jax.jit(lambda p, c, t: transformer_decode_step(p, c, t, cfg))
+
+
+@functools.lru_cache(maxsize=None)
+def _spec_draft_scan(cfg: TransformerConfig, n: int, sampled: bool):
+    """One compiled program proposing n draft tokens: scan of
+    (pick from current logits, feed, next logits).  Returns
+    (drafts [n] int32, qlogits [n, V] f32, cache)."""
+
+    def run(params, cache, first_logits, keys, temp):
+        def body(carry, key):
+            cache, cur = carry
+            if sampled:
+                tok = jax.random.categorical(key, cur / temp)
+            else:
+                tok = jnp.argmax(cur)
+            lg, cache = transformer_decode_step(
+                params, cache, tok[None].astype(jnp.int32), cfg)
+            return (cache, lg[0]), (tok.astype(jnp.int32), cur)
+
+        (cache, _), (drafts, qlogits) = lax.scan(
+            body, (cache, first_logits), keys, length=n)
+        return drafts, qlogits, cache
+
+    return jax.jit(run)
 
 
 def transformer_prefill(params: Dict, cache: Dict, prompt,
@@ -601,5 +870,6 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
 
 
 __all__ = ["init_decode_cache", "transformer_decode_step",
-           "transformer_prefill", "transformer_generate",
+           "transformer_prefill", "transformer_extend",
+           "transformer_generate", "transformer_speculative_generate",
            "transformer_beam_search", "make_decode_step"]

@@ -125,6 +125,143 @@ class TestGenerate:
             transformer_generate(params, cfg, prompt, 8, max_len=8)
 
 
+class TestChunkExtendAndSpeculative:
+    """transformer_extend (multi-token chunks) and speculative decoding
+    (r5, beyond reference: draft-propose / target-verify with exact
+    greedy equivalence)."""
+
+    def test_extend_matches_stepwise_decode(self):
+        from horovod_tpu.models import transformer_extend
+
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 64)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, 3), 0, 64)
+
+        c1 = init_decode_cache(cfg, 2, 16)
+        _, c1 = transformer_prefill(params, c1, prompt, cfg)
+        lg_chunk, c1 = transformer_extend(params, c1, toks, cfg)
+
+        c2 = init_decode_cache(cfg, 2, 16)
+        _, c2 = transformer_prefill(params, c2, prompt, cfg)
+        step_lgs = []
+        for i in range(3):
+            lg, c2 = transformer_decode_step(params, c2, toks[:, i], cfg)
+            step_lgs.append(lg)
+        np.testing.assert_allclose(
+            np.asarray(lg_chunk), np.stack(
+                [np.asarray(s) for s in step_lgs], axis=1),
+            rtol=2e-5, atol=2e-5)
+        assert int(c1["pos"]) == int(c2["pos"]) == 7
+
+    def test_extend_gqa_and_quantized_cache(self):
+        from horovod_tpu.models import transformer_extend
+
+        cfg = _cfg(n_kv_heads=2)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 4), 0, 64)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (1, 2), 0, 64)
+        for quant in (None, "int8"):
+            c = init_decode_cache(cfg, 1, 12, quantize=quant)
+            _, c = transformer_prefill(params, c, prompt, cfg)
+            lg, c = transformer_extend(params, c, toks, cfg)
+            assert lg.shape == (1, 2, 64)
+            assert np.isfinite(np.asarray(lg)).all()
+
+    def test_extend_wrap_rejected(self):
+        from horovod_tpu.models import transformer_extend
+
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 4), 0, 64)
+        c = init_decode_cache(cfg, 1, 6)
+        _, c = transformer_prefill(params, c, prompt, cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (1, 3), 0, 64)
+        with pytest.raises(ValueError, match="wrap"):
+            transformer_extend(params, c, toks, cfg)
+
+    def test_speculative_greedy_matches_plain_generate(self):
+        from horovod_tpu.models import transformer_speculative_generate
+
+        cfg = _cfg(n_layers=2)
+        draft_cfg = _cfg(d_model=16, n_heads=2, d_head=8, d_ff=32,
+                         n_layers=1)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        draft = transformer_init(jax.random.PRNGKey(7), draft_cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, 64)
+
+        plain, _ = transformer_generate(params, cfg, prompt, 12)
+        spec, stats = transformer_speculative_generate(
+            params, cfg, draft, draft_cfg, prompt, 12, gamma=3)
+        np.testing.assert_array_equal(np.asarray(spec),
+                                      np.asarray(plain))
+        assert stats["rounds"] >= 1
+        assert 0.0 <= stats["accept_rate"] <= 1.0
+
+    def test_self_speculation_accepts_everything(self):
+        # Draft == target: every greedy proposal matches, so each round
+        # lands gamma accepted + 1 bonus token.
+        from horovod_tpu.models import transformer_speculative_generate
+
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 4), 0, 64)
+        plain, _ = transformer_generate(params, cfg, prompt, 9)
+        spec, stats = transformer_speculative_generate(
+            params, cfg, params, cfg, prompt, 9, gamma=4)
+        np.testing.assert_array_equal(np.asarray(spec),
+                                      np.asarray(plain))
+        assert stats["accept_rate"] == 1.0
+        # 9 tokens at gamma=4: rounds of 4+1 -> ceil sizing, <= 3 rounds.
+        assert stats["rounds"] <= 3
+
+    def test_speculative_sampling_valid(self):
+        from horovod_tpu.models import transformer_speculative_generate
+
+        cfg = _cfg()
+        draft_cfg = _cfg(d_model=16, n_heads=2, d_head=8, d_ff=32,
+                         n_layers=1)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        draft = transformer_init(jax.random.PRNGKey(7), draft_cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 4), 0, 64)
+        toks, stats = transformer_speculative_generate(
+            params, cfg, draft, draft_cfg, prompt, 8, gamma=3,
+            temperature=0.8, rng=jax.random.PRNGKey(3))
+        arr = np.asarray(toks)
+        assert arr.shape == (1, 8)
+        assert ((arr >= 0) & (arr < 64)).all()
+
+    def test_speculative_rejects_bad_configs(self):
+        from horovod_tpu.models import transformer_speculative_generate
+
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt2 = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 64)
+        with pytest.raises(ValueError, match="batch 1"):
+            transformer_speculative_generate(
+                params, cfg, params, cfg, prompt2, 4)
+        prompt = prompt2[:1]
+        wcfg = _cfg(attn_window=8)
+        with pytest.raises(ValueError, match="attn_window"):
+            transformer_speculative_generate(
+                params, cfg, params, wcfg, prompt, 4)
+        vcfg = _cfg(vocab_size=32)
+        vparams = transformer_init(jax.random.PRNGKey(2), vcfg)
+        with pytest.raises(ValueError, match="vocab"):
+            transformer_speculative_generate(
+                params, cfg, vparams, vcfg, prompt, 4)
+        # Undersized explicit max_len must raise eagerly: inside jit the
+        # ring-wrap guard cannot fire and the write would silently clamp.
+        with pytest.raises(ValueError, match="max_len"):
+            transformer_speculative_generate(
+                params, cfg, params, cfg, prompt, 8, gamma=3,
+                max_len=10)
+        with pytest.raises(ValueError, match="temperature"):
+            transformer_speculative_generate(
+                params, cfg, params, cfg, prompt, 4, temperature=-1.0,
+                rng=jax.random.PRNGKey(0))
+
+
 class TestRingCacheAndPrefill:
     def test_prefill_matches_teacher_forcing(self):
         from horovod_tpu.models import transformer_prefill
