@@ -461,7 +461,9 @@ def transformer_speculative_generate(
             draft_params, dcache, jnp.asarray(dlast), keys[1:],
             jnp.float32(temperature or 1.0))
         drafts = [int(t) for t in np.asarray(drafts_d)]
-        qlogits = np.asarray(qlogits_d)            # [n, V]
+        # qlogits only feed the accept/resample rule; greedy rounds
+        # skip the [n, V] device->host transfer entirely.
+        qlogits = np.asarray(qlogits_d) if temperature else None
         proposed_total += n
         # --- target scores all n in ONE chunked forward -------------
         # Row i predicts position base+1+i; position base is judged by

@@ -38,6 +38,7 @@ QUEUE = [
     ("bench", [PY, "bench.py"], 3600),
     ("flash_block_sweep", [PY, "flash_block_sweep.py"], 7200),
     ("decode_bench", [PY, "decode_bench.py"], 5400),
+    ("spec_bench", [PY, "spec_bench.py"], 5400),
     ("vgg16", [PY, "examples/synthetic_benchmark.py", "--model",
                "vgg16", "--batch-size", "32"], 2400),
     ("elastic_timing", [PY, "scripts/elastic_timing.py"], 1800),

@@ -1,0 +1,123 @@
+"""Speculative vs plain decode throughput (models/decode.py r5).
+
+Measures tokens/s of target-only greedy decode against speculative
+decoding (draft-propose / target-verify) on the same target model.
+Like decode_bench.py, each config runs in a fresh killable subprocess
+(wedged-tunnel defense); one JSON line per config on stdout.
+
+The interesting regime is a target whose per-token step is dispatch- or
+HBM-bound and a draft ~10x smaller: each round replaces gamma+1 target
+steps with one chunked target forward + one target step.  With random
+(untrained) weights the draft disagrees almost always, so the measured
+speedup here is a LOWER bound — acceptance on real checkpoints is what
+makes gamma pay; the bench also reports accept_rate so the arithmetic
+(tokens per target dispatch = 1 + accept_rate * gamma) is visible.
+A self-speculation config (draft == target) shows the 100%-acceptance
+upper bound on round efficiency with this implementation's overheads.
+
+Usage:  python spec_bench.py            # real chip
+        JAX_PLATFORMS=cpu python spec_bench.py --tiny   # smoke
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+# (tag, target_d, target_L, draft_d, draft_L, gamma, prompt, new)
+CONFIGS = [
+    ("plain",      1024, 8, 0,   0, 0, 512, 128),
+    ("spec_g4",    1024, 8, 256, 2, 4, 512, 128),
+    ("spec_g8",    1024, 8, 256, 2, 8, 512, 128),
+    ("self_g4",    1024, 8, -1, -1, 4, 512, 128),
+]
+
+CHILD_CODE = r"""
+import json, sys, time
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+
+if {tiny!r} == "1":
+    jax.config.update("jax_platforms", "cpu")
+
+from horovod_tpu.models import (
+    TransformerConfig, transformer_init, transformer_generate,
+    transformer_speculative_generate)
+
+td, tl, dd, dl, gamma, T0, N = (int(a) for a in sys.argv[1:8])
+V = 8192
+
+def cfg_for(d, L):
+    return TransformerConfig(
+        vocab_size=V, d_model=d, n_heads=max(1, d // 64),
+        d_head=min(64, d), d_ff=4 * d, n_layers=L)
+
+cfg = cfg_for(td, tl)
+params = transformer_init(jax.random.PRNGKey(0), cfg)
+prompt = jax.random.randint(jax.random.PRNGKey(1), (1, T0), 0, V)
+
+if gamma == 0:
+    # warmup (compile) then timed
+    transformer_generate(params, cfg, prompt, 4)
+    t0 = time.perf_counter()
+    toks, _ = transformer_generate(params, cfg, prompt, N)
+    jax.block_until_ready(toks)
+    dt = time.perf_counter() - t0
+    print(json.dumps({{"tok_s": N / dt, "ms_tok": dt / N * 1e3}}))
+else:
+    if dd < 0:
+        dcfg, dparams = cfg, params        # self-speculation
+    else:
+        dcfg = cfg_for(dd, dl)
+        dparams = transformer_init(jax.random.PRNGKey(7), dcfg)
+    transformer_speculative_generate(
+        params, cfg, dparams, dcfg, prompt, 2 * gamma + 2, gamma=gamma)
+    t0 = time.perf_counter()
+    toks, stats = transformer_speculative_generate(
+        params, cfg, dparams, dcfg, prompt, N, gamma=gamma)
+    jax.block_until_ready(toks)
+    dt = time.perf_counter() - t0
+    print(json.dumps({{"tok_s": N / dt, "ms_tok": dt / N * 1e3,
+                      "accept_rate": stats["accept_rate"],
+                      "rounds": stats["rounds"]}}))
+"""
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--tiny", action="store_true")
+    args = p.parse_args()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = CHILD_CODE.format(repo=repo, tiny="1" if args.tiny else "0")
+    for tag, td, tl, dd, dl, gamma, T0, N in CONFIGS:
+        if args.tiny:
+            td, tl = 128, 2
+            dd, dl = (dd if dd < 0 else 64), (dl if dd < 0 else 1)
+            T0, N = 32, 16
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code] +
+                [str(a) for a in (td, tl, dd, dl, gamma, T0, N)],
+                capture_output=True, text=True, timeout=1800)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"config": tag, "error": "timeout"}),
+                  flush=True)
+            continue
+        if r.returncode != 0:
+            print(json.dumps({"config": tag,
+                              "error": f"exit {r.returncode}"}),
+                  flush=True)
+            print(f"{tag}: {r.stderr[-300:]}", file=sys.stderr, flush=True)
+            continue
+        res = json.loads(r.stdout.strip().splitlines()[-1])
+        print(json.dumps({"config": tag, **res}), flush=True)
+        extra = (f"  accept {res['accept_rate']:.2f} over "
+                 f"{res['rounds']} rounds" if "accept_rate" in res else "")
+        print(f"{tag:9s} {res['tok_s']:8.1f} tok/s "
+              f"({res['ms_tok']:6.2f} ms/tok){extra}",
+              file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
