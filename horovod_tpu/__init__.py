@@ -109,6 +109,7 @@ from .parallel.data_parallel import (  # noqa: F401
     data_parallel,
     distributed_grad,
     DistributedGradientTape,
+    error_feedback_init,
     shard_batch,
 )
 

@@ -457,13 +457,17 @@ def transformer_speculative_generate(
                 else jnp.zeros((n + 1, 2), jnp.uint32))
         rng = keys[0] if rng is not None else None
         dscan = _spec_draft_scan(draft_cfg, n, bool(temperature))
-        drafts_d, qlogits_d, dcache = dscan(
-            draft_params, dcache, jnp.asarray(dlast), keys[1:],
-            jnp.float32(temperature or 1.0))
+        if temperature:
+            drafts_d, qlogits_d, dcache = dscan(
+                draft_params, dcache, jnp.asarray(dlast), keys[1:],
+                jnp.float32(temperature))
+            qlogits = np.asarray(qlogits_d)
+        else:
+            drafts_d, dcache = dscan(
+                draft_params, dcache, jnp.asarray(dlast), keys[1:],
+                jnp.float32(1.0))
+            qlogits = None
         drafts = [int(t) for t in np.asarray(drafts_d)]
-        # qlogits only feed the accept/resample rule; greedy rounds
-        # skip the [n, V] device->host transfer entirely.
-        qlogits = np.asarray(qlogits_d) if temperature else None
         proposed_total += n
         # --- target scores all n in ONE chunked forward -------------
         # Row i predicts position base+1+i; position base is judged by
@@ -550,11 +554,20 @@ def _spec_draft_scan(cfg: TransformerConfig, n: int, sampled: bool):
                 tok = jnp.argmax(cur)
             lg, cache = transformer_decode_step(
                 params, cache, tok[None].astype(jnp.int32), cfg)
-            return (cache, lg[0]), (tok.astype(jnp.int32), cur)
+            # qlogits only feed the sampling accept rule; the greedy
+            # specialization stacks nothing.
+            ys = ((tok.astype(jnp.int32), cur) if sampled
+                  else tok.astype(jnp.int32))
+            return (cache, lg[0]), ys
 
-        (cache, _), (drafts, qlogits) = lax.scan(
+        (cache, _), ys = lax.scan(
             body, (cache, first_logits), keys, length=n)
-        return drafts, qlogits, cache
+        if sampled:
+            drafts, qlogits = ys
+        else:
+            drafts, qlogits = ys, None
+        return ((drafts, qlogits, cache) if sampled
+                else (drafts, cache))
 
     return jax.jit(run)
 

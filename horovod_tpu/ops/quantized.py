@@ -79,44 +79,94 @@ def _codec(wire: str):
     raise ValueError(f"unknown wire codec {wire!r}")
 
 
+def local_roundtrip(v: jax.Array, wire: str = "int8") -> jax.Array:
+    """encode→decode through the local codec (same blockwise scales the
+    ring's first hop uses) — the compression operator C whose error
+    error-feedback carries to the next step (parallel/data_parallel.py
+    `error_feedback_state`)."""
+    encode, decode = _codec(wire)
+    flat = v.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % _BLOCK
+    padded = jnp.pad(flat, (0, pad))
+    return decode(encode(padded))[: flat.size].reshape(v.shape)
+
+
 def quantized_allreduce_shard(x: jax.Array, axis: str,
                               average: bool = False,
-                              wire: str = "int8") -> jax.Array:
+                              wire: str = "int8",
+                              error_feedback: jax.Array = None):
     """Sum (or average) `x` across `axis` with 1-byte ring transport
     (`wire`: "int8" | "fp8_e4m3" | "fp8_e5m2") and f32 accumulation.
 
     Called inside shard_map with `axis` in scope; any shape/float dtype
     (computation in f32, result cast back).
+
+    `error_feedback` (optional, f32, x's shape): SENDER-SIDE error
+    feedback.  The residual is added to `x` before the collective, and
+    every wire transmission's encode error — first-hop raw sends,
+    interior partial-sum re-encodes, AND the owner's final allgather
+    encode — is captured exactly once, by its sender.  Returns
+    `(result, new_residual)`; carrying the residual across steps makes
+    the dropped bits telescope EXACTLY:
+
+        n * out_t = sum_r g_r + sum_r e_{r,t} - sum_r e_{r,t+1}
+
+    (every bit the wire drops at step t sits in some rank's e_{t+1}),
+    so the time-averaged result converges to the exact reduction at
+    O(1/t).  Tested as an exact identity in tests/test_quantized.py.
     """
     encode, decode = _codec(wire)
     n = lax.psum(1, axis)
+    ef = error_feedback
     if n == 1:
+        if ef is not None:
+            # Exact wire: apply the carried residual, nothing dropped —
+            # the conservation identity degenerates to out = x + e.
+            out = (x.astype(jnp.float32)
+                   + ef.astype(jnp.float32)).astype(x.dtype)
+            return out, jnp.zeros(x.shape, jnp.float32)
         return x
     idx = lax.axis_index(axis)
     shape, dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
+    if ef is not None:
+        flat = flat + ef.astype(jnp.float32).reshape(-1)
     # Pad so each of the n chunks is a whole number of blocks.
     chunk = -(-flat.size // (n * _BLOCK)) * _BLOCK
     flat = jnp.pad(flat, (0, n * chunk - flat.size))
     acc = flat.reshape(n, chunk)
+    resid = jnp.zeros((n, chunk), jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     # --- ring reduce-scatter: n-1 hops of 1-byte payload (+scales) ---
-    def body(s, acc):
+    def body(s, carry):
+        acc, resid = carry
         send_idx = (idx - s) % n
         v = lax.dynamic_slice(acc, (send_idx, 0), (1, chunk))[0]
-        payload = tuple(lax.ppermute(p, axis, perm) for p in encode(v))
+        enc = encode(v)
+        if ef is not None:
+            # What this send dropped — kept by the SENDER.
+            resid = lax.dynamic_update_slice(
+                resid, (v - decode(enc))[None], (send_idx, 0))
+        payload = tuple(lax.ppermute(p, axis, perm) for p in enc)
         recv_idx = (idx - s - 1) % n
         mine = lax.dynamic_slice(acc, (recv_idx, 0), (1, chunk))[0]
         upd = mine + decode(payload)
-        return lax.dynamic_update_slice(acc, upd[None], (recv_idx, 0))
+        return (lax.dynamic_update_slice(acc, upd[None],
+                                         (recv_idx, 0)), resid)
 
-    acc = lax.fori_loop(0, n - 1, body, acc)
+    acc, resid = lax.fori_loop(0, n - 1, body, (acc, resid))
 
     # Rank i now owns the fully-reduced chunk (i + 1) % n.
     own_idx = (idx + 1) % n
     own = lax.dynamic_slice(acc, (own_idx, 0), (1, chunk))[0]
     payload = encode(own)
+    if ef is not None:
+        # The broadcast of the reduced chunk is ALSO a 1-byte send —
+        # every rank (owner included) consumes the decoded value, so
+        # the owner keeps the final encode's error too.
+        resid = lax.dynamic_update_slice(
+            resid, (own - decode(payload))[None], (own_idx, 0))
 
     # --- allgather phase (1-byte wire) ---
     gathered = tuple(lax.all_gather(p, axis) for p in payload)
@@ -127,7 +177,11 @@ def quantized_allreduce_shard(x: jax.Array, axis: str,
     out = chunks.reshape(-1)[: math.prod(shape)].reshape(shape)
     if average:
         out = out / n
-    return out.astype(dtype)
+    out = out.astype(dtype)
+    if ef is not None:
+        new_resid = resid.reshape(-1)[: math.prod(shape)].reshape(shape)
+        return out, new_resid
+    return out
 
 
 def quantized_allreduce(stacked: jax.Array, mesh: Mesh, axis: str = None,
@@ -148,4 +202,5 @@ def quantized_allreduce(stacked: jax.Array, mesh: Mesh, axis: str = None,
     return fn(stacked)
 
 
-__all__ = ["quantized_allreduce", "quantized_allreduce_shard"]
+__all__ = ["quantized_allreduce", "quantized_allreduce_shard",
+           "local_roundtrip"]

@@ -63,6 +63,7 @@ def allreduce_gradients(
     axis_name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
     fusion_threshold_bytes: Optional[int] = None,
+    error_feedback_state: Any = None,
 ) -> Any:
     """Average a gradient pytree across ranks with wire compression and
     fusion-buffer-style bucketing (reference: FusionBufferManager — here
@@ -71,16 +72,32 @@ def allreduce_gradients(
 
     `fusion_threshold_bytes` defaults to HOROVOD_FUSION_THRESHOLD (64 MB,
     the reference default), overridden live by the autotuner when
-    HOROVOD_AUTOTUNE=1."""
+    HOROVOD_AUTOTUNE=1.
+
+    `error_feedback_state` (quantized wires only; create with
+    `error_feedback_init(grads)`): standard EF compression — each rank
+    adds its carried residual to the gradient before encoding and keeps
+    the new LOCAL encode error for the next step, so the per-step
+    quantization bias telescopes away (time-averaged error O(1/t)
+    instead of a persistent bias).  When passed, the return value is
+    `(reduced, new_error_feedback_state)`; thread the state through
+    your step like optimizer state."""
     if fusion_threshold_bytes is None:
         from ..utils.autotune import current_fusion_threshold
         fusion_threshold_bytes = current_fusion_threshold()
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    if not leaves:
-        return grads
     from ..ops.compression import _CooperativeCompressor
-    if isinstance(compression, type) and \
-            issubclass(compression, _CooperativeCompressor):
+    _cooperative = (isinstance(compression, type) and
+                    issubclass(compression, _CooperativeCompressor))
+    if error_feedback_state is not None and not _cooperative:
+        raise ValueError(
+            "error_feedback_state only applies to the quantized wire "
+            "formats (Compression.int8 / fp8_*) — exact and fp16/bf16 "
+            "wires have no compression error to feed back")
+    if not leaves:
+        return ((grads, error_feedback_state)
+                if error_feedback_state is not None else grads)
+    if _cooperative:
         wire = compression.wire
         # Cooperative wire format: the quantized ring allreduce IS the
         # collective (ops/quantized.py).  In-jit only — it needs the
@@ -106,7 +123,18 @@ def allreduce_gradients(
         float_idx = [i for i, t in enumerate(leaves)
                      if jnp.issubdtype(t.dtype, jnp.floating)]
         int_idx = [i for i in range(len(leaves)) if i not in float_idx]
+        ef_leaves = None
+        if error_feedback_state is not None:
+            ef_leaves, ef_def = jax.tree_util.tree_flatten(
+                error_feedback_state)
+            if len(ef_leaves) != len(float_idx):
+                raise ValueError(
+                    f"error_feedback_state has {len(ef_leaves)} leaves; "
+                    f"expected one per float gradient leaf "
+                    f"({len(float_idx)}) — build it with "
+                    f"error_feedback_init(grads)")
         out = [None] * len(leaves)
+        new_ef = [None] * len(float_idx)
         if int_idx:
             exact = C.grouped_allreduce(
                 [leaves[i] for i in int_idx], op=op, axis_name=axis_name)
@@ -123,16 +151,34 @@ def allreduce_gradients(
                 continue
             flat = jnp.concatenate(
                 [leaves[i].astype(jnp.float32).reshape(-1) for i in idxs])
-            reduced = quantized_allreduce_shard(
-                flat, axis_name, average=(op is C.Average), wire=wire)
+            if ef_leaves is not None:
+                # Sender-side EF inside the ring: the collective adds
+                # the residual, captures every wire encode's error at
+                # its sender, and hands the new residual back — the
+                # dropped bits telescope exactly across steps (see
+                # quantized_allreduce_shard).
+                ef_flat = jnp.concatenate(
+                    [ef_leaves[j].reshape(-1) for j in bidxs])
+                reduced, err = quantized_allreduce_shard(
+                    flat, axis_name, average=(op is C.Average),
+                    wire=wire, error_feedback=ef_flat)
+            else:
+                reduced = quantized_allreduce_shard(
+                    flat, axis_name, average=(op is C.Average), wire=wire)
             offset = 0
-            for i in idxs:
+            for j, i in zip(bidxs, idxs):
                 n = leaves[i].size
                 out[i] = (reduced[offset:offset + n]
                           .reshape(leaves[i].shape)
                           .astype(leaves[i].dtype))
+                if ef_leaves is not None:
+                    new_ef[j] = err[offset:offset + n].reshape(
+                        leaves[i].shape)
                 offset += n
-        return jax.tree_util.tree_unflatten(treedef, out)
+        result = jax.tree_util.tree_unflatten(treedef, out)
+        if ef_leaves is not None:
+            return result, jax.tree_util.tree_unflatten(ef_def, new_ef)
+        return result
     compressed, ctxs = [], []
     for leaf in leaves:
         c, ctx = compression.compress(leaf)
@@ -150,6 +196,16 @@ def allreduce_gradients(
         for i, r in zip(idxs, reduced):
             out[i] = compression.decompress(r, ctxs[i])
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def error_feedback_init(grads: Any):
+    """Zero EF residuals for `allreduce_gradients(...,
+    error_feedback_state=...)`: one f32 zero array per FLOAT leaf of
+    `grads`, in leaf order (integer leaves ride the exact wire and
+    carry no residual)."""
+    leaves, _ = jax.tree_util.tree_flatten(grads)
+    return [jnp.zeros(leaf.shape, jnp.float32) for leaf in leaves
+            if jnp.issubdtype(leaf.dtype, jnp.floating)]
 
 
 def distributed_grad(

@@ -58,8 +58,10 @@ params = transformer_init(jax.random.PRNGKey(0), cfg)
 prompt = jax.random.randint(jax.random.PRNGKey(1), (1, T0), 0, V)
 
 if gamma == 0:
-    # warmup (compile) then timed
-    transformer_generate(params, cfg, prompt, 4)
+    # Warmup at the SAME shapes as the timed run (scan length and cache
+    # capacity key the compiled programs; a short warmup would leave
+    # the timed region paying the compile).
+    transformer_generate(params, cfg, prompt, N)
     t0 = time.perf_counter()
     toks, _ = transformer_generate(params, cfg, prompt, N)
     jax.block_until_ready(toks)
@@ -71,8 +73,10 @@ else:
     else:
         dcfg = cfg_for(dd, dl)
         dparams = transformer_init(jax.random.PRNGKey(7), dcfg)
+    # Warmup with the timed run's N so cache capacity (and thus every
+    # jitted program shape) matches the timed call exactly.
     transformer_speculative_generate(
-        params, cfg, dparams, dcfg, prompt, 2 * gamma + 2, gamma=gamma)
+        params, cfg, dparams, dcfg, prompt, N, gamma=gamma)
     t0 = time.perf_counter()
     toks, stats = transformer_speculative_generate(
         params, cfg, dparams, dcfg, prompt, N, gamma=gamma)

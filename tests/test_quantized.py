@@ -155,3 +155,156 @@ class TestInt8GradientPath:
         with pytest.raises(ValueError, match="in-jit path"):
             hvd.allreduce_gradients(
                 {"g": jnp.ones((4,))}, compression=hvd.Compression.int8)
+
+
+class TestErrorFeedback:
+    """EF compression (r5): residual bookkeeping and telescoping bias
+    cancellation on the quantized wire."""
+
+    def _run_ef(self, mesh8, grads_per_rank, steps, wire="int8"):
+        """Iterate allreduce_gradients with EF on CONSTANT per-rank
+        grads; returns list of per-step outputs (rank-0 view)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        hvd.init()
+        stacked = jnp.stack(grads_per_rank)        # [8, L]
+
+        def one(x, e):
+            out, e2 = hvd.allreduce_gradients(
+                [x[0]], compression=hvd.Compression.int8,
+                axis_name="r", error_feedback_state=e)
+            return out[0][None], [a[None] for a in e2]
+
+        sm = jax.jit(shard_map(
+            one, mesh=mesh8,
+            in_specs=(P("r"), [P("r")]),
+            out_specs=(P("r"), [P("r")]),
+            check_vma=False))
+        e = [jnp.zeros_like(stacked)]
+        outs = []
+        for _ in range(steps):
+            o, e = sm(stacked, e)
+            outs.append(np.asarray(o[0]))
+        return outs
+
+    def test_conservation_identity_exact(self, mesh8):
+        # The sender-side EF contract (quantized_allreduce_shard): every
+        # bit the wire drops at step t sits in some rank's residual, so
+        #   n * out_t == sum_r g_r + sum_r e_t - sum_r e_{t+1}
+        # holds EXACTLY (f32 noise), not just statistically.
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        hvd.init()
+        rng = np.random.default_rng(3)
+        stacked = jnp.asarray(
+            rng.normal(size=(8, 256)).astype(np.float32))
+
+        def one(x, e):
+            out, e2 = hvd.allreduce_gradients(
+                [x[0]], compression=hvd.Compression.int8,
+                axis_name="r", error_feedback_state=e)
+            return out[0][None], [a[None] for a in e2]
+
+        sm = jax.jit(shard_map(
+            one, mesh=mesh8, in_specs=(P("r"), [P("r")]),
+            out_specs=(P("r"), [P("r")]), check_vma=False))
+        e = [jnp.zeros_like(stacked)]
+        S = np.sum(np.asarray(stacked), axis=0)
+        for _ in range(3):
+            e_before = np.sum(np.asarray(e[0]), axis=0)
+            out, e = sm(stacked, e)
+            e_after = np.sum(np.asarray(e[0]), axis=0)
+            lhs = 8.0 * np.asarray(out[0])        # Average -> sum
+            np.testing.assert_allclose(
+                lhs, S + e_before - e_after, atol=2e-3, rtol=1e-5)
+
+    def test_compressor_bias_telescopes_away(self):
+        # The EF recursion against the LOCAL compressor C (the operator
+        # whose error is fed back): mean_t C(g + e_t) -> g with error
+        # O(1/t) — the classic telescoping identity.
+        from horovod_tpu.ops.quantized import local_roundtrip
+
+        g = jnp.asarray(np.random.default_rng(5).normal(
+            size=(512,)).astype(np.float32) * 3)
+        e = jnp.zeros_like(g)
+        outs = []
+        for _ in range(12):
+            c = local_roundtrip(g + e)
+            e = (g + e) - c
+            outs.append(np.asarray(c))
+        single = np.abs(outs[0] - np.asarray(g)).mean()
+        mean_err = np.abs(np.mean(outs, 0) - np.asarray(g)).mean()
+        assert mean_err < single / 5, (mean_err, single)
+
+    def test_bias_telescopes_through_the_ring(self, mesh8):
+        # End-to-end O(1/t): sender-side EF captures EVERY wire
+        # encode's error (first-hop, interior re-encodes, final
+        # broadcast), so over 10 steps the time-averaged error drops to
+        # ~1/10 of a single shot (measured r5: ratio 0.104).
+        rng = np.random.default_rng(7)
+        grads = [rng.normal(size=(512,)).astype(np.float32) * 3
+                 for _ in range(8)]
+        exact = np.mean(np.stack(grads), axis=0)
+        outs = self._run_ef(mesh8, grads, steps=10)
+        single_err = np.abs(outs[0] - exact).mean()
+        mean_err = np.abs(np.mean(outs, axis=0) - exact).mean()
+        assert mean_err < single_err * 0.2, (mean_err, single_err)
+
+    def test_ef_requires_quantized_wire(self):
+        hvd.init()
+        with pytest.raises(ValueError, match="error_feedback"):
+            hvd.allreduce_gradients(
+                {"g": jnp.ones((4,))},
+                compression=hvd.Compression.fp16,
+                error_feedback_state=[jnp.zeros((4,))])
+
+    def test_ef_leaf_count_mismatch_raises(self, mesh8):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        hvd.init()
+        stacked = jnp.ones((8, 128), jnp.float32)
+
+        def one(x, e):
+            out, e2 = hvd.allreduce_gradients(
+                [x[0], x[0]], compression=hvd.Compression.int8,
+                axis_name="r", error_feedback_state=e)
+            return out[0][None], [a[None] for a in e2]
+
+        sm = shard_map(one, mesh=mesh8, in_specs=(P("r"), [P("r")]),
+                       out_specs=(P("r"), [P("r")]), check_vma=False)
+        with pytest.raises(ValueError, match="error_feedback_init"):
+            jax.jit(sm)(stacked, [jnp.zeros((8, 128))])
+
+    def test_error_feedback_init_float_leaves_only(self):
+        grads = {"w": jnp.ones((3, 2)), "step": jnp.ones((), jnp.int32)}
+        st = hvd.error_feedback_init(grads)
+        assert len(st) == 1 and st[0].shape == (3, 2)
+        assert st[0].dtype == jnp.float32
+
+    def test_single_rank_applies_residual(self):
+        # Shrunk-to-one-rank collective: the carried residual must be
+        # APPLIED (out = x + e), not dropped (r5 review).
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        hvd.init()
+        mesh1 = Mesh(np.array(jax.devices()[:1]), ("r",))
+        x = jnp.ones((1, 128), jnp.float32)
+        e = jnp.full((1, 128), 0.25, jnp.float32)
+
+        def one(x, e):
+            out, e2 = hvd.allreduce_gradients(
+                [x[0]], compression=hvd.Compression.int8,
+                axis_name="r", error_feedback_state=e)
+            return out[0][None], [a[None] for a in e2]
+
+        sm = jax.jit(shard_map(one, mesh=mesh1,
+                               in_specs=(P("r"), [P("r")]),
+                               out_specs=(P("r"), [P("r")]),
+                               check_vma=False))
+        out, e2 = sm(x, [e])
+        np.testing.assert_allclose(np.asarray(out[0]), 1.25)
+        np.testing.assert_allclose(np.asarray(e2[0]), 0.0)
