@@ -139,7 +139,9 @@ def autotune_record_step(items: float = 1.0) -> None:
         mgr.record_step(items)
 
 from .parallel.hierarchical import (  # noqa: F401
+    dcn_shard_size,
     hierarchical_allreduce,
+    hierarchical_error_feedback_init,
 )
 
 from . import callbacks  # noqa: F401

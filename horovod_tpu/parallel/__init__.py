@@ -15,7 +15,9 @@ from .mesh import (  # noqa: F401
     mesh_axis_size,
 )
 from .hierarchical import (  # noqa: F401
+    dcn_shard_size,
     hierarchical_allreduce,
+    hierarchical_error_feedback_init,
 )
 from .sequence import (  # noqa: F401
     dense_attention_oracle,

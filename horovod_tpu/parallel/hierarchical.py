@@ -47,7 +47,8 @@ def enabled() -> bool:
 
 
 def hierarchical_reduce_leaf(x, dcn_axis: str, ici_axis: str, average: bool,
-                             dcn_wire: str = None):
+                             dcn_wire: str = None,
+                             error_feedback: jnp.ndarray = None):
     """One leaf: flatten → psum_scatter(ICI) → psum(DCN) → all_gather(ICI).
 
     Padding makes any size divisible by the ICI axis; the pad rides the
@@ -58,7 +59,19 @@ def hierarchical_reduce_leaf(x, dcn_axis: str, ici_axis: str, average: bool,
     the quantized ring collective (ops/quantized.py): each element
     crosses DCN once per 1/ici_size shard AND at 1 byte instead of 4.
     The fast ICI legs stay exact.  Env: HOROVOD_HIERARCHICAL_DCN_WIRE.
+
+    `error_feedback` (quantized wire only): f32 array shaped like this
+    rank's DCN shard — `dcn_shard_size(x.size, n_ici)` elements — the
+    sender-side EF residual carried across steps (see
+    quantized_allreduce_shard).  Returns (out, new_residual).  The
+    residual lives in the ICI-scattered SUM space; since the scatter
+    assignment is static, carrying it per rank telescopes the DCN
+    wire's dropped bits exactly as in the flat ring.
     """
+    if error_feedback is not None and not dcn_wire:
+        raise ValueError(
+            "error_feedback requires a quantized dcn_wire (the exact "
+            "psum drops nothing)")
     n_ici = lax.axis_size(ici_axis)
     n_dcn = lax.axis_size(dcn_axis)
     flat = x.reshape(-1)
@@ -66,10 +79,16 @@ def hierarchical_reduce_leaf(x, dcn_axis: str, ici_axis: str, average: bool,
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     s = lax.psum_scatter(flat, ici_axis, tiled=True)   # 1/n_ici shard, ICI sum
+    resid = None
     if dcn_wire:
         from ..ops.quantized import quantized_allreduce_shard
 
-        s = quantized_allreduce_shard(s, dcn_axis, wire=dcn_wire)
+        if error_feedback is not None:
+            s, resid = quantized_allreduce_shard(
+                s, dcn_axis, wire=dcn_wire,
+                error_feedback=error_feedback)
+        else:
+            s = quantized_allreduce_shard(s, dcn_axis, wire=dcn_wire)
     else:
         s = lax.psum(s, dcn_axis)                      # cross-slice, DCN
     g = lax.all_gather(s, ici_axis, tiled=True)        # reassemble over ICI
@@ -78,7 +97,48 @@ def hierarchical_reduce_leaf(x, dcn_axis: str, ici_axis: str, average: bool,
     out = g.reshape(x.shape)
     if average:
         out = (out.astype(jnp.float32) / (n_ici * n_dcn)).astype(x.dtype)
+    if error_feedback is not None:
+        return out, resid
     return out
+
+
+def dcn_shard_size(size: int, n_ici: int) -> int:
+    """Elements of one rank's DCN shard for a leaf of `size` elements —
+    the shape of the `error_feedback` residual a caller must carry."""
+    return (size + (-size) % n_ici) // n_ici
+
+
+def _leaf_wire(dt, average: bool, dcn_wire: Optional[str]):
+    """The ONE wire-eligibility rule (shared by the allreduce and the EF
+    state constructor — their per-dtype decisions must never diverge):
+    env-routed when dcn_wire is None, explicit wire for float dtypes
+    only otherwise."""
+    if dcn_wire is None:
+        return _env_dcn_wire(dt, average)
+    return dcn_wire if jnp.issubdtype(dt, jnp.floating) else None
+
+
+def hierarchical_error_feedback_init(tree: Any, ici_size: int,
+                                     dcn_wire: Optional[str] = None,
+                                     average: bool = True):
+    """Zero EF residuals for `hierarchical_allreduce(...,
+    error_feedback_state=...)`: one f32 zero array per fused
+    WIRE-ELIGIBLE dtype buffer of `tree` (same by-dtype grouping,
+    first-occurrence order), each sized to this rank's DCN shard
+    (`dcn_shard_size(buffer, ici_size)`).  `dcn_wire=None` reads the
+    env route the allreduce itself would use."""
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    by_dtype: dict = {}
+    for leaf in leaves:
+        dt = jnp.asarray(leaf).dtype
+        by_dtype.setdefault(dt, 0)
+        by_dtype[dt] += jnp.asarray(leaf).size
+    state = []
+    for dt, total in by_dtype.items():
+        if _leaf_wire(dt, average, dcn_wire):
+            state.append(jnp.zeros((dcn_shard_size(total, ici_size),),
+                                   jnp.float32))
+    return state
 
 
 def hierarchical_allreduce(
@@ -87,40 +147,69 @@ def hierarchical_allreduce(
     ici_axis: Optional[str] = None,
     average: bool = True,
     dcn_wire: Optional[str] = None,
+    error_feedback_state: Any = None,
 ):
     """Hierarchical allreduce of a pytree (gradients), fused: all leaves
     of one dtype are concatenated into a single flat buffer so the three
     collectives run once per dtype, not once per tensor (the fusion-buffer
-    behavior of the reference, in-graph)."""
+    behavior of the reference, in-graph).
+
+    `error_feedback_state` (quantized `dcn_wire` only; build with
+    `hierarchical_error_feedback_init`): sender-side EF residuals for
+    the DCN leg, one per wire-eligible dtype buffer.  When passed, the
+    return value is `(reduced_tree, new_state)`."""
     from ..common.basics import GLOBAL_AXIS
 
     ici_axis = ici_axis or GLOBAL_AXIS
-    env_wire = dcn_wire is None
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
-        return tree
+        return ((tree, error_feedback_state)
+                if error_feedback_state is not None else tree)
     out = [None] * len(leaves)
     by_dtype = {}
     for i, leaf in enumerate(leaves):
         by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    ef_iter = (iter(error_feedback_state)
+               if error_feedback_state is not None else None)
+    new_ef = []
+    wired_buffers = 0
     for dt, idxs in by_dtype.items():
         flats = [jnp.ravel(leaves[i]) for i in idxs]
         sizes = [f.size for f in flats]
         buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
         # Quantized wire is float-only: integer leaves (counters etc.)
         # must keep summing exactly over the DCN psum.
-        if env_wire:
-            leaf_wire = _env_dcn_wire(dt, average)
+        leaf_wire = _leaf_wire(dt, average, dcn_wire)
+        if ef_iter is not None and leaf_wire:
+            wired_buffers += 1
+            try:
+                e = next(ef_iter)
+            except StopIteration:
+                raise ValueError(
+                    "error_feedback_state has fewer entries than "
+                    "wire-eligible dtype buffers — build it with "
+                    "hierarchical_error_feedback_init(tree, ici_size)"
+                ) from None
+            red, e2 = hierarchical_reduce_leaf(
+                buf, dcn_axis, ici_axis, average, dcn_wire=leaf_wire,
+                error_feedback=e)
+            new_ef.append(e2)
         else:
-            leaf_wire = dcn_wire if jnp.issubdtype(dt, jnp.floating) \
-                else None
-        red = hierarchical_reduce_leaf(buf, dcn_axis, ici_axis, average,
-                                       dcn_wire=leaf_wire)
+            red = hierarchical_reduce_leaf(
+                buf, dcn_axis, ici_axis, average, dcn_wire=leaf_wire)
         off = 0
         for i, sz in zip(idxs, sizes):
             out[i] = red[off: off + sz].reshape(jnp.shape(leaves[i]))
             off += sz
-    return jax.tree_util.tree_unflatten(treedef, out)
+    result = jax.tree_util.tree_unflatten(treedef, out)
+    if ef_iter is not None:
+        if next(ef_iter, None) is not None:
+            raise ValueError(
+                f"error_feedback_state has more entries than the "
+                f"{wired_buffers} wire-eligible dtype buffers — build "
+                f"it with hierarchical_error_feedback_init")
+        return result, new_ef
+    return result
 
 
 def maybe_hierarchical(x, axes, op_name: str):
@@ -139,8 +228,10 @@ def maybe_hierarchical(x, axes, op_name: str):
 
 
 __all__ = [
+    "dcn_shard_size",
     "enabled",
     "hierarchical_allreduce",
+    "hierarchical_error_feedback_init",
     "hierarchical_reduce_leaf",
     "maybe_hierarchical",
 ]

@@ -229,3 +229,104 @@ def test_dcn_wire_on_auto_dispatch_path(hmesh, monkeypatch):
     s = np.asarray(_run(fsum, hmesh, vals))
     np.testing.assert_allclose(s, np.sum(np.stack(vals), 0), rtol=1e-5,
                                atol=1e-4)
+
+
+def test_dcn_wire_error_feedback_telescopes(hmesh):
+    """Sender-side EF on the DCN leg (r5): conservation identity per
+    step in DCN-sum space, and the time-averaged output converges to
+    the exact mean (O(1/t)) with constant gradients."""
+    from horovod_tpu.parallel.hierarchical import (
+        dcn_shard_size, hierarchical_reduce_leaf)
+
+    rng = np.random.RandomState(9)
+    vals = [rng.normal(size=(300,)).astype(np.float32) * 50
+            for _ in range(N)]
+    exact = np.mean(np.stack(vals), axis=0)
+    shard = dcn_shard_size(300, ICI)
+
+    def f(x, e):
+        out, e2 = hierarchical_reduce_leaf(
+            x[0], "dcn", hvd.GLOBAL_AXIS, average=True,
+            dcn_wire="int8", error_feedback=e[0])
+        return out[None], e2[None]
+
+    sm = jax.jit(shard_map(
+        f, mesh=hmesh,
+        in_specs=(P(("dcn", hvd.GLOBAL_AXIS)),
+                  P(("dcn", hvd.GLOBAL_AXIS))),
+        out_specs=(P(("dcn", hvd.GLOBAL_AXIS)),
+                   P(("dcn", hvd.GLOBAL_AXIS))),
+        check_vma=False))
+    e = jnp.zeros((N, shard), jnp.float32)
+    outs = []
+    for _ in range(8):
+        o, e = sm(jnp.stack(vals), e)
+        outs.append(np.asarray(o[0]))
+    single = np.abs(outs[0] - exact).mean()
+    mean_err = np.abs(np.mean(outs, 0) - exact).mean()
+    assert mean_err < single * 0.35, (mean_err, single)
+
+
+def test_dcn_wire_error_feedback_requires_wire(hmesh):
+    from horovod_tpu.parallel.hierarchical import hierarchical_reduce_leaf
+
+    def f(x):
+        out, _ = hierarchical_reduce_leaf(
+            x[0], "dcn", hvd.GLOBAL_AXIS, average=True,
+            error_feedback=jnp.zeros((75,)))
+        return out
+
+    with pytest.raises(ValueError, match="quantized dcn_wire"):
+        _run(f, hmesh, [np.zeros((300,), np.float32)] * N)
+
+
+def test_tree_level_dcn_error_feedback(hmesh):
+    """The production tree-level API threads EF: mixed float/int tree,
+    residual per wire-eligible dtype buffer, telescoping average."""
+    from horovod_tpu.parallel.hierarchical import (
+        hierarchical_allreduce, hierarchical_error_feedback_init)
+
+    rng = np.random.RandomState(11)
+    g = [rng.normal(size=(200,)).astype(np.float32) * 20
+         for _ in range(N)]
+    b = [rng.normal(size=(40,)).astype(np.float32) * 20
+         for _ in range(N)]
+    exact_g = np.mean(np.stack(g), axis=0)
+    tmpl = {"w": g[0], "b": b[0], "step": np.zeros((2,), np.int32)}
+    ef0 = hierarchical_error_feedback_init(tmpl, ICI, dcn_wire="int8")
+    assert len(ef0) == 1          # one f32 buffer; int leaves excluded
+
+    def f(w, bb, st, e):
+        tree = {"w": w[0], "b": bb[0], "step": st[0]}
+        out, e2 = hierarchical_allreduce(
+            tree, "dcn", hvd.GLOBAL_AXIS, average=True,
+            dcn_wire="int8", error_feedback_state=e)
+        return out["w"][None], [a[None] for a in e2]
+
+    spec = P(("dcn", hvd.GLOBAL_AXIS))
+    sm = jax.jit(shard_map(
+        f, mesh=hmesh, in_specs=(spec, spec, spec, [spec]),
+        out_specs=(spec, [spec]), check_vma=False))
+    steps_in = (jnp.stack(g), jnp.stack(b),
+                jnp.zeros((N, 2), jnp.int32))
+    e = [jnp.broadcast_to(ef0[0], (N,) + ef0[0].shape)]
+    outs = []
+    for _ in range(8):
+        o, e = sm(*steps_in, e)
+        outs.append(np.asarray(o[0]))
+    single = np.abs(outs[0] - exact_g).mean()
+    mean_err = np.abs(np.mean(outs, 0) - exact_g).mean()
+    assert mean_err < single * 0.4, (mean_err, single)
+
+
+def test_tree_level_ef_count_mismatch(hmesh):
+    from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+
+    def f(x):
+        out, _ = hierarchical_allreduce(
+            {"w": x[0]}, "dcn", hvd.GLOBAL_AXIS, average=True,
+            dcn_wire="int8", error_feedback_state=[])
+        return out["w"]
+
+    with pytest.raises(ValueError, match="fewer entries"):
+        _run(f, hmesh, [np.ones((300,), np.float32)] * N)
