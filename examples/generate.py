@@ -19,6 +19,7 @@ from horovod_tpu.models import (
     transformer_beam_search,
     transformer_generate,
     transformer_init,
+    transformer_speculative_generate,
 )
 
 
@@ -37,6 +38,12 @@ def main():
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument("--beam", type=int, default=0,
                    help="beam width (0 = greedy/sampling path)")
+    p.add_argument("--spec-gamma", type=int, default=0,
+                   help="speculative decoding: draft proposals per "
+                        "round (0 = off; needs --batch 1)")
+    p.add_argument("--draft-d-model", type=int, default=64,
+                   help="draft model width for --spec-gamma")
+    p.add_argument("--draft-layers", type=int, default=1)
     args = p.parse_args()
 
     cfg = TransformerConfig(
@@ -57,6 +64,30 @@ def main():
             "--beam is deterministic; drop --temperature/--top-p")
     rng = jax.random.PRNGKey(2) if args.temperature else None
     t0 = time.perf_counter()
+    if args.spec_gamma:
+        if args.beam:
+            raise SystemExit("--spec-gamma and --beam are exclusive")
+        if args.top_p < 1.0:
+            raise SystemExit(
+                "--top-p is not supported with --spec-gamma (the "
+                "speculative accept rule samples the full distribution)")
+        if args.batch != 1:
+            raise SystemExit("--spec-gamma needs --batch 1")
+        draft_cfg = TransformerConfig(
+            vocab_size=args.vocab, d_model=args.draft_d_model,
+            n_heads=max(1, args.draft_d_model // 32),
+            d_head=min(32, args.draft_d_model),
+            d_ff=4 * args.draft_d_model, n_layers=args.draft_layers)
+        draft = transformer_init(jax.random.PRNGKey(9), draft_cfg)
+        out, stats = transformer_speculative_generate(
+            params, cfg, draft, draft_cfg, prompt, args.new_tokens,
+            gamma=args.spec_gamma, temperature=args.temperature, rng=rng)
+        dt = time.perf_counter() - t0
+        print(f"speculative gamma={args.spec_gamma}: "
+              f"{args.new_tokens} tokens in {dt:.2f}s; accept rate "
+              f"{stats['accept_rate']:.2f} over {stats['rounds']} rounds")
+        print("sequence:", out[0].tolist())
+        return
     if args.beam:
         out, scores = transformer_beam_search(
             params, cfg, prompt, args.new_tokens, beam_width=args.beam)

@@ -124,6 +124,15 @@ class TestExamples:
             extra_env={"XLA_FLAGS": ""})
         assert "generated" in out
 
+    def test_generate_speculative(self):
+        out = _run_example(
+            "generate.py",
+            ["--batch", "1", "--d-model", "64", "--n-layers", "2",
+             "--n-heads", "4", "--new-tokens", "8", "--spec-gamma", "3",
+             "--draft-d-model", "32"],
+            extra_env={"XLA_FLAGS": ""})
+        assert "accept rate" in out
+
     def test_generate_beam(self):
         out = _run_example(
             "generate.py",
