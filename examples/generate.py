@@ -40,7 +40,8 @@ def main():
                    help="beam width (0 = greedy/sampling path)")
     p.add_argument("--spec-gamma", type=int, default=0,
                    help="speculative decoding: draft proposals per "
-                        "round (0 = off; needs --batch 1)")
+                        "round (0 = off; batched rows advance by the "
+                        "batch-minimum acceptance)")
     p.add_argument("--draft-d-model", type=int, default=64,
                    help="draft model width for --spec-gamma")
     p.add_argument("--draft-layers", type=int, default=1)
@@ -71,8 +72,10 @@ def main():
             raise SystemExit(
                 "--top-p is not supported with --spec-gamma (the "
                 "speculative accept rule samples the full distribution)")
-        if args.batch != 1:
-            raise SystemExit("--spec-gamma needs --batch 1")
+        if args.attn_window:
+            raise SystemExit(
+                "--attn-window is not supported with --spec-gamma "
+                "(rollback across a rolling ring would evict live slots)")
         draft_cfg = TransformerConfig(
             vocab_size=args.vocab, d_model=args.draft_d_model,
             n_heads=max(1, args.draft_d_model // 32),
@@ -83,10 +86,11 @@ def main():
             params, cfg, draft, draft_cfg, prompt, args.new_tokens,
             gamma=args.spec_gamma, temperature=args.temperature, rng=rng)
         dt = time.perf_counter() - t0
+        n = args.batch * args.new_tokens
         print(f"speculative gamma={args.spec_gamma}: "
-              f"{args.new_tokens} tokens in {dt:.2f}s; accept rate "
+              f"{n} tokens in {dt:.2f}s; accept rate "
               f"{stats['accept_rate']:.2f} over {stats['rounds']} rounds")
-        print("sequence:", out[0].tolist())
+        print("first sequence:", out[0].tolist())
         return
     if args.beam:
         out, scores = transformer_beam_search(

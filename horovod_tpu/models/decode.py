@@ -356,23 +356,24 @@ def transformer_speculative_generate(
       norm(max(0, p - q)).  The output distribution equals target-only
       sampling.
 
-    Single-sequence only (B == 1): per-sequence acceptance lengths
-    diverge under batching and would need ragged cache positions.
-    Returns (tokens [1, max_new_tokens], stats dict with
-    `rounds`, `accept_rate`).  The round loop runs in Python; the two
-    model passes per round are the compiled pieces (draft scan +
-    target chunk extend), so wall-clock per round is one draft scan of
-    gamma steps + ONE target dispatch — the latency win when the
-    target is dispatch- or memory-bound.
+    Batching (B > 1) uses MIN-ACCEPTANCE: every round all sequences
+    advance by the batch-minimum accepted length + 1, so the shared
+    cache position stays scalar.  Per-row VALUES are unaffected — a row
+    that accepted beyond the minimum takes its own (already-verified)
+    draft token as the round's extra — only throughput degrades toward
+    the slowest row (the standard batched-speculation tradeoff).
+    Returns (tokens [B, max_new_tokens], stats dict with `rounds`,
+    `accept_rate` — the min-based effective rate).  The round loop runs
+    in Python; the model passes per round are the compiled pieces
+    (draft scan + target chunk extend + one step), so wall-clock per
+    round is one draft scan of gamma steps + ONE chunked target
+    dispatch — the latency win when the target is dispatch- or
+    memory-bound.
 
     Both models must share the vocabulary; `cfg.attn_window` is not
     supported (rollback across a rolling ring would evict live slots).
     """
     B, T0 = prompt.shape
-    if B != 1:
-        raise ValueError(
-            f"speculative decoding supports batch 1, got {B} "
-            f"(per-sequence acceptance lengths diverge)")
     if cfg.attn_window or draft_cfg.attn_window:
         raise ValueError(
             "speculative decoding does not support attn_window configs")
@@ -399,17 +400,17 @@ def transformer_speculative_generate(
             f"max_len {cap} < prompt {T0} + max_new {max_new_tokens} + "
             f"gamma {gamma} + 1: speculative rounds write up to gamma "
             f"slots past the accepted frontier before rolling back")
-    cache = init_decode_cache(cfg, 1, cap)
-    dcache = init_decode_cache(draft_cfg, 1, cap)
+    cache = init_decode_cache(cfg, B, cap)
+    dcache = init_decode_cache(draft_cfg, B, cap)
 
     # Loop invariant (restored at the end of every round): every
     # DECIDED token is fed into both caches, and tlast/dlast are the
-    # [V] logits (numpy, host) for the next undecided position.
+    # [B, V] logits (numpy, host) for the next undecided position.
     # Prefill establishes it for the prompt.
     tlast, cache = transformer_prefill(params, cache, prompt, cfg)
     dlast, dcache = transformer_prefill(draft_params, dcache, prompt,
                                         draft_cfg)
-    tlast, dlast = np.asarray(tlast[0]), np.asarray(dlast[0])
+    tlast, dlast = np.asarray(tlast), np.asarray(dlast)
 
     # Compiled programs are module-cached per (cfg, ...) with params as
     # TRACED ARGUMENTS — repeat calls with the same configs reuse the
@@ -437,22 +438,22 @@ def transformer_speculative_generate(
         p = _softmax_np(logits_np / temperature)
         return int(rng_np.choice(len(p), p=p))
 
-    out: list = []
+    out = [[] for _ in range(B)]    # decided tokens per row
     rounds = 0
     accepted_total = 0
     proposed_total = 0
     base = T0                       # first undecided position (host)
-    while len(out) < max_new_tokens:
+    while len(out[0]) < max_new_tokens:
         rounds += 1
         # Always propose a full gamma chunk — a shorter final round
         # would compile a SECOND (dscan, extend) shape pair just to
         # absorb the tail; the cache reserves gamma headroom past the
         # frontier and the final truncation discards any surplus.
         n = gamma
-        # --- draft proposes n tokens in ONE compiled scan -----------
-        # qlogits[i] is the distribution d_i was drawn from; the scan
-        # feeds every drafted token (the rollback below erases the
-        # speculative tail either way).
+        # --- draft proposes n tokens per row in ONE compiled scan ---
+        # qlogits[i] is the distribution row b's d_i was drawn from;
+        # the scan feeds every drafted token (the rollback below erases
+        # the speculative tail either way).
         keys = (jax.random.split(rng, n + 1) if rng is not None
                 else jnp.zeros((n + 1, 2), jnp.uint32))
         rng = keys[0] if rng is not None else None
@@ -461,64 +462,81 @@ def transformer_speculative_generate(
             drafts_d, qlogits_d, dcache = dscan(
                 draft_params, dcache, jnp.asarray(dlast), keys[1:],
                 jnp.float32(temperature))
-            qlogits = np.asarray(qlogits_d)
+            qlogits = np.asarray(qlogits_d)        # [n, B, V]
         else:
             drafts_d, dcache = dscan(
                 draft_params, dcache, jnp.asarray(dlast), keys[1:],
                 jnp.float32(1.0))
             qlogits = None
-        drafts = [int(t) for t in np.asarray(drafts_d)]
+        drafts = np.asarray(drafts_d)              # [n, B] int
         proposed_total += n
         # --- target scores all n in ONE chunked forward -------------
         # Row i predicts position base+1+i; position base is judged by
-        # tlast, so target distributions are [tlast, rows 0..n-2] and
-        # row n-1 supplies the all-accepted bonus position base+n.
+        # tlast, so each row's target distributions are [tlast[b],
+        # tlogits[b, 0..n-2]] and tlogits[b, n-1] supplies the
+        # all-accepted bonus position base+n.
         tlogits_d, cache = extend(params, cache,
-                                  jnp.asarray([drafts], jnp.int32))
-        tlogits = np.asarray(tlogits_d[0])         # [n, V]
-        tdists = [tlast] + [tlogits[i] for i in range(n - 1)]
-        n_acc = 0
-        extra = None
-        for i in range(n):
-            if not temperature:
-                t_tok = int(np.argmax(tdists[i]))
-                if drafts[i] == t_tok:
-                    n_acc += 1
+                                  jnp.asarray(drafts.T, jnp.int32))
+        tlogits = np.asarray(tlogits_d)            # [B, n, V]
+
+        per_acc = [0] * B
+        per_extra: list = [None] * B
+        for b in range(B):
+            tdists = [tlast[b]] + [tlogits[b, i] for i in range(n - 1)]
+            for i in range(n):
+                d_i = int(drafts[i, b])
+                if not temperature:
+                    t_tok = int(np.argmax(tdists[i]))
+                    if d_i == t_tok:
+                        per_acc[b] += 1
+                        continue
+                    per_extra[b] = t_tok
+                    break
+                p = _softmax_np(tdists[i] / temperature)
+                q = _softmax_np(qlogits[i, b] / temperature)
+                if rng_np.uniform() < min(
+                        1.0, float(p[d_i]) / max(float(q[d_i]), 1e-20)):
+                    per_acc[b] += 1
                     continue
-                extra = t_tok
+                resid = np.maximum(p - q, 0.0)
+                resid = resid / max(resid.sum(), 1e-20)
+                per_extra[b] = int(rng_np.choice(len(resid), p=resid))
                 break
-            p = _softmax_np(tdists[i] / temperature)
-            q = _softmax_np(qlogits[i] / temperature)
-            if rng_np.uniform() < min(
-                    1.0, float(p[drafts[i]]) / max(float(q[drafts[i]]),
-                                                   1e-20)):
-                n_acc += 1
-                continue
-            resid = np.maximum(p - q, 0.0)
-            resid = resid / max(resid.sum(), 1e-20)
-            extra = int(rng_np.choice(len(resid), p=resid))
-            break
-        if extra is None:
-            # All n accepted: row n-1 prices position base+n for free.
-            extra = _host_pick(tlogits[n - 1])
+        # Min-acceptance: all rows advance n_acc + 1 tokens.  A row
+        # that accepted beyond n_acc takes its OWN verified draft at
+        # position n_acc as the extra — values stay exactly that row's
+        # target chain; only speed is lost to the slowest row.
+        n_acc = min(per_acc)
+        extra = [0] * B
+        for b in range(B):
+            if per_acc[b] > n_acc:
+                extra[b] = int(drafts[n_acc, b])
+            elif per_extra[b] is not None:
+                extra[b] = per_extra[b]
+            else:
+                # Row accepted all n (== n_acc): bonus from its last
+                # chunk row.
+                extra[b] = _host_pick(tlogits[b, n - 1])
         accepted_total += n_acc
-        out.extend(drafts[:n_acc])
-        if len(out) < max_new_tokens:
-            out.append(extra)
-            # --- restore the invariant: feed the extra token --------
+        for b in range(B):
+            out[b].extend(int(t) for t in drafts[:n_acc, b])
+        if len(out[0]) < max_new_tokens:
+            for b in range(B):
+                out[b].append(extra[b])
+            # --- restore the invariant: feed the extra tokens -------
             # Both caches fed d_0..d_{n-1} (pos base+n).  Roll both to
             # the accepted frontier and feed `extra`; stale speculative
             # slots beyond it are masked (abs-pos reconstruction) and
             # later overwritten.
-            feed = jnp.asarray([extra], jnp.int32)
+            feed = jnp.asarray(extra, jnp.int32)
             tl, cache = tstep(params, _at(cache, base + n_acc), feed)
             dl, dcache = dstep(draft_params, _at(dcache, base + n_acc),
                                feed)
-            tlast, dlast = np.asarray(tl[0]), np.asarray(dl[0])
+            tlast, dlast = np.asarray(tl), np.asarray(dl)
             base = base + n_acc + 1
         else:
             base = base + n_acc
-    toks = jnp.asarray(out[:max_new_tokens], jnp.int32)[None]
+    toks = jnp.asarray([row[:max_new_tokens] for row in out], jnp.int32)
     stats = {"rounds": rounds,
              "accept_rate": accepted_total / max(1, proposed_total)}
     return toks, stats
@@ -541,24 +559,24 @@ def _spec_step_fn(cfg: TransformerConfig):
 
 @functools.lru_cache(maxsize=None)
 def _spec_draft_scan(cfg: TransformerConfig, n: int, sampled: bool):
-    """One compiled program proposing n draft tokens: scan of
+    """One compiled program proposing n draft tokens per row: scan of
     (pick from current logits, feed, next logits).  Returns
-    (drafts [n] int32, qlogits [n, V] f32, cache)."""
+    (drafts [n, B] int32, qlogits [n, B, V] f32, cache)."""
 
     def run(params, cache, first_logits, keys, temp):
         def body(carry, key):
-            cache, cur = carry
+            cache, cur = carry                     # cur [B, V]
             if sampled:
-                tok = jax.random.categorical(key, cur / temp)
+                tok = jax.random.categorical(key, cur / temp, axis=-1)
             else:
-                tok = jnp.argmax(cur)
+                tok = jnp.argmax(cur, axis=-1)     # [B]
             lg, cache = transformer_decode_step(
-                params, cache, tok[None].astype(jnp.int32), cfg)
+                params, cache, tok.astype(jnp.int32), cfg)
             # qlogits only feed the sampling accept rule; the greedy
             # specialization stacks nothing.
             ys = ((tok.astype(jnp.int32), cur) if sampled
                   else tok.astype(jnp.int32))
-            return (cache, lg[0]), ys
+            return (cache, lg), ys
 
         (cache, _), ys = lax.scan(
             body, (cache, first_logits), keys, length=n)

@@ -215,7 +215,8 @@ class TestChunkExtendAndSpeculative:
         # 9 tokens at gamma=4: rounds of 4+1 -> ceil sizing, <= 3 rounds.
         assert stats["rounds"] <= 3
 
-    def test_speculative_sampling_valid(self):
+    @pytest.mark.parametrize("batch", [1, 3])
+    def test_speculative_sampling_valid(self, batch):
         from horovod_tpu.models import transformer_speculative_generate
 
         cfg = _cfg()
@@ -223,24 +224,45 @@ class TestChunkExtendAndSpeculative:
                          n_layers=1)
         params = transformer_init(jax.random.PRNGKey(0), cfg)
         draft = transformer_init(jax.random.PRNGKey(7), draft_cfg)
-        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 4), 0, 64)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, 4),
+                                    0, 64)
         toks, stats = transformer_speculative_generate(
             params, cfg, draft, draft_cfg, prompt, 8, gamma=3,
             temperature=0.8, rng=jax.random.PRNGKey(3))
         arr = np.asarray(toks)
-        assert arr.shape == (1, 8)
+        assert arr.shape == (batch, 8)
         assert ((arr >= 0) & (arr < 64)).all()
+
+    def test_speculative_batched_matches_plain(self):
+        # Min-acceptance batching: every row's output equals its own
+        # target-greedy chain even when rows accept different lengths.
+        from horovod_tpu.models import transformer_speculative_generate
+
+        cfg = _cfg(n_layers=2)
+        draft_cfg = _cfg(d_model=16, n_heads=2, d_head=8, d_ff=32,
+                         n_layers=1)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        draft = transformer_init(jax.random.PRNGKey(7), draft_cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (3, 5), 0, 64)
+        plain, _ = transformer_generate(params, cfg, prompt, 9)
+        spec, stats = transformer_speculative_generate(
+            params, cfg, draft, draft_cfg, prompt, 9, gamma=3)
+        np.testing.assert_array_equal(np.asarray(spec),
+                                      np.asarray(plain))
+        # Batched self-speculation: all rows agree -> min acceptance
+        # is full and every round lands gamma+1 tokens.
+        spec2, st2 = transformer_speculative_generate(
+            params, cfg, params, cfg, prompt, 9, gamma=4)
+        np.testing.assert_array_equal(np.asarray(spec2),
+                                      np.asarray(plain))
+        assert st2["accept_rate"] == 1.0
 
     def test_speculative_rejects_bad_configs(self):
         from horovod_tpu.models import transformer_speculative_generate
 
         cfg = _cfg()
         params = transformer_init(jax.random.PRNGKey(0), cfg)
-        prompt2 = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 64)
-        with pytest.raises(ValueError, match="batch 1"):
-            transformer_speculative_generate(
-                params, cfg, params, cfg, prompt2, 4)
-        prompt = prompt2[:1]
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 4), 0, 64)
         wcfg = _cfg(attn_window=8)
         with pytest.raises(ValueError, match="attn_window"):
             transformer_speculative_generate(
