@@ -36,6 +36,7 @@ def main():
     p.add_argument("--new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--beam", type=int, default=0,
                    help="beam width (0 = greedy/sampling path)")
     p.add_argument("--spec-gamma", type=int, default=0,
@@ -56,22 +57,23 @@ def main():
     prompt = jax.random.randint(jax.random.PRNGKey(1),
                                 (args.batch, args.prompt_len), 0, cfg.vocab_size)
 
-    if args.top_p < 1.0 and not args.temperature:
+    if (args.top_p < 1.0 or args.top_k) and not args.temperature:
         raise SystemExit(
-            "--top-p needs --temperature > 0 (greedy decoding ignores "
-            "the nucleus)")
-    if args.beam and (args.temperature or args.top_p < 1.0):
+            "--top-p/--top-k need --temperature > 0 (greedy decoding "
+            "ignores them)")
+    if args.beam and (args.temperature or args.top_p < 1.0 or args.top_k):
         raise SystemExit(
-            "--beam is deterministic; drop --temperature/--top-p")
+            "--beam is deterministic; drop --temperature/--top-p/--top-k")
     rng = jax.random.PRNGKey(2) if args.temperature else None
     t0 = time.perf_counter()
     if args.spec_gamma:
         if args.beam:
             raise SystemExit("--spec-gamma and --beam are exclusive")
-        if args.top_p < 1.0:
+        if args.top_p < 1.0 or args.top_k:
             raise SystemExit(
-                "--top-p is not supported with --spec-gamma (the "
-                "speculative accept rule samples the full distribution)")
+                "--top-p/--top-k are not supported with --spec-gamma "
+                "(the speculative accept rule samples the full "
+                "distribution)")
         if args.attn_window:
             raise SystemExit(
                 "--attn-window is not supported with --spec-gamma "
@@ -104,7 +106,8 @@ def main():
     else:
         out, cache = transformer_generate(
             params, cfg, prompt, args.new_tokens,
-            temperature=args.temperature, top_p=args.top_p, rng=rng)
+            temperature=args.temperature, top_p=args.top_p,
+            top_k=args.top_k, rng=rng)
         out.block_until_ready()
         dt = time.perf_counter() - t0
         n = args.batch * args.new_tokens

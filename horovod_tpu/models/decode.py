@@ -670,6 +670,7 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
                          max_new_tokens: int,
                          temperature: float = 0.0,
                          top_p: float = 1.0,
+                         top_k: int = 0,
                          rng: Optional[jax.Array] = None,
                          max_len: Optional[int] = None,
                          quantize=None) -> Tuple[jax.Array, Dict]:
@@ -678,9 +679,12 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
     Greedy when temperature == 0 (default), else softmax sampling at
     the given temperature (requires `rng`); `top_p < 1` restricts
     sampling to the smallest set of tokens whose cumulative probability
-    reaches top_p (nucleus sampling).  Returns (tokens
-    [B, max_new_tokens], final cache).  Prefill is one batched forward;
-    generation is one `lax.scan` — two compiled programs total.
+    reaches top_p (nucleus sampling); `top_k > 0` restricts it to the k
+    highest-probability tokens.  Both may be combined (top-k cut first,
+    then the nucleus within it — the usual composition).  Returns
+    (tokens [B, max_new_tokens], final cache).  Prefill is one batched
+    forward; generation is one `lax.scan` — two compiled programs
+    total.
 
     `max_len` defaults to T0 + max_new_tokens; with `cfg.attn_window`
     it may be as small as max(window, T0) — the ring rolls."""
@@ -692,10 +696,13 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
         raise ValueError("sampling (temperature > 0) needs rng")
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if top_p < 1.0 and not temperature:
+    if top_k < 0 or top_k > cfg.vocab_size:
         raise ValueError(
-            "top_p < 1 needs temperature > 0 (greedy decoding ignores "
-            "the nucleus)")
+            f"top_k must be in [0, vocab_size], got {top_k}")
+    if (top_p < 1.0 or top_k) and not temperature:
+        raise ValueError(
+            "top_p < 1 / top_k > 0 need temperature > 0 (greedy "
+            "decoding ignores them)")
     cache = init_decode_cache(cfg, B, max_len, quantize=quantize)
     last_logits, cache = transformer_prefill(params, cache, prompt, cfg)
 
@@ -703,17 +710,26 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
         if not temperature:
             return jnp.argmax(logits, axis=-1)
         logits = logits / temperature
-        if top_p < 1.0:
-            # Nucleus: sample IN SORTED SPACE (mask the tail ranks,
+        if top_p < 1.0 or top_k:
+            # Truncated sampling IN SORTED SPACE (mask the tail ranks,
             # draw a rank, map back through sort_idx) — same
             # distribution as masking in vocab order, without paying a
             # per-token O(B*V) scatter inside the generation scan.
             sort_idx = jnp.argsort(-logits, axis=-1)
             sorted_logits = jnp.take_along_axis(logits, sort_idx, -1)
+            if top_k:
+                # Top-k cut FIRST; the nucleus then applies to the
+                # RENORMALIZED top-k distribution (softmax over the
+                # surviving ranks) — the HF warper-chain composition
+                # the docstring promises.
+                sorted_logits = jnp.where(
+                    jnp.arange(logits.shape[-1]) < top_k,
+                    sorted_logits, -jnp.inf)
             probs = jax.nn.softmax(sorted_logits, axis=-1)
             cum = jnp.cumsum(probs, axis=-1)
             # keep ranks where the cumulative mass BEFORE them < top_p
-            # (rank 0 always kept — no all-masked row exists)
+            # (rank 0 always kept — no all-masked row exists; ranks cut
+            # by top-k carry -inf logits and stay cut regardless)
             keep_sorted = (cum - probs) < top_p
             masked = jnp.where(keep_sorted, sorted_logits, -jnp.inf)
             rank = jax.random.categorical(key, masked)

@@ -467,6 +467,59 @@ class TestTopP:
         np.testing.assert_array_equal(np.asarray(nucleus),
                                       np.asarray(greedy))
 
+    def test_top_k_one_is_greedy(self):
+        # top_k=1 keeps only the argmax token: sampling at any
+        # temperature reproduces the greedy chain exactly.
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 3), 0, 64)
+        greedy, _ = transformer_generate(params, cfg, prompt, 5)
+        topk, _ = transformer_generate(params, cfg, prompt, 5,
+                                       temperature=2.0, top_k=1,
+                                       rng=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(topk),
+                                      np.asarray(greedy))
+
+    def test_top_k_validation(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 2), jnp.int32)
+        with pytest.raises(ValueError, match="top_k"):
+            transformer_generate(params, cfg, prompt, 2, temperature=1.0,
+                                 top_k=-1, rng=jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="top_k"):
+            transformer_generate(params, cfg, prompt, 2, temperature=1.0,
+                                 top_k=10_000, rng=jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="temperature"):
+            transformer_generate(params, cfg, prompt, 2, top_k=4)
+
+    def test_top_k_tokens_stay_in_top_k(self):
+        # Every sampled token must be within the top-k of the model's
+        # own distribution at its position (teacher-forced check).
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 3), 0, 64)
+        out, _ = transformer_generate(params, cfg, prompt, 8,
+                                      temperature=3.0, top_k=2,
+                                      rng=jax.random.PRNGKey(11))
+        seq = jnp.concatenate([prompt, out], axis=1)
+        logits, _ = transformer_ref_apply(params, seq, cfg)
+        for i in range(8):
+            pos = prompt.shape[1] - 1 + i
+            top2 = np.argsort(-np.asarray(logits[0, pos]))[:2]
+            assert int(out[0, i]) in top2, (i, int(out[0, i]), top2)
+
+    def test_top_k_with_top_p_runs(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.zeros((1, 2), jnp.int32)
+        out, _ = transformer_generate(params, cfg, prompt, 4,
+                                      temperature=1.0, top_p=0.9,
+                                      top_k=8, rng=jax.random.PRNGKey(3))
+        arr = np.asarray(out)
+        assert arr.shape == (1, 4)
+        assert ((arr >= 0) & (arr < 64)).all()
+
     def test_top_p_sampling_runs(self):
         cfg = _cfg()
         params = transformer_init(jax.random.PRNGKey(0), cfg)
