@@ -671,6 +671,7 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
                          temperature: float = 0.0,
                          top_p: float = 1.0,
                          top_k: int = 0,
+                         eos_id: Optional[int] = None,
                          rng: Optional[jax.Array] = None,
                          max_len: Optional[int] = None,
                          quantize=None) -> Tuple[jax.Array, Dict]:
@@ -685,6 +686,12 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
     (tokens [B, max_new_tokens], final cache).  Prefill is one batched
     forward; generation is one `lax.scan` — two compiled programs
     total.
+
+    `eos_id`: rows that emit this token stop — every position strictly
+    after a row's first eos is reported as `eos_id` (padding).  The
+    scan still runs max_new_tokens steps (static shapes; the tail
+    compute is discarded, not skipped — XLA has no data-dependent
+    early exit).
 
     `max_len` defaults to T0 + max_new_tokens; with `cfg.attn_window`
     it may be as small as max(window, T0) — the ring rolls."""
@@ -703,6 +710,9 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
         raise ValueError(
             "top_p < 1 / top_k > 0 need temperature > 0 (greedy "
             "decoding ignores them)")
+    if eos_id is not None and not 0 <= int(eos_id) < cfg.vocab_size:
+        raise ValueError(
+            f"eos_id {eos_id} outside vocab [0, {cfg.vocab_size})")
     cache = init_decode_cache(cfg, B, max_len, quantize=quantize)
     last_logits, cache = transformer_prefill(params, cache, prompt, cfg)
 
@@ -747,7 +757,14 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
         return (cache, logits), tok
 
     (cache, _), toks = lax.scan(gen_step, (cache, last_logits), keys)
-    return toks.T, cache                                  # [B, max_new]
+    toks = toks.T                                         # [B, max_new]
+    if eos_id is not None:
+        hit = toks == eos_id
+        # Strictly after each row's FIRST eos: the cumulative count
+        # BEFORE the position is already positive.
+        after = (jnp.cumsum(hit, axis=1) - hit.astype(jnp.int32)) > 0
+        toks = jnp.where(after, jnp.asarray(eos_id, toks.dtype), toks)
+    return toks, cache
 
 
 def make_decode_step(mesh, cfg: TransformerConfig, quantize=None):

@@ -467,6 +467,38 @@ class TestTopP:
         np.testing.assert_array_equal(np.asarray(nucleus),
                                       np.asarray(greedy))
 
+    def test_eos_pads_tail(self):
+        # Force a guaranteed eos hit: eos_id = the greedy chain's own
+        # second token; everything strictly after its first occurrence
+        # must read eos_id, positions up to and including it unchanged.
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 3), 0, 64)
+        plain, _ = transformer_generate(params, cfg, prompt, 8)
+        eos = int(plain[0, 1])
+        stopped, _ = transformer_generate(params, cfg, prompt, 8,
+                                          eos_id=eos)
+        got = np.asarray(stopped[0])
+        ref = np.asarray(plain[0])
+        first = int(np.argmax(ref == eos))
+        np.testing.assert_array_equal(got[: first + 1], ref[: first + 1])
+        assert (got[first + 1:] == eos).all()
+
+    def test_eos_absent_is_noop_and_validated(self):
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 3), 0, 64)
+        plain, _ = transformer_generate(params, cfg, prompt, 6)
+        # Pick an id the greedy chain never emits.
+        unused = next(v for v in range(64)
+                      if v not in np.asarray(plain).ravel())
+        same, _ = transformer_generate(params, cfg, prompt, 6,
+                                       eos_id=unused)
+        np.testing.assert_array_equal(np.asarray(same),
+                                      np.asarray(plain))
+        with pytest.raises(ValueError, match="eos_id"):
+            transformer_generate(params, cfg, prompt, 2, eos_id=999)
+
     def test_top_k_one_is_greedy(self):
         # top_k=1 keeps only the argmax token: sampling at any
         # temperature reproduces the greedy chain exactly.
