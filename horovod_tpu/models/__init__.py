@@ -37,6 +37,7 @@ from .transformer import (  # noqa: F401
     transformer_ref_loss,
 )
 from .decode import (  # noqa: F401
+    ShardedDecode,
     init_decode_cache,
     make_decode_step,
     transformer_beam_search,

@@ -39,7 +39,7 @@ Prefill is ONE batched forward through the training attention path
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -767,9 +767,23 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
     return toks, cache
 
 
+class ShardedDecode(NamedTuple):
+    """Sharded inference bundle from `make_decode_step`.  Unpacks as
+    (step, prefill, shard_params, shard_cache, shard_tokens, extend);
+    `extend` is the chunked multi-token forward (the speculative verify
+    pass), sharded identically to `step`."""
+
+    step: Any
+    prefill: Any
+    shard_params: Any
+    shard_cache: Any
+    shard_tokens: Any
+    extend: Any
+
+
 def make_decode_step(mesh, cfg: TransformerConfig, quantize=None):
-    """Sharded inference: build (decode_step, prefill, shard_params,
-    shard_cache, shard_tokens) over a dp x tp mesh.
+    """Sharded inference: build a `ShardedDecode` bundle (decode step,
+    prefill, chunked extend, sharding helpers) over a dp x tp mesh.
 
     - batch shards over `dp`; attention heads and the KV cache's head
       axis shard over `tp` (n_heads % tp == 0 and kv_heads % tp == 0 —
@@ -825,6 +839,11 @@ def make_decode_step(mesh, cfg: TransformerConfig, quantize=None):
         mesh=mesh,
         in_specs=(pspecs, cache_spec, P(dp, None)),
         out_specs=(logits_spec, cache_spec), check_vma=False))
+    extend = jax.jit(shard_map(
+        lambda p, c, t: transformer_extend(p, c, t, cfg, tp_axis),
+        mesh=mesh,
+        in_specs=(pspecs, cache_spec, P(dp, None)),
+        out_specs=(P(dp, None, None), cache_spec), check_vma=False))
 
     def shard_params(params):
         return jax.tree_util.tree_map(
@@ -839,7 +858,8 @@ def make_decode_step(mesh, cfg: TransformerConfig, quantize=None):
     def shard_tokens(tokens):
         return jax.device_put(tokens, NamedSharding(mesh, tok_spec))
 
-    return step, prefill, shard_params, shard_cache, shard_tokens
+    return ShardedDecode(step, prefill, shard_params, shard_cache,
+                         shard_tokens, extend)
 
 
 def transformer_beam_search(params: Dict, cfg: TransformerConfig,
@@ -938,4 +958,5 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
 __all__ = ["init_decode_cache", "transformer_decode_step",
            "transformer_prefill", "transformer_extend",
            "transformer_generate", "transformer_speculative_generate",
-           "transformer_beam_search", "make_decode_step"]
+           "transformer_beam_search", "make_decode_step",
+           "ShardedDecode"]

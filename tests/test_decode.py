@@ -417,7 +417,7 @@ class TestShardedDecode:
         ref_lg, ref_cache = transformer_prefill(params, ref_cache,
                                                 toks, cfg)
 
-        step, prefill, shard_params, shard_cache, shard_tokens = \
+        step, prefill, shard_params, shard_cache, shard_tokens, _ = \
             make_decode_step(mesh, cfg)
         sp = shard_params(params)
         sc = shard_cache(init_decode_cache(cfg, 2, 10))
@@ -433,6 +433,32 @@ class TestShardedDecode:
                                        np.asarray(ref_lg),
                                        atol=3e-4, rtol=3e-4)
             nxt = jnp.argmax(lg, axis=-1)
+
+    def test_sharded_extend_matches_single_device(self):
+        # The speculative verify pass at dp2 x tp2: chunked extend over
+        # the sharded cache equals the single-device chunk.
+        from horovod_tpu.models import make_decode_step, transformer_extend
+
+        cfg = _cfg(n_kv_heads=2)
+        mesh = self._mesh(dp=2, tp=2)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 64)
+        chunk = jax.random.randint(jax.random.PRNGKey(2), (2, 3), 0, 64)
+
+        ref_cache = init_decode_cache(cfg, 2, 10)
+        _, ref_cache = transformer_prefill(params, ref_cache, toks, cfg)
+        ref_lg, ref_cache = transformer_extend(params, ref_cache,
+                                               chunk, cfg)
+
+        bundle = make_decode_step(mesh, cfg)
+        sp = bundle.shard_params(params)
+        sc = bundle.shard_cache(init_decode_cache(cfg, 2, 10))
+        _, sc = bundle.prefill(sp, sc, toks)
+        lg, sc = bundle.extend(sp, sc, chunk)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(ref_lg),
+                                   atol=3e-4, rtol=3e-4)
+        assert int(jax.device_get(sc["pos"])) == \
+            int(ref_cache["pos"]) == 7
 
     def test_unsupported_axes_raise(self):
         from horovod_tpu.models import make_decode_step
@@ -692,7 +718,7 @@ class TestQuantizedCache:
         from horovod_tpu.models import transformer_prefill
         ref_lg, ref_cache = transformer_prefill(params, ref_cache,
                                                 toks, cfg)
-        step, prefill, shard_params, shard_cache, shard_tokens = \
+        step, prefill, shard_params, shard_cache, shard_tokens, _ = \
             make_decode_step(mesh, cfg, quantize="int8")
         sp = shard_params(params)
         sc = shard_cache(init_decode_cache(cfg, 2, 8, quantize="int8"))
@@ -738,7 +764,7 @@ def test_sharded_fp8_cache_builds_and_steps():
     mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
     params = transformer_init(jax.random.PRNGKey(0), cfg)
     toks = jax.random.randint(jax.random.PRNGKey(1), (2, 4), 0, 64)
-    step, prefill, shard_params, shard_cache, shard_tokens = \
+    step, prefill, shard_params, shard_cache, shard_tokens, _ = \
         make_decode_step(mesh, cfg, quantize="fp8_e4m3")
     sp = shard_params(params)
     sc = shard_cache(init_decode_cache(cfg, 2, 6, quantize="fp8_e4m3"))
