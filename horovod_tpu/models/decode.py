@@ -494,13 +494,11 @@ def transformer_speculative_generate(
                     break
                 p = _softmax_np(tdists[i] / temperature)
                 q = _softmax_np(qlogits[i, b] / temperature)
-                if rng_np.uniform() < min(
-                        1.0, float(p[d_i]) / max(float(q[d_i]), 1e-20)):
+                ok, tok = _spec_accept(d_i, p, q, rng_np)
+                if ok:
                     per_acc[b] += 1
                     continue
-                resid = np.maximum(p - q, 0.0)
-                resid = resid / max(resid.sum(), 1e-20)
-                per_extra[b] = int(rng_np.choice(len(resid), p=resid))
+                per_extra[b] = tok
                 break
         # Min-acceptance: all rows advance n_acc + 1 tokens.  A row
         # that accepted beyond n_acc takes its OWN verified draft at
@@ -545,6 +543,21 @@ def transformer_speculative_generate(
 def _softmax_np(x):
     e = np.exp(x - np.max(x))
     return e / e.sum()
+
+
+def _spec_accept(d_tok: int, p, q, rng_np):
+    """One speculative accept/resample decision (Leviathan et al.):
+    accept draft token `d_tok` (drawn from q) with probability
+    min(1, p[d]/q[d]); otherwise resample from norm(max(p - q, 0)).
+    The emitted token is distributed EXACTLY per p — the identity the
+    whole scheme rests on, property-tested in isolation
+    (tests/test_decode.py::test_accept_rule_preserves_target_dist)."""
+    if rng_np.uniform() < min(1.0, float(p[d_tok])
+                              / max(float(q[d_tok]), 1e-20)):
+        return True, int(d_tok)
+    resid = np.maximum(p - q, 0.0)
+    resid = resid / max(resid.sum(), 1e-20)
+    return False, int(rng_np.choice(len(resid), p=resid))
 
 
 @functools.lru_cache(maxsize=None)
