@@ -879,17 +879,26 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
                             prompt, max_new_tokens: int,
                             beam_width: int = 4,
                             length_penalty: float = 0.0,
+                            eos_id: Optional[int] = None,
                             max_len: Optional[int] = None,
                             quantize=None):
     """Beam search over the KV-cache decode path.
 
     prompt [B, T0] -> (tokens [B, W, max_new], scores [B, W]) sorted
-    best-first; scores are sums of chosen-token logprobs.  All beams
-    decode the full max_new_tokens (no EOS truncation), so lengths are
-    equal and `length_penalty` only NORMALIZES the reported scores
-    (score / len**penalty, the GNMT formula) — it cannot re-rank
-    equal-length beams and exists for score comparability across runs
-    of different lengths.
+    best-first; scores are sums of chosen-token logprobs.
+
+    Without `eos_id`, all beams decode the full max_new_tokens and
+    `length_penalty` only NORMALIZES the reported scores
+    (score / max_new**penalty, the GNMT formula).  With `eos_id`, a
+    beam that emits it is FINISHED: it keeps its score (subsequent
+    forced-eos continuations add logprob 0) and its reported tail reads
+    eos_id; `length_penalty` then normalizes the W SURVIVORS by their
+    ACTUAL lengths (first-eos position + 1) and re-sorts.  Caveat:
+    during the search itself beams compete on RAW scores — a short
+    finished hypothesis whose raw sum falls below W live continuations
+    is evicted before the final re-rank (no separate finished pool, the
+    in-scan tradeoff; HF-style finished-pool semantics would need
+    2W-candidate bookkeeping).
 
     The cache carries B*W rows (beam-major within batch); each step
     selects the top-W of the W*V continuations per batch and GATHERS
@@ -903,6 +912,8 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
     V = cfg.vocab_size
+    if eos_id is not None and not 0 <= int(eos_id) < V:
+        raise ValueError(f"eos_id {eos_id} outside vocab [0, {V})")
     max_len = _resolve_max_len(cfg, T0, max_new_tokens, max_len)
 
     # Prefill ONCE per sequence, then tile each cache row W times
@@ -921,11 +932,19 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
     seed_lp, seed_tok = jax.lax.top_k(logp, W)              # [B, W]
     scores = seed_lp.reshape(B * W)
     tok = seed_tok.reshape(B * W)
+    done = (tok == eos_id) if eos_id is not None else \
+        jnp.zeros((B * W,), bool)
+    if eos_id is not None:
+        # A finished beam's only continuation is eos at logprob 0: its
+        # score freezes and the tail reads eos.
+        frozen_lp = jnp.full((V,), -1e30).at[int(eos_id)].set(0.0)
 
     def gen_step(carry, _):
-        cache, scores, tok = carry
+        cache, scores, tok, done = carry
         logits, cache = transformer_decode_step(params, cache, tok, cfg)
         logp = jax.nn.log_softmax(logits, axis=-1)          # [B*W, V]
+        if eos_id is not None:
+            logp = jnp.where(done[:, None], frozen_lp[None, :], logp)
         cand = scores[:, None] + logp                       # [B*W, V]
         cand = cand.reshape(B, W * V)
         new_scores, flat_idx = jax.lax.top_k(cand, W)       # [B, W]
@@ -937,12 +956,16 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
             lambda a: a[:, rows], t)
         cache = {"k": gather(cache["k"]), "v": gather(cache["v"]),
                  "pos": cache["pos"]}
+        new_tok_flat = new_tok.reshape(B * W)
+        new_done = done[rows]
+        if eos_id is not None:
+            new_done = new_done | (new_tok_flat == eos_id)
         return ((cache, new_scores.reshape(B * W),
-                 new_tok.reshape(B * W)),
-                (new_tok.reshape(B * W), rows))
+                 new_tok_flat, new_done),
+                (new_tok_flat, rows))
 
-    (cache, scores, tok), (toks, parents) = lax.scan(
-        gen_step, (cache, scores, tok), None,
+    (cache, scores, tok, done), (toks, parents) = lax.scan(
+        gen_step, (cache, scores, tok, done), None,
         length=max_new_tokens - 1)
 
     # Reconstruct each surviving beam's token path by walking the
@@ -960,11 +983,26 @@ def transformer_beam_search(params: Dict, cfg: TransformerConfig,
     out = jnp.asarray(paths.T).reshape(B, W, max_new_tokens)
     scores = scores.reshape(B, W)
     if length_penalty:
-        # Equal-length beams: a pure normalization of the reported
-        # scores (see docstring) — ranking is unchanged.
-        scores = scores / (float(max_new_tokens) ** length_penalty)
-    # Already sorted best-first: lax.top_k emits descending scores and
-    # the normalization above is order-preserving.
+        if eos_id is not None:
+            # Actual lengths: first eos + 1 (max_new when no eos) —
+            # the penalty genuinely re-ranks unequal-length beams.
+            out_np = np.asarray(out)
+            hit = out_np == int(eos_id)
+            lengths = np.where(hit.any(axis=-1),
+                               hit.argmax(axis=-1) + 1,
+                               max_new_tokens).astype(np.float64)
+            scores = scores / jnp.asarray(lengths ** length_penalty,
+                                          scores.dtype)
+            order = jnp.argsort(-scores, axis=-1)
+            scores = jnp.take_along_axis(scores, order, -1)
+            out = jnp.take_along_axis(out, order[..., None], 1)
+        else:
+            # Equal-length beams: a pure normalization of the reported
+            # scores (see docstring) — ranking is unchanged.
+            scores = scores / (float(max_new_tokens) ** length_penalty)
+    # Sorted best-first: lax.top_k emits descending scores; the
+    # equal-length normalization is order-preserving, and the
+    # eos-length path re-sorts explicitly above.
     return out, scores
 
 

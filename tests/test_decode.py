@@ -632,6 +632,87 @@ class TestBeamSearch:
         np.testing.assert_array_equal(np.asarray(beams[:, 0]),
                                       np.asarray(greedy))
 
+    def test_eos_freezes_beam_score_and_tail(self):
+        # Pick eos = a token inside the plain best beam: with eos_id
+        # set, that beam's tail after its first eos must read eos and
+        # its score must equal the teacher-forced logprob sum up to and
+        # INCLUDING the first eos (forced continuations add 0).
+        from horovod_tpu.models import transformer_beam_search
+
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 64)
+        N, W = 6, 3
+        plain, _ = transformer_beam_search(params, cfg, prompt, N,
+                                           beam_width=W)
+        eos = int(plain[0, 0, 2])
+        beams, scores = transformer_beam_search(params, cfg, prompt, N,
+                                                beam_width=W,
+                                                eos_id=eos)
+        arr = np.asarray(beams)
+        # Non-vacuity: the chosen eos must actually appear somewhere.
+        assert any(eos in arr[0, b] for b in range(W)), arr
+        for b in range(W):
+            row = arr[0, b]
+            if eos in row:
+                first = int(np.argmax(row == eos))
+                assert (row[first:] == eos).all(), (b, row)
+                # Teacher-forced score of the truncated chain.
+                seq = jnp.concatenate(
+                    [prompt, jnp.asarray(row[: first + 1])[None]],
+                    axis=1)
+                logits, _ = transformer_ref_apply(params, seq, cfg)
+                lp = jax.nn.log_softmax(logits, axis=-1)
+                picked = jnp.take_along_axis(
+                    lp[:, 3:-1], seq[:, 4:, None].astype(jnp.int32),
+                    -1)[..., 0]
+                np.testing.assert_allclose(
+                    float(scores[0, b]), float(picked.sum()),
+                    rtol=2e-4, atol=2e-4)
+
+    def test_eos_length_penalty_uses_actual_lengths(self):
+        # Reported scores must equal the teacher-forced raw chain
+        # logprob (to first eos) divided by the ACTUAL length —
+        # a uniform max_new normalization fails this whenever any
+        # beam finished early.
+        from horovod_tpu.models import transformer_beam_search
+
+        cfg = _cfg()
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, 64)
+        N, W = 6, 3
+        plain, _ = transformer_beam_search(params, cfg, prompt, N,
+                                           beam_width=W)
+        eos = int(plain[0, 0, 2])
+        beams, scores = transformer_beam_search(
+            params, cfg, prompt, N, beam_width=W, eos_id=eos,
+            length_penalty=1.0)
+        arr = np.asarray(beams)
+        lengths = []
+        for b in range(W):
+            row = arr[0, b]
+            first = (int(np.argmax(row == eos)) if eos in row else N - 1)
+            length = first + 1
+            lengths.append(length)
+            seq = jnp.concatenate(
+                [prompt, jnp.asarray(row[: length])[None]], axis=1)
+            logits, _ = transformer_ref_apply(params, seq, cfg)
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            raw = float(jnp.take_along_axis(
+                lp[:, 3:-1], seq[:, 4:, None].astype(jnp.int32),
+                -1)[..., 0].sum())
+            np.testing.assert_allclose(float(scores[0, b]),
+                                       raw / length,
+                                       rtol=3e-4, atol=3e-4)
+        # Non-vacuity: at least one beam must have finished early.
+        assert min(lengths) < N, lengths
+        # Output stays sorted best-first after the re-sort.
+        s = np.asarray(scores[0])
+        assert (np.diff(s) <= 1e-6).all(), s
+        with pytest.raises(ValueError, match="eos_id"):
+            transformer_beam_search(params, cfg, prompt, 4,
+                                    beam_width=2, eos_id=999)
+
     def test_scores_are_true_chain_logprobs(self):
         # Each returned beam's score must equal the sum of the chosen
         # tokens' logprobs under teacher forcing of that beam.
