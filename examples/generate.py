@@ -37,6 +37,9 @@ def main():
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--eos-id", type=int, default=-1,
+                   help="stop token: tails after the first eos read "
+                        "eos (generate + beam; -1 = off)")
     p.add_argument("--beam", type=int, default=0,
                    help="beam width (0 = greedy/sampling path)")
     p.add_argument("--spec-gamma", type=int, default=0,
@@ -65,8 +68,11 @@ def main():
         raise SystemExit(
             "--beam is deterministic; drop --temperature/--top-p/--top-k")
     rng = jax.random.PRNGKey(2) if args.temperature else None
+    eos = args.eos_id if args.eos_id >= 0 else None
     t0 = time.perf_counter()
     if args.spec_gamma:
+        if eos is not None:
+            raise SystemExit("--eos-id is not supported with --spec-gamma")
         if args.beam:
             raise SystemExit("--spec-gamma and --beam are exclusive")
         if args.top_p < 1.0 or args.top_k:
@@ -96,7 +102,8 @@ def main():
         return
     if args.beam:
         out, scores = transformer_beam_search(
-            params, cfg, prompt, args.new_tokens, beam_width=args.beam)
+            params, cfg, prompt, args.new_tokens, beam_width=args.beam,
+            eos_id=eos)
         out.block_until_ready()
         dt = time.perf_counter() - t0
         n = args.batch * args.new_tokens * args.beam
@@ -107,7 +114,7 @@ def main():
         out, cache = transformer_generate(
             params, cfg, prompt, args.new_tokens,
             temperature=args.temperature, top_p=args.top_p,
-            top_k=args.top_k, rng=rng)
+            top_k=args.top_k, eos_id=eos, rng=rng)
         out.block_until_ready()
         dt = time.perf_counter() - t0
         n = args.batch * args.new_tokens
