@@ -87,6 +87,9 @@ def load(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_char_p, ctypes.c_double, ctypes.c_double,
             ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p]
+        if hasattr(lib, "hvdtpu_tl_event2"):  # older prebuilt .so lacks it
+            lib.hvdtpu_tl_event2.argtypes = (
+                lib.hvdtpu_tl_event.argtypes + [ctypes.c_char_p])
         lib.hvdtpu_tl_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib

@@ -64,7 +64,14 @@ class NativeTimelineWriter:
 
     def event(self, name: str, cat: str, ph: str, ts_us: float,
               dur_us: float = -1.0, pid: int = 0, tid: str = "",
-              scope: str = "", args_json: str = "") -> None:
+              scope: str = "", args_json: str = "",
+              extra_json: str = "") -> None:
+        if extra_json and hasattr(self._libref, "hvdtpu_tl_event2"):
+            self._libref.hvdtpu_tl_event2(
+                self._handle, name.encode(), cat.encode(), ph.encode(),
+                float(ts_us), float(dur_us), pid, tid.encode(),
+                scope.encode(), args_json.encode(), extra_json.encode())
+            return
         self._libref.hvdtpu_tl_event(
             self._handle, name.encode(), cat.encode(), ph.encode(),
             float(ts_us), float(dur_us), pid, tid.encode(), scope.encode(),

@@ -692,7 +692,7 @@ class TimelineWriter {
   // "p" for instant events, args_json pre-serialized or "".
   void event(const char* name, const char* cat, const char* ph, double ts_us,
              double dur_us, int pid, const char* tid, const char* scope,
-             const char* args_json) {
+             const char* args_json, const char* extra_json = nullptr) {
     if (!f_) return;
     std::string rec = "{\"name\":\"" + json_escape(name) + "\",\"cat\":\"" +
                       json_escape(cat) + "\",\"ph\":\"" + json_escape(ph) +
@@ -710,6 +710,11 @@ class TimelineWriter {
     if (scope && scope[0]) rec += std::string(",\"s\":\"") + scope + "\"";
     if (args_json && args_json[0])
       rec += std::string(",\"args\":") + args_json;
+    // Pre-serialized extra top-level fields ("id" for async/flow event
+    // pairing, etc.) — the fixed parameter list above can't grow per
+    // Chrome-trace extension, so unknown keys ride through verbatim.
+    if (extra_json && extra_json[0])
+      rec += std::string(",") + extra_json;
     rec += "}";
     std::lock_guard<std::mutex> g(mu_);
     // Separator-before-record keeps the file strict JSON (no trailing
@@ -815,6 +820,14 @@ void hvdtpu_tl_event(void* h, const char* name, const char* cat,
                      const char* args_json) {
   static_cast<TimelineWriter*>(h)->event(name, cat, ph, ts_us, dur_us, pid,
                                          tid, scope, args_json);
+}
+
+void hvdtpu_tl_event2(void* h, const char* name, const char* cat,
+                      const char* ph, double ts_us, double dur_us, int pid,
+                      const char* tid, const char* scope,
+                      const char* args_json, const char* extra_json) {
+  static_cast<TimelineWriter*>(h)->event(name, cat, ph, ts_us, dur_us, pid,
+                                         tid, scope, args_json, extra_json);
 }
 
 void hvdtpu_tl_close(void* h) {

@@ -238,6 +238,16 @@ def init(
         _stall_mod.init_from_env()
         _at_mod.init_from_env()
 
+        # Metrics exposition (HOROVOD_METRICS_PORT) + the fallback KV
+        # publisher for workers whose watchdog is disabled (the stall
+        # inspector publishes snapshots itself when running).
+        from ..metrics import exposition as _met_exp
+        from ..metrics import fleet as _met_fleet
+
+        _met_exp.init_from_env(_global_state.process_index,
+                               _global_state.num_processes)
+        _met_fleet.maybe_start_kv_publisher()
+
         logger.info(
             "horovod_tpu initialized: size=%d local_size=%d process=%d/%d "
             "platform=%s",
@@ -266,10 +276,15 @@ def shutdown() -> None:
         from ..utils import stall_inspector as _stall_mod
         from ..utils import timeline as _tl_mod
 
+        from ..metrics import exposition as _met_exp
+        from ..metrics import fleet as _met_fleet
+
         _coll.clear_caches()
         _tl_mod.stop_timeline()
         _stall_mod.shutdown_inspector()
         _at_mod.shutdown_manager()
+        _met_fleet.stop_kv_publisher()
+        _met_exp.stop_server()
         _global_state = None
         # Elastic multi-process mode must also drop the live backends:
         # jax.distributed.initialize refuses to run once backends exist,

@@ -1,0 +1,39 @@
+"""Cluster-wide numeric telemetry.
+
+The timeline (utils/timeline.py) and stall inspector are forensic tools;
+this package is the continuously-scrapable counterpart: a lock-cheap
+registry of counters/gauges/histograms, hot-path instrumentation of the
+collectives/elastic/training layers (see catalog.py for every series), a
+per-worker Prometheus endpoint (HOROVOD_METRICS_PORT), and a KV-merged
+fleet view (`python -m horovod_tpu.metrics`).
+
+Quick start::
+
+    HOROVOD_METRICS_PORT=9090 horovodrun_tpu -np 8 python train.py
+    curl :9090/metrics                    # per-worker scrape
+    python -m horovod_tpu.metrics         # merged cluster view (via KV)
+
+See docs/METRICS.md for the metric catalog and scrape config.
+"""
+
+from . import catalog  # noqa: F401  (declares every hvd_* series)
+from .exposition import (  # noqa: F401
+    render,
+    start_server,
+    stop_server,
+    server_port,
+)
+from .fleet import (  # noqa: F401
+    aggregate,
+    publish,
+    read_fleet,
+    render_fleet,
+    snapshot,
+)
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)

@@ -1,0 +1,94 @@
+"""The metric catalog: every `hvd_*` series this runtime emits.
+
+Single definition point so (a) instrumentation sites import handles
+instead of re-declaring names, and (b) `scripts/check_metrics_catalog.py`
+can lint code-vs-docs drift (docs/METRICS.md must document every metric
+declared here).
+
+Hot-path discipline: each handle below is a module-level attribute, so an
+instrumentation site pays one attribute load + one labels() dict lookup
+per event.  `enabled()` gates all of it (HOROVOD_METRICS_DISABLE=1).
+"""
+
+from __future__ import annotations
+
+from ..common import util
+from .registry import get_registry
+
+_REG = get_registry()
+
+# Labels shared by the per-collective series.  `process_set` is the set
+# id (0 = global), matching the reference's per-process-set controllers.
+COLLECTIVE_LABELS = ("kind", "dtype", "process_set")
+
+# -- ops hot path (ops/collectives.py `_traced` / `_cached_program`) --------
+collective_calls = _REG.counter(
+    "hvd_collective_calls_total",
+    "Eager collective dispatches, by collective kind/dtype/process set.",
+    COLLECTIVE_LABELS)
+collective_bytes = _REG.counter(
+    "hvd_collective_bytes_total",
+    "Global payload bytes entering eager collectives (the staged "
+    "global-mesh array, all ranks' shards).",
+    COLLECTIVE_LABELS)
+collective_latency = _REG.histogram(
+    "hvd_collective_latency_seconds",
+    "Host-side eager dispatch latency (bracket enter to exit; device "
+    "completion belongs to jax.profiler), log4 buckets 1us..67s.",
+    COLLECTIVE_LABELS)
+compile_cache_hits = _REG.counter(
+    "hvd_compile_cache_hits_total",
+    "Eager collective program-cache hits (reference: response cache).",
+    ("kind",))
+compile_cache_misses = _REG.counter(
+    "hvd_compile_cache_misses_total",
+    "Eager collective program-cache misses (trace+compile on this call).",
+    ("kind",))
+
+# -- training step layer (parallel/data_parallel.py, parallel/optimizer.py) -
+steps = _REG.counter(
+    "hvd_steps_total",
+    "Compiled data-parallel step invocations (hvd.data_parallel).")
+grad_bytes_reduced = _REG.counter(
+    "hvd_grad_bytes_reduced_total",
+    "Gradient bytes cross-rank reduced on the eager path "
+    "(allreduce_gradients outside jit).")
+grad_bytes_per_step = _REG.gauge(
+    "hvd_grad_bytes_per_step",
+    "Static gradient bytes per compiled step (recorded at trace time; "
+    "multiply by hvd_steps_total for in-jit traffic).")
+optimizer_syncs = _REG.counter(
+    "hvd_optimizer_syncs_total",
+    "DistributedOptimizer cross-rank gradient syncs executed eagerly.")
+
+# -- observability / control plane ------------------------------------------
+stall_warnings = _REG.counter(
+    "hvd_stall_warnings_total",
+    "Stall-inspector warnings issued (collectives past the warn "
+    "threshold).")
+stall_aborts = _REG.counter(
+    "hvd_stall_aborts_total",
+    "Stall-inspector aborts triggered (shutdown threshold exceeded).")
+
+# -- elastic driver (runner/elastic/driver.py) ------------------------------
+elastic_rank_added = _REG.counter(
+    "hvd_elastic_rank_added_total",
+    "Worker slots added across elastic generation transitions.")
+elastic_rank_removed = _REG.counter(
+    "hvd_elastic_rank_removed_total",
+    "Worker slots removed (failure/scale-down) across generations.")
+elastic_restarts = _REG.counter(
+    "hvd_elastic_restarts_total",
+    "Elastic generation resets (driver reset_count increments).")
+
+_enabled = not util.env_bool("METRICS_DISABLE", False)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(value: bool) -> None:
+    """Test/embedding hook; HOROVOD_METRICS_DISABLE=1 sets the default."""
+    global _enabled
+    _enabled = bool(value)

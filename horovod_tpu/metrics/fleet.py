@@ -1,0 +1,242 @@
+"""Fleet aggregation: per-rank snapshots over the rendezvous KV.
+
+Channel: the same control-plane KV `KvRankReporter` uses for stall
+heartbeats (utils/stall_inspector.py).  Each worker's watchdog publishes
+
+    metrics/rank/{rank} = JSON snapshot()
+
+and `python -m horovod_tpu.metrics` (or any rank) reads every key under
+`metrics/rank/` and merges them into one cluster view: counters and
+histograms sum across ranks, gauges stay per-rank (min/max/mean in the
+merged rendering).  The data plane never touches the KV — snapshots are
+small (one JSON object per rank) and published at watchdog cadence, not
+step cadence.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .registry import MetricsRegistry, get_registry
+
+logger = logging.getLogger("horovod_tpu.metrics")
+
+KV_PREFIX = "metrics/rank/"
+
+__all__ = ["snapshot", "publish", "read_fleet", "aggregate",
+           "render_fleet", "KV_PREFIX"]
+
+
+def snapshot(registry: Optional[MetricsRegistry] = None,
+             rank: Optional[int] = None) -> dict:
+    """JSON-able dump of every metric series in the registry."""
+    registry = registry or get_registry()
+    if rank is None:
+        try:
+            from ..common import basics
+            rank = basics.rank() if basics.is_initialized() else 0
+        except Exception:  # noqa: BLE001 — snapshots are best-effort
+            rank = 0
+    metrics: Dict[str, dict] = {}
+    for m in registry.collect():
+        samples = []
+        for values, child in m.samples():
+            if m.kind == "histogram":
+                samples.append([list(values), {
+                    "sum": child.sum, "count": child.count,
+                    "buckets": [[b, c] for b, c in child.cumulative()
+                                if b != float("inf")],
+                    "inf": child.cumulative()[-1][1],
+                }])
+            else:
+                samples.append([list(values), child.get()])
+        metrics[m.name] = {"kind": m.kind, "labelnames": list(m.labelnames),
+                           "samples": samples}
+    return {"rank": rank, "ts": time.time(), "metrics": metrics}
+
+
+def publish(client, rank: Optional[int] = None) -> None:
+    """Publish this process's snapshot to the KV (called from the stall
+    inspector's watchdog thread; never raises — the control plane may be
+    mid-restart)."""
+    try:
+        snap = snapshot(rank=rank)
+        client.put(f"{KV_PREFIX}{snap['rank']}",
+                   json.dumps(snap, separators=(",", ":")))
+    except Exception:  # noqa: BLE001
+        logger.debug("metrics KV publish failed", exc_info=True)
+
+
+def read_fleet(client) -> List[dict]:
+    """Every rank's latest snapshot from the KV, sorted by rank."""
+    snaps = []
+    for key in client.keys(KV_PREFIX):
+        raw = client.get(key)
+        if raw is None:
+            continue
+        try:
+            snaps.append(json.loads(raw))
+        except (ValueError, TypeError):
+            logger.warning("unparseable metrics snapshot at %s", key)
+    return sorted(snaps, key=lambda s: s.get("rank", 0))
+
+
+# ---------------------------------------------------------------------------
+# Merging
+# ---------------------------------------------------------------------------
+
+def aggregate(snaps: List[dict]) -> dict:
+    """Merge per-rank snapshots: counters/histograms sum, gauges keep
+    per-rank values.  Returns {name: {kind, labelnames, samples}} where a
+    counter/histogram sample is keyed by label values and a gauge sample
+    carries {rank: value}."""
+    out: Dict[str, dict] = {}
+    for snap in snaps:
+        rank = snap.get("rank", 0)
+        for name, m in snap.get("metrics", {}).items():
+            agg = out.setdefault(name, {
+                "kind": m["kind"], "labelnames": m["labelnames"],
+                "samples": {}})
+            for values, val in m["samples"]:
+                key = tuple(values)
+                if m["kind"] == "counter":
+                    agg["samples"][key] = agg["samples"].get(key, 0.0) + val
+                elif m["kind"] == "gauge":
+                    agg["samples"].setdefault(key, {})[rank] = val
+                else:  # histogram
+                    cur = agg["samples"].get(key)
+                    if cur is None:
+                        agg["samples"][key] = {
+                            "sum": val["sum"], "count": val["count"],
+                            "buckets": {b: c for b, c in val["buckets"]},
+                            "inf": val.get("inf", val["count"])}
+                    else:
+                        cur["sum"] += val["sum"]
+                        cur["count"] += val["count"]
+                        cur["inf"] += val.get("inf", val["count"])
+                        for b, c in val["buckets"]:
+                            cur["buckets"][b] = cur["buckets"].get(b, 0) + c
+    return out
+
+
+def _counter_total(agg: dict, name: str) -> float:
+    m = agg.get(name)
+    return sum(m["samples"].values()) if m else 0.0
+
+
+def _per_rank_counter(snaps: List[dict], name: str) -> Dict[int, float]:
+    out: Dict[int, float] = {}
+    for snap in snaps:
+        m = snap.get("metrics", {}).get(name)
+        if m:
+            out[snap.get("rank", 0)] = sum(v for _, v in m["samples"])
+    return out
+
+
+def render_fleet(snaps: List[dict]) -> str:
+    """Human-readable merged cluster view (the CLI's output)."""
+    if not snaps:
+        return "no metrics snapshots found (is any worker publishing?)\n"
+    agg = aggregate(snaps)
+    now = time.time()
+    lines = [f"fleet view: {len(snaps)} rank(s)", ""]
+
+    # Per-rank step progress + skew (the stall inspector's laggard story,
+    # but continuous instead of event-driven).
+    steps = _per_rank_counter(snaps, "hvd_steps_total")
+    lines.append("rank  steps  snapshot_age_s")
+    for snap in snaps:
+        r = snap.get("rank", 0)
+        age = now - float(snap.get("ts", now))
+        lines.append(f"{r:>4}  {int(steps.get(r, 0)):>5}  {age:>13.1f}")
+    if steps:
+        lines.append(f"step skew (max-min): "
+                     f"{int(max(steps.values()) - min(steps.values()))}")
+    lines.append("")
+
+    # Aggregate collective throughput.
+    calls = _counter_total(agg, "hvd_collective_calls_total")
+    nbytes = _counter_total(agg, "hvd_collective_bytes_total")
+    lat = agg.get("hvd_collective_latency_seconds")
+    lat_sum = (sum(s["sum"] for s in lat["samples"].values()) if lat else 0.0)
+    lines.append(f"collective calls: {int(calls)}   "
+                 f"bytes: {int(nbytes)}")
+    if lat_sum > 0:
+        lines.append(f"aggregate dispatch throughput: "
+                     f"{nbytes / lat_sum / 1e6:.1f} MB/s "
+                     f"(total dispatch time {lat_sum:.3f}s)")
+
+    # Compile-cache hit rate (the response-cache fast-path analog).
+    hits = _counter_total(agg, "hvd_compile_cache_hits_total")
+    misses = _counter_total(agg, "hvd_compile_cache_misses_total")
+    if hits + misses > 0:
+        lines.append(f"compile cache: {int(hits)} hits / "
+                     f"{int(misses)} misses "
+                     f"({100.0 * hits / (hits + misses):.1f}% hit rate)")
+
+    # Elastic / stall events, if any rank reported them.
+    for name, label in (("hvd_elastic_restarts_total", "elastic restarts"),
+                        ("hvd_stall_warnings_total", "stall warnings"),
+                        ("hvd_stall_aborts_total", "stall aborts")):
+        total = _counter_total(agg, name)
+        if total:
+            lines.append(f"{label}: {int(total)}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Standalone publisher (workers whose stall inspector is disabled — the
+# watchdog normally owns publishing; this thread is the fallback so the
+# fleet view stays complete either way).
+# ---------------------------------------------------------------------------
+
+_publisher_stop: Optional[threading.Event] = None
+_publisher_thread: Optional[threading.Thread] = None
+
+
+def maybe_start_kv_publisher(interval_s: Optional[float] = None) -> bool:
+    """Start the fallback publisher thread if (a) a rendezvous KV is
+    reachable, and (b) no stall-inspector watchdog is running (which
+    would otherwise publish for us).  Returns True when started."""
+    global _publisher_stop, _publisher_thread
+    import os
+
+    from ..common import util
+    from ..utils import stall_inspector as _stall
+
+    if _publisher_thread is not None:
+        return False
+    if "HOROVOD_RENDEZVOUS_ADDR" not in os.environ:
+        return False
+    if _stall.get_inspector() is not None:
+        return False  # the watchdog publishes snapshots itself
+    try:
+        from ..runner.elastic_worker import client_from_env
+        client = client_from_env()
+    except Exception:  # noqa: BLE001
+        return False
+    interval = (interval_s if interval_s is not None
+                else util.env_float("METRICS_KV_INTERVAL", 5.0))
+    stop = threading.Event()
+
+    def _run():
+        while not stop.wait(interval):
+            publish(client)
+
+    t = threading.Thread(target=_run, name="hvd-metrics-kv", daemon=True)
+    t.start()
+    _publisher_stop, _publisher_thread = stop, t
+    return True
+
+
+def stop_kv_publisher() -> None:
+    global _publisher_stop, _publisher_thread
+    if _publisher_stop is not None:
+        _publisher_stop.set()
+    if _publisher_thread is not None:
+        _publisher_thread.join(timeout=5)
+    _publisher_stop = _publisher_thread = None

@@ -38,6 +38,7 @@ one process).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -49,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..common import basics
 from ..common.basics import GLOBAL_AXIS, ProcessSet
 from ..common.exceptions import HorovodTpuError
+from ..metrics import catalog as _met
 from ..utils import consistency as _cc
 from ..utils import stall_inspector as _stall
 from ..utils import timeline as _tl
@@ -129,7 +131,8 @@ class _joinable:
 
 
 class _traced:
-    """Timeline + stall-inspector bracket around one eager collective.
+    """Timeline + stall-inspector + metrics bracket around one eager
+    collective.
 
     Reference analog: the per-tensor Timeline activities and the stall
     inspector's submitted-tensor table (timeline.cc / stall_inspector.cc).
@@ -141,17 +144,29 @@ class _traced:
     polls `is_ready()` and clears the entry itself, which is what lets it
     observe a collective hung on a dead peer.  The timeline event covers
     host-side dispatch only (device-side timing belongs to jax.profiler).
+
+    Metrics: on exit, the bracket records one call + the dispatch latency
+    into the registry (metrics/catalog.py), plus the global payload bytes
+    when the call site handed them over via `stat()`.  The update is O(1)
+    dict lookups and holds no lock across any device interaction; like
+    the timeline, nested brackets (barrier → inner allreduce) each count.
     """
 
-    __slots__ = ("_desc", "_si", "_key", "_tl", "_token", "_tracked")
+    __slots__ = ("_desc", "_si", "_key", "_tl", "_token", "_tracked",
+                 "_kind", "_t0", "_nbytes", "_dtype", "_ps")
 
     def __init__(self, kind: str, name: Optional[str]):
         self._desc = f"{kind}:{name}" if name else kind
+        self._kind = kind
         self._tl = _tl.get_timeline()
         self._si = _stall.get_inspector()
         self._key = None
         self._token = None
         self._tracked = False
+        self._t0 = 0.0
+        self._nbytes = 0
+        self._dtype = "none"
+        self._ps = 0
 
     def __enter__(self):
         if self._si is not None:
@@ -159,7 +174,19 @@ class _traced:
         if self._tl is not None:
             self._token = self._tl.activity_start(
                 self._desc, self._desc.split(":", 1)[0])
+        self._t0 = time.perf_counter()
         return self
+
+    def stat(self, arr=None, dtype=None, process_set=None) -> None:
+        """Attach payload facts once the call site knows them: `arr` is
+        the staged global (set_size, ...) array, so `arr.nbytes` is the
+        collective's whole payload (every rank's contribution)."""
+        if arr is not None and hasattr(arr, "nbytes"):
+            self._nbytes = int(arr.nbytes)
+        if dtype is not None:
+            self._dtype = str(dtype)
+        if process_set is not None:
+            self._ps = process_set.process_set_id
 
     def track(self, result):
         """Keep the stall entry open until `result` is device-ready."""
@@ -176,6 +203,13 @@ class _traced:
             # otherwise the watchdog owns the entry until readiness.
             if exc_type is not None or not self._tracked:
                 self._si.record_end(self._key)
+        if _met.enabled() and exc_type is None:
+            lbl = (self._kind, self._dtype, str(self._ps))
+            _met.collective_calls.labels(*lbl).inc()
+            if self._nbytes:
+                _met.collective_bytes.labels(*lbl).inc(self._nbytes)
+            _met.collective_latency.labels(*lbl).observe(
+                time.perf_counter() - self._t0)
         return False
 
 __all__ = [
@@ -253,9 +287,15 @@ def clear_caches() -> None:
 def _cached_program(key: Tuple, builder: Callable[[], Callable]) -> Callable:
     with _cache_lock:
         fn = _program_cache.get(key)
+        hit = fn is not None
         if fn is None:
             fn = builder()
             _program_cache[key] = fn
+    if _met.enabled():
+        # The response-cache fast-path ratio (reference: response_cache.cc
+        # bitvector hits): a healthy steady-state job converges to ~100%.
+        (_met.compile_cache_hits if hit
+         else _met.compile_cache_misses).labels(str(key[0])).inc()
     return fn
 
 
@@ -608,6 +648,7 @@ def allreduce(
                    prescale=prescale_factor, postscale=postscale_factor), \
             _traced("ALLREDUCE", name) as tr:
         xs, dtype = _make_global(tensor, ps)
+        tr.stat(arr=xs, dtype=dtype, process_set=ps)
         pre = jnp.asarray(prescale_factor, jnp.float32)
         post = jnp.asarray(postscale_factor, jnp.float32)
         if _join.armed():
@@ -751,6 +792,7 @@ def allgather(
 
         program = _cached_program(("allgather", ps.process_set_id), build)
         with _traced("ALLGATHER", name) as tr:
+            tr.stat(arr=xs, dtype=xs.dtype, process_set=ps)
             gathered = tr.track(program(xs))
         if all(s == max0 for s in sizes):
             return gathered
@@ -846,6 +888,7 @@ def broadcast(
 
         program = _cached_program(("broadcast", ps.process_set_id), build)
         with _traced("BROADCAST", name) as tr:
+            tr.stat(arr=xs, dtype=xs.dtype, process_set=ps)
             return tr.track(program(xs, jnp.asarray(root_rank, jnp.int32)))
 
 
@@ -909,6 +952,7 @@ def alltoall(
 
             program = _cached_program(("alltoall", ps.process_set_id), build)
             with _traced("ALLTOALL", name) as tr:
+                tr.stat(arr=xs, dtype=xs.dtype, process_set=ps)
                 out = tr.track(program(xs))
         # Return this process's received rows, one per local rank.
         local = [r for r in basics.local_device_ranks() if r in ps.ranks]
@@ -972,7 +1016,8 @@ def _alltoallv_eager(tensor, contribs, splits_arr, ps, n, name):
         )
 
     program = _cached_program(("alltoallv", ps.process_set_id), build)
-    with _traced("ALLTOALL", name):
+    with _traced("ALLTOALL", name) as tr:
+        tr.stat(arr=xs, dtype=xs.dtype, process_set=ps)
         # np.asarray per local shard is a blocking device→host fetch: the
         # bracket stays open across the genuinely-blocking part, so a hang
         # here is visible to the watchdog without readiness tracking.
@@ -1073,6 +1118,7 @@ def reducescatter(
                 ("masked_reducescatter", ps.process_set_id, op.name),
                 build_masked)
             with _traced("REDUCESCATTER", name) as tr:
+                tr.stat(arr=xs, dtype=xs.dtype, process_set=ps)
                 out = tr.track(program(xs, mask))
         else:
             def build():
@@ -1091,6 +1137,7 @@ def reducescatter(
                 ("reducescatter", ps.process_set_id, op.name), build
             )
             with _traced("REDUCESCATTER", name) as tr:
+                tr.stat(arr=xs, dtype=xs.dtype, process_set=ps)
                 out = tr.track(program(xs))
     local = [r for r in basics.local_device_ranks() if r in ps.ranks]
     rows = _local_rows(out, ps, local)

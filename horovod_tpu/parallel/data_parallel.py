@@ -28,6 +28,7 @@ from jax import shard_map
 
 from ..common import basics
 from ..common.basics import GLOBAL_AXIS, ProcessSet
+from ..metrics import catalog as _met
 from ..ops import collectives as C
 from ..ops.compression import Compression
 
@@ -97,6 +98,17 @@ def allreduce_gradients(
     if not leaves:
         return ((grads, error_feedback_state)
                 if error_feedback_state is not None else grads)
+    if _met.enabled():
+        nbytes = sum(l.size * l.dtype.itemsize for l in leaves
+                     if hasattr(l, "size") and hasattr(l, "dtype"))
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            # Trace time — this branch fires once per compile, not per
+            # step: record the static per-step payload (multiply by
+            # hvd_steps_total for in-jit traffic).  Incrementing a
+            # counter here would silently count compiles, not steps.
+            _met.grad_bytes_per_step.set(nbytes)
+        else:
+            _met.grad_bytes_reduced.inc(nbytes)
     if _cooperative:
         wire = compression.wire
         # Cooperative wire format: the quantized ring allreduce IS the
@@ -387,6 +399,8 @@ def data_parallel(
         tl = _tl.get_timeline()
         if tl is not None:
             tl.mark_cycle()
+        if _met.enabled():
+            _met.steps.inc()
         return out
 
     return call

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import optax
 
 from ..common.basics import ProcessSet
+from ..metrics import catalog as _met
 from ..ops import collectives as C
 from ..ops.compression import Compression
 from .data_parallel import allreduce_gradients
@@ -75,6 +76,12 @@ def DistributedGradientTransformation(
         else:
             grads = reduce_grads(grads)
             updates, inner = optimizer.update(grads, state.inner, params)
+        if _met.enabled() and not any(
+                isinstance(l, jax.core.Tracer)
+                for l in jax.tree_util.tree_leaves(grads)):
+            # Eager executions only: under jit this body runs once per
+            # compile, so counting here would undercount (and mislead).
+            _met.optimizer_syncs.inc()
         return updates, inner
 
     if backward_passes_per_step == 1:

@@ -32,6 +32,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ...common.exceptions import HorovodTpuError
+from ...metrics import catalog as _met
 from .. import safe_exec
 from ..exec_run import (
     DEFAULT_COORDINATOR_PORT,
@@ -189,6 +190,13 @@ class ElasticDriver:
         kv = self.server.kv()
         kv.put(f"elastic/gen/{self.gen}/info", json.dumps(info))
         kv.put("elastic/current_gen", str(self.gen))
+        old_slots = set(self.assignments)
+        new_slots = {(s.hostname, s.local_rank) for s in slots}
+        if _met.enabled():
+            if new_slots - old_slots:
+                _met.elastic_rank_added.inc(len(new_slots - old_slots))
+            if old_slots - new_slots:
+                _met.elastic_rank_removed.inc(len(old_slots - new_slots))
         self.assignments = {(s.hostname, s.local_rank): s for s in slots}
         logger.info("generation %d: %d workers on %s", self.gen,
                     len(slots), sorted(info["hosts"]))
@@ -363,6 +371,8 @@ class ElasticDriver:
                                  self.settings.reset_limit)
                     return 1
                 self.reset_count += 1
+                if _met.enabled():
+                    _met.elastic_restarts.inc()
                 self._active_hosts = usable
                 self._publish_generation(self._compute_assignments(usable))
                 self._kill_removed_workers()

@@ -33,6 +33,14 @@ from ..common import util
 logger = logging.getLogger("horovod_tpu.stall_inspector")
 
 
+def _metrics():
+    # Deferred: utils.* must stay importable before the metrics package
+    # (hvd.init wiring) and free of import cycles.
+    from ..metrics import catalog
+
+    return catalog
+
+
 class KvRankReporter:
     """Per-rank progress publishing over the control-plane KV.
 
@@ -248,6 +256,9 @@ class StallInspector:
                     f"[{desc}]. A rank may be lagging, dead, or running a "
                     f"different program.{blame}"
                 )
+                _m = _metrics()
+                if _m.enabled():
+                    _m.stall_warnings.inc()
             if worst is None or age > worst[1]:
                 worst = (desc, age)
         if (
@@ -255,6 +266,9 @@ class StallInspector:
             and worst is not None
             and worst[1] >= self.shutdown_time
         ):
+            _m = _metrics()
+            if _m.enabled():
+                _m.stall_aborts.inc()
             self._abort_fn(
                 f"Collective [{worst[0]}] stalled for {worst[1]:.0f}s "
                 f">= HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
@@ -278,6 +292,12 @@ class StallInspector:
                 with self._lock:
                     seq = self._next_key
                 self._reporter.publish(seq)
+                # The watchdog doubles as the metrics fleet publisher:
+                # same KV, same cadence (metrics/fleet.py reads it back).
+                from ..metrics import fleet as _fleet
+
+                _fleet.publish(self._reporter._client,
+                               rank=self._reporter._rank)
             self.check()
 
     def stop(self) -> None:

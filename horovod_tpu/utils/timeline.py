@@ -26,6 +26,7 @@ disk (`TimelineWriter` with its short-circuit buffer, timeline.cc).
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import queue
@@ -76,7 +77,13 @@ class _TimelineWriter:
                     # default=str: event args may carry numpy/jax scalars.
                     f.write(json.dumps(rec, default=str))
                     first = False
-                    f.flush()
+                    # Flush only when the queue drains: under a burst of
+                    # events a flush per record turns the writer thread
+                    # into one syscall per event (the reference's writer
+                    # batches for the same reason); an empty queue means
+                    # nobody is waiting, so make the file current then.
+                    if self._queue.empty():
+                        f.flush()
                 f.write("\n]\n")
         except Exception:
             # Mark unhealthy so the hot path stops feeding a dead writer
@@ -103,8 +110,17 @@ class _NativeWriterAdapter:
         self.filename = filename
         self._w = NativeTimelineWriter(filename)
 
+    # Chrome-trace keys the native writer's fixed parameter list covers.
+    _KNOWN = frozenset(("name", "cat", "ph", "ts", "dur", "pid", "tid",
+                        "s", "args"))
+
     def enqueue(self, record: dict) -> None:
         args = record.get("args")
+        # Keys outside the fixed set ("id" pairing async/flow events,
+        # "bp", ...) must survive the round trip top-level — folding
+        # them into args (or dropping them, the old behavior) breaks
+        # chrome://tracing's event pairing.
+        extra = {k: v for k, v in record.items() if k not in self._KNOWN}
         self._w.event(
             name=str(record.get("name", "")),
             cat=str(record.get("cat", "")),
@@ -115,6 +131,8 @@ class _NativeWriterAdapter:
             tid=str(record.get("tid", "")),
             scope=str(record.get("s", "")),
             args_json=json.dumps(args, default=str) if args else "",
+            extra_json=(json.dumps(extra, default=str)[1:-1]
+                        if extra else ""),
         )
 
     def close(self) -> None:
@@ -235,6 +253,12 @@ def stop_timeline() -> None:
     if _timeline is not None:
         _timeline.close()
         _timeline = None
+
+
+# Close the trace (emitting the closing bracket / draining the native
+# buffer) even when users never call hvd.shutdown(); stop_timeline() is
+# idempotent, so the normal shutdown path stays unaffected.
+atexit.register(stop_timeline)
 
 
 def init_from_env(rank: int) -> None:

@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Lint: every metric registered in horovod_tpu/metrics/catalog.py must be
+documented in docs/METRICS.md (and the doc must not list series the code
+no longer emits).
+
+Pure text parsing — no imports of horovod_tpu (CI machines running this
+lint need no jax).  Exit 1 on drift, printing one line per offense.
+
+Usage: python scripts/check_metrics_catalog.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+CATALOG = "horovod_tpu/metrics/catalog.py"
+DOC = "docs/METRICS.md"
+
+# _REG.counter(\n    "hvd_name", ... — the name is the first string
+# literal after the registration call.
+_REG_RE = re.compile(
+    r"_REG\.(?:counter|gauge|histogram)\(\s*\"(hvd_[a-z0-9_]+)\"",
+    re.MULTILINE)
+
+# Doc catalog rows: a markdown table line whose first cell is `hvd_*`.
+_DOC_ROW_RE = re.compile(r"^\|\s*`(hvd_[a-z0-9_]+)`", re.MULTILINE)
+
+
+def main(argv=None) -> int:
+    root = Path(argv[1]) if argv and len(argv) > 1 else \
+        Path(__file__).resolve().parent.parent
+    catalog_src = (root / CATALOG).read_text()
+    declared = set(_REG_RE.findall(catalog_src))
+    if not declared:
+        print(f"error: no metric registrations found in {CATALOG} "
+              "(parser out of date?)")
+        return 1
+    doc_path = root / DOC
+    if not doc_path.exists():
+        print(f"error: {DOC} missing — every metric in {CATALOG} must "
+              "be documented there")
+        return 1
+    documented = set(_DOC_ROW_RE.findall(doc_path.read_text()))
+
+    rc = 0
+    for name in sorted(declared - documented):
+        print(f"undocumented metric: {name} (registered in {CATALOG}, "
+              f"no catalog row in {DOC})")
+        rc = 1
+    for name in sorted(documented - declared):
+        print(f"stale doc entry: {name} (listed in {DOC}, not registered "
+              f"in {CATALOG})")
+        rc = 1
+    if rc == 0:
+        print(f"ok: {len(declared)} metrics declared and documented")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

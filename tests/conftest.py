@@ -32,6 +32,9 @@ import horovod_tpu as hvd  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "integration: multi-process integration tests")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 "
+        "smoke pass (-m 'not slow')")
 
 
 @pytest.fixture(scope="session", autouse=True)

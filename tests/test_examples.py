@@ -48,6 +48,7 @@ class TestExamples:
         out = _run_example("tape_mnist.py")
         assert "loss=" in out
 
+    @pytest.mark.slow
     def test_synthetic_benchmark_tiny(self):
         out = _run_example(
             "synthetic_benchmark.py",
@@ -56,6 +57,7 @@ class TestExamples:
              "--num-batches-per-iter", "2", "--num-iters", "1"])
         assert "Total img/sec" in out
 
+    @pytest.mark.slow
     def test_synthetic_benchmark_adasum_fp16(self):
         out = _run_example(
             "synthetic_benchmark.py",
@@ -65,6 +67,7 @@ class TestExamples:
              "--use-adasum", "--fp16-allreduce"])
         assert "Total img/sec" in out
 
+    @pytest.mark.slow
     def test_synthetic_benchmark_int8_ring(self):
         out = _run_example(
             "synthetic_benchmark.py",
@@ -74,6 +77,7 @@ class TestExamples:
              "--compression", "int8"])
         assert "Total img/sec" in out
 
+    @pytest.mark.slow
     def test_autotune_demo_tiny(self):
         out = _run_example("autotune_demo.py", ["--tiny"],
                            extra_env={"XLA_FLAGS": ""})
@@ -84,6 +88,7 @@ class TestExamples:
         out = _run_example("torch_mnist.py", ["--epochs", "1"])
         assert "loss=" in out
 
+    @pytest.mark.slow
     def test_spark_estimator(self):
         # Spawns its own 2 worker processes (LocalBackend pins them to
         # CPU with clean XLA_FLAGS itself).
@@ -141,6 +146,7 @@ class TestExamples:
             extra_env={"XLA_FLAGS": ""})
         assert "best score" in out
 
+    @pytest.mark.slow
     def test_elastic_resnet_under_driver(self, tmp_path):
         script = tmp_path / "discover.sh"
         script.write_text("#!/bin/sh\necho localhost:1\n")

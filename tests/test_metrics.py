@@ -1,0 +1,364 @@
+"""Metrics subsystem: registry primitives, Prometheus exposition + the
+scrape endpoint, hot-path instrumentation consistency, KV fleet
+aggregation, the merged-view CLI, and the catalog lint.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.metrics import catalog as met_catalog
+from horovod_tpu.metrics import exposition, fleet
+from horovod_tpu.metrics.__main__ import _parse_prometheus
+from horovod_tpu.metrics.registry import (
+    Counter, Histogram, MetricsRegistry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Registry primitives
+# ---------------------------------------------------------------------------
+
+def test_counter_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "help", ("kind",))
+    c.labels("a").inc()
+    c.labels("a").inc(2.5)
+    c.labels("b").inc()
+    assert c.labels("a").get() == 3.5
+    assert c.labels("b").get() == 1.0
+    with pytest.raises(ValueError):
+        c.labels("a").inc(-1)
+
+
+def test_unlabeled_convenience():
+    reg = MetricsRegistry()
+    c = reg.counter("plain_total", "help")
+    c.inc()
+    c.inc(4)
+    assert c._solo().get() == 5.0
+    g = reg.gauge("g", "help")
+    g.set(7)
+    g.inc()
+    assert g._solo().get() == 8.0
+
+
+def test_labels_interning_and_kwargs():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "h", ("kind", "dtype"))
+    assert c.labels("x", "f32") is c.labels("x", "f32")
+    assert c.labels("x", "f32") is c.labels(kind="x", dtype="f32")
+    c2 = reg.counter("u_total", "h", ("kind", "bits"))
+    assert c2.labels("x", 32) is c2.labels("x", "32")  # str-coerced
+    with pytest.raises(ValueError):
+        c.labels("only-one")
+
+
+def test_reregistration_type_mismatch_raises():
+    reg = MetricsRegistry()
+    reg.counter("m", "h", ("a",))
+    assert reg.counter("m", "h", ("a",)) is reg.get("m")  # idempotent
+    with pytest.raises(ValueError):
+        reg.gauge("m", "h", ("a",))
+    with pytest.raises(ValueError):
+        reg.counter("m", "h", ("b",))
+
+
+def test_histogram_buckets_cumulative():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "h", buckets=[0.01, 0.1, 1.0])
+    for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    child = h._solo()
+    cum = child.cumulative()
+    assert cum == [(0.01, 2), (0.1, 3), (1.0, 4), (math.inf, 5)]
+    assert child.count == 5
+    assert abs(child.sum - 5.56) < 1e-9
+
+
+def test_default_latency_buckets_span():
+    from horovod_tpu.metrics.registry import default_latency_buckets
+    b = default_latency_buckets()
+    assert b[0] == 1e-6 and b[-1] > 60
+    assert all(x < y for x, y in zip(b, b[1:]))
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def test_render_text_format():
+    reg = MetricsRegistry()
+    c = reg.counter("hvd_x_total", "calls with \"quotes\"", ("kind",))
+    c.labels("AR").inc(3)
+    h = reg.histogram("hvd_l_seconds", "lat", ("kind",), buckets=[0.1, 1.0])
+    h.labels("AR").observe(0.05)
+    text = exposition.render(reg)
+    assert '# HELP hvd_x_total calls with \\"quotes\\"' in text
+    assert "# TYPE hvd_x_total counter" in text
+    assert 'hvd_x_total{kind="AR"} 3' in text
+    assert "# TYPE hvd_l_seconds histogram" in text
+    assert 'hvd_l_seconds_bucket{kind="AR",le="0.1"} 1' in text
+    assert 'hvd_l_seconds_bucket{kind="AR",le="+Inf"} 1' in text
+    assert 'hvd_l_seconds_sum{kind="AR"} 0.05' in text
+    assert 'hvd_l_seconds_count{kind="AR"} 1' in text
+
+
+def test_render_parses_back():
+    reg = MetricsRegistry()
+    reg.counter("hvd_a_total", "h", ("k",)).labels("x").inc(2)
+    reg.histogram("hvd_b_seconds", "h", buckets=[1.0])._solo().observe(0.5)
+    snap = _parse_prometheus(exposition.render(reg), rank=0)
+    assert snap["metrics"]["hvd_a_total"]["samples"] == [[["x"], 2.0]]
+    hist = snap["metrics"]["hvd_b_seconds"]
+    assert hist["kind"] == "histogram"
+    [[_, acc]] = hist["samples"]
+    assert acc["count"] == 1 and acc["sum"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# Hot-path instrumentation + the scrape endpoint (the acceptance smoke
+# test: N eager allreduces must be visible in a real HTTP scrape)
+# ---------------------------------------------------------------------------
+
+def _scrape(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        return r.read().decode()
+
+
+def _sum_series(text, name, **label_filter):
+    snap = _parse_prometheus(text, rank=0)
+    m = snap["metrics"].get(name)
+    if m is None:
+        return 0.0
+    names = m["labelnames"]
+    total = 0.0
+    for values, val in m["samples"]:
+        labels = dict(zip(sorted(names), values))
+        if all(labels.get(k) == v for k, v in label_filter.items()):
+            total += val if not isinstance(val, dict) else val["count"]
+    return total
+
+
+def test_eager_allreduce_visible_in_scrape():
+    n = 3
+    port = exposition.start_server(0, addr="127.0.0.1")
+    try:
+        before = _scrape(port)
+        for _ in range(n):
+            hvd.allreduce(jnp.ones((16,), jnp.float32), name="m.smoke")
+        after = _scrape(port)
+    finally:
+        exposition.stop_server()
+
+    def delta(name, **f):
+        return _sum_series(after, name, **f) - _sum_series(
+            before, name, **f)
+
+    assert delta("hvd_collective_calls_total", kind="ALLREDUCE") == n
+    # 16 f32 * 8 ranks staged globally, n times.
+    assert delta("hvd_collective_bytes_total", kind="ALLREDUCE") \
+        == n * 16 * 4 * hvd.size()
+    # Histogram observed once per call (count via the _count series).
+    assert delta("hvd_collective_latency_seconds",
+                 kind="ALLREDUCE") == n
+    # Same shape n times: every dispatch is a cache hit or miss, and
+    # they account for exactly the n calls.
+    cache = delta("hvd_compile_cache_hits_total", kind="allreduce") + \
+        delta("hvd_compile_cache_misses_total", kind="allreduce")
+    assert cache == n
+    for needle in ("hvd_collective_calls_total",
+                   "hvd_collective_bytes_total",
+                   "hvd_collective_latency_seconds_bucket",
+                   "hvd_compile_cache_hits_total",
+                   "hvd_compile_cache_misses_total"):
+        assert needle in after
+
+
+def test_metrics_disable_gates_hot_path():
+    met_catalog.set_enabled(False)
+    try:
+        before = met_catalog.collective_calls.labels(
+            "ALLREDUCE", "float32", "0").get()
+        hvd.allreduce(jnp.ones((4,), jnp.float32), name="m.disabled")
+        after = met_catalog.collective_calls.labels(
+            "ALLREDUCE", "float32", "0").get()
+        assert after == before
+    finally:
+        met_catalog.set_enabled(True)
+
+
+def test_healthz_and_404():
+    port = exposition.start_server(0, addr="127.0.0.1")
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert r.read() == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        exposition.stop_server()
+
+
+def test_steps_counter_increments():
+    before = met_catalog.steps._solo().get()
+    step = hvd.data_parallel(lambda x: x * 2, batch_args=(0,),
+                             donate_args=())
+    step(jnp.ones((8, 2)))
+    step(jnp.ones((8, 2)))
+    assert met_catalog.steps._solo().get() == before + 2
+
+
+def test_grad_bytes_eager_counter():
+    grads = {"w": jnp.ones((32,), jnp.float32),
+             "b": jnp.ones((4,), jnp.float32)}
+    before = met_catalog.grad_bytes_reduced._solo().get()
+    hvd.allreduce_gradients(grads)
+    assert met_catalog.grad_bytes_reduced._solo().get() \
+        == before + (32 + 4) * 4
+
+
+# ---------------------------------------------------------------------------
+# Fleet snapshots / aggregation / CLI
+# ---------------------------------------------------------------------------
+
+def _mini_snap(rank, steps, calls_val):
+    return {
+        "rank": rank, "ts": time.time(),
+        "metrics": {
+            "hvd_steps_total": {
+                "kind": "counter", "labelnames": [],
+                "samples": [[[], float(steps)]]},
+            "hvd_collective_calls_total": {
+                "kind": "counter",
+                "labelnames": ["kind", "dtype", "process_set"],
+                "samples": [[["ALLREDUCE", "float32", "0"],
+                             float(calls_val)]]},
+        },
+    }
+
+
+def test_aggregate_sums_counters_keeps_gauges():
+    s0 = _mini_snap(0, steps=10, calls_val=5)
+    s1 = _mini_snap(1, steps=12, calls_val=7)
+    for s, g in ((s0, 1.0), (s1, 3.0)):
+        s["metrics"]["hvd_grad_bytes_per_step"] = {
+            "kind": "gauge", "labelnames": [], "samples": [[[], g]]}
+    agg = fleet.aggregate([s0, s1])
+    assert agg["hvd_steps_total"]["samples"][()] == 22.0
+    assert agg["hvd_collective_calls_total"]["samples"][
+        ("ALLREDUCE", "float32", "0")] == 12.0
+    assert agg["hvd_grad_bytes_per_step"]["samples"][()] == {0: 1.0, 1: 3.0}
+
+
+def test_render_fleet_reports_skew():
+    out = fleet.render_fleet([_mini_snap(0, 10, 5), _mini_snap(1, 14, 5)])
+    assert "2 rank(s)" in out
+    assert "step skew (max-min): 4" in out
+    assert "collective calls: 10" in out
+
+
+def test_snapshot_roundtrips_through_json():
+    snap = fleet.snapshot(rank=3)
+    again = json.loads(json.dumps(snap))
+    assert again["rank"] == 3
+    # Histogram samples carry mergeable buckets, not raw observations.
+    lat = again["metrics"].get("hvd_collective_latency_seconds")
+    if lat is not None:
+        for _values, acc in lat["samples"]:
+            assert set(acc) == {"sum", "count", "buckets", "inf"}
+
+
+_PUBLISH_RANK1 = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import horovod_tpu  # noqa: F401  (registers the catalog)
+from horovod_tpu.metrics import catalog, fleet
+from horovod_tpu.runner.rendezvous import RendezvousClient
+catalog.steps.inc(7)
+catalog.collective_calls.labels("ALLREDUCE", "float32", "0").inc(2)
+client = RendezvousClient("127.0.0.1", int(sys.argv[1]), sys.argv[2])
+fleet.publish(client, rank=1)
+"""
+
+
+@pytest.mark.integration
+def test_fleet_cli_merges_multirank_kv(tmp_path):
+    """Acceptance: `python -m horovod_tpu.metrics` renders a merged
+    multi-rank view from the KV, the snapshots coming from two distinct
+    processes (this one as rank 0, a subprocess as rank 1)."""
+    from horovod_tpu.runner.rendezvous import (
+        RendezvousClient, RendezvousServer)
+
+    srv = RendezvousServer(prefer_native=False)
+    port = srv.start(0)
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "HOROVOD_RENDEZVOUS_ADDR": "127.0.0.1",
+           "HOROVOD_RENDEZVOUS_PORT": str(port),
+           "HOROVOD_SECRET_KEY": srv.secret}
+    try:
+        met_catalog.steps.inc(5)  # make rank 0 visibly non-empty
+        fleet.publish(RendezvousClient("127.0.0.1", port, srv.secret),
+                      rank=0)
+        subprocess.run(
+            [sys.executable, "-c",
+             _PUBLISH_RANK1.format(repo=REPO), str(port), srv.secret],
+            check=True, timeout=300, env=env, cwd=str(tmp_path))
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.metrics"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        out = proc.stdout
+        assert "fleet view: 2 rank(s)" in out
+        assert "step skew" in out
+        # Rank rows for both ranks, in order.
+        assert out.index("\n   0 ") < out.index("\n   1 ")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Catalog lint (code <-> docs drift)
+# ---------------------------------------------------------------------------
+
+def test_catalog_lint_passes_on_repo():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_metrics_catalog.py"), REPO],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_catalog_lint_catches_drift(tmp_path):
+    cat_dir = tmp_path / "horovod_tpu" / "metrics"
+    cat_dir.mkdir(parents=True)
+    src = open(os.path.join(
+        REPO, "horovod_tpu", "metrics", "catalog.py")).read()
+    (cat_dir / "catalog.py").write_text(
+        src + '\nghost = _REG.counter(\n    "hvd_ghost_total", "boo")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "METRICS.md").write_text(
+        open(os.path.join(REPO, "docs", "METRICS.md")).read())
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_metrics_catalog.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "hvd_ghost_total" in proc.stdout

@@ -72,12 +72,14 @@ class TestCrossProcessCollectives:
             assert results[rank]["ckpt"] == [1.0, 1.0, 1.0]
             assert results[rank]["ckpt_latest"] == 1
 
+    @pytest.mark.slow
     def test_four_process_collectives(self, tmp_path):
         """np=4 (reference floor is 2 processes; SURVEY §4 says go
         beyond): mesh order, every collective, and process-set subsets
         that span non-adjacent processes."""
         self._run_n_process(4, tmp_path, timeout=420)
 
+    @pytest.mark.slow
     def test_eight_process_collectives(self, tmp_path):
         """np=8: contiguous-rank/mesh-order assumptions at the size the
         virtual-device tests simulate, with real processes."""

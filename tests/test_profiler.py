@@ -1,0 +1,114 @@
+"""merge_traces clock alignment via TRACE_START_MARKER (complements the
+basic merge tests in test_aux.py: exact shift arithmetic, unaligned
+fallback, truncated-trace tolerance, uncompressed device input).
+"""
+
+import gzip
+import json
+
+from horovod_tpu.utils import profiler as prof
+from horovod_tpu.utils import timeline as tl_mod
+
+
+def _write_host(tmp_path, events):
+    """Write a Chrome-array host timeline directly (known timestamps —
+    Timeline's perf_counter clock would make exact assertions flaky)."""
+    f = tmp_path / "host.json"
+    f.write_text("[\n" + ",\n".join(json.dumps(e) for e in events) + "\n]\n")
+    return str(f)
+
+
+def _write_dev(tmp_path, events, compress=True):
+    payload = {"traceEvents": events}
+    if compress:
+        f = tmp_path / "dev.trace.json.gz"
+        with gzip.open(f, "wt") as fh:
+            json.dump(payload, fh)
+    else:
+        f = tmp_path / "dev.trace.json"
+        f.write_text(json.dumps(payload))
+    return str(f)
+
+
+def test_marker_shift_is_exact(tmp_path):
+    """Every host event must be shifted by exactly -marker_ts so the
+    marker lands at t=0 on the device clock."""
+    host = _write_host(tmp_path, [
+        {"name": "before", "ph": "i", "ts": 100.0, "pid": 0, "tid": "t"},
+        {"name": prof.TRACE_START_MARKER, "ph": "i", "ts": 250.0,
+         "pid": 0, "tid": "profiler"},
+        {"name": "EXECUTE", "ph": "X", "ts": 400.0, "dur": 25.0,
+         "pid": 0, "tid": "grad.w"},
+    ])
+    dev = _write_dev(tmp_path, [
+        {"name": "fusion.7", "ph": "X", "ts": 5.0, "dur": 10.0,
+         "pid": 1, "tid": 2},
+    ])
+    out = tmp_path / "merged.json"
+    stats = prof.merge_traces(host, dev, str(out))
+    assert stats == {"device_events": 1, "host_events": 3,
+                     "aligned": True, "out": str(out)}
+    merged = json.load(open(out))["traceEvents"]
+    by_name = {e["name"]: e for e in merged if "name" in e}
+    assert by_name[prof.TRACE_START_MARKER]["ts"] == 0.0
+    assert by_name["before"]["ts"] == -150.0   # 100 - 250
+    assert by_name["EXECUTE"]["ts"] == 150.0   # 400 - 250
+    assert by_name["EXECUTE"]["dur"] == 25.0   # durations untouched
+    # Device events keep their own clock.
+    assert by_name["fusion.7"]["ts"] == 5.0
+    # Host pids offset out of the device pid space + labeled.
+    assert by_name["EXECUTE"]["pid"] == prof.HOST_PID_OFFSET
+    labels = [e for e in merged if e.get("ph") == "M"]
+    assert any("control plane" in e["args"]["name"] for e in labels)
+
+
+def test_no_marker_means_no_shift(tmp_path):
+    host = _write_host(tmp_path, [
+        {"name": "EXECUTE", "ph": "X", "ts": 400.0, "dur": 25.0,
+         "pid": 2, "tid": "g"},
+    ])
+    dev = _write_dev(tmp_path, [])
+    stats = prof.merge_traces(host, dev, str(tmp_path / "m.json"))
+    assert not stats["aligned"]
+    merged = json.load(open(tmp_path / "m.json"))["traceEvents"]
+    ev = next(e for e in merged if e.get("name") == "EXECUTE")
+    assert ev["ts"] == 400.0  # unshifted
+    assert ev["pid"] == prof.HOST_PID_OFFSET + 2
+
+
+def test_truncated_host_trace_tolerated(tmp_path):
+    """A process that died mid-run leaves no closing bracket; the merge
+    must still read every complete record."""
+    f = tmp_path / "host.json"
+    rec = {"name": prof.TRACE_START_MARKER, "ph": "i", "ts": 10.0,
+           "pid": 0, "tid": "p"}
+    f.write_text("[\n" + json.dumps(rec))  # no ]\n
+    dev = _write_dev(tmp_path, [])
+    stats = prof.merge_traces(str(f), dev, str(tmp_path / "m.json"))
+    assert stats["host_events"] == 1 and stats["aligned"]
+
+
+def test_uncompressed_device_trace(tmp_path):
+    host = _write_host(tmp_path, [
+        {"name": prof.TRACE_START_MARKER, "ph": "i", "ts": 0.0,
+         "pid": 0, "tid": "p"},
+    ])
+    dev = _write_dev(tmp_path, [
+        {"name": "k", "ph": "X", "ts": 1.0, "dur": 1.0, "pid": 0,
+         "tid": 0},
+    ], compress=False)
+    stats = prof.merge_traces(host, dev, str(tmp_path / "m.json"))
+    assert stats["device_events"] == 1 and stats["aligned"]
+
+
+def test_marker_stamped_by_live_timeline(tmp_path):
+    """start_device_trace stamps the marker through the real Timeline
+    (sanity that the producer and the merge agree on the name)."""
+    tl = tl_mod.start_timeline(str(tmp_path / "host.json"))
+    try:
+        tl.instant(prof.TRACE_START_MARKER, category="profiler",
+                   args={"logdir": "x"})
+    finally:
+        tl_mod.stop_timeline()
+    events = json.load(open(tmp_path / "host.json"))
+    assert any(e["name"] == prof.TRACE_START_MARKER for e in events)

@@ -558,6 +558,7 @@ class TestEstimatorValidation:
 
 @pytest.mark.integration
 class TestTorchEstimatorFit:
+    @pytest.mark.slow
     def test_fit_transform_2proc(self, tmp_path):
         import torch
 
@@ -599,6 +600,7 @@ class TestTorchEstimatorFit:
 
 @pytest.mark.integration
 class TestKerasEstimatorFit:
+    @pytest.mark.slow
     def test_fit_transform_2proc(self, tmp_path):
         import tensorflow as tf
 
@@ -781,6 +783,7 @@ class TestLightningValidation:
 
 @pytest.mark.integration
 class TestLightningEstimatorFit:
+    @pytest.mark.slow
     def test_fit_transform_2proc(self, tmp_path, monkeypatch):
         import torch
 

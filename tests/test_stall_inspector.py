@@ -1,0 +1,114 @@
+"""Stall-inspector two-tier policy driven by a fake clock, plus the
+metrics coupling (hvd_stall_* counters; watchdog-as-fleet-publisher).
+
+Complements test_aux.py (which covers warn-once, degraded mode, async
+result tracking); here the warn->abort escalation is walked explicitly
+through time via check(now=...).
+"""
+
+import time
+
+from horovod_tpu.metrics import catalog as met_catalog
+from horovod_tpu.utils import stall_inspector as stall_mod
+
+
+def _make(warn, shutdown):
+    warnings, aborts = [], []
+    insp = stall_mod.StallInspector(
+        warn_time_seconds=warn,
+        shutdown_time_seconds=shutdown,
+        warn_fn=warnings.append,
+        abort_fn=aborts.append,
+    )
+    return insp, warnings, aborts
+
+
+def test_two_tier_policy_fake_clock():
+    insp, warnings, aborts = _make(warn=10.0, shutdown=30.0)
+    t0 = time.time()
+    insp.record_start("ALLREDUCE:grad.w")
+
+    # Below the warn threshold: silence.
+    assert insp.check(now=t0 + 5) == []
+    assert warnings == [] and aborts == []
+
+    # Past warn, below shutdown: exactly one warning, no abort.
+    assert insp.check(now=t0 + 15) == ["ALLREDUCE:grad.w"]
+    assert len(warnings) == 1 and "ALLREDUCE:grad.w" in warnings[0]
+    assert aborts == []
+
+    # Re-checking does not re-warn the same op.
+    assert insp.check(now=t0 + 20) == []
+    assert len(warnings) == 1
+
+    # Past shutdown: the abort tier fires with the worst op named.
+    insp.check(now=t0 + 35)
+    assert len(aborts) == 1 and "ALLREDUCE:grad.w" in aborts[0]
+
+
+def test_shutdown_tier_disabled_by_default():
+    insp, warnings, aborts = _make(warn=10.0, shutdown=0.0)
+    t0 = time.time()
+    insp.record_start("BARRIER")
+    insp.check(now=t0 + 1e6)  # absurdly stalled
+    assert len(warnings) == 1
+    assert aborts == []  # shutdown_time=0 never aborts (reference default)
+
+
+def test_completed_op_never_warns():
+    insp, warnings, aborts = _make(warn=10.0, shutdown=0.0)
+    t0 = time.time()
+    key = insp.record_start("ALLGATHER:x")
+    insp.record_end(key)
+    assert insp.check(now=t0 + 100) == []
+    assert warnings == []
+
+
+def test_warning_and_abort_increment_metrics():
+    warn_c = met_catalog.stall_warnings
+    abort_c = met_catalog.stall_aborts
+    w0 = warn_c._solo().get()
+    a0 = abort_c._solo().get()
+
+    insp, warnings, aborts = _make(warn=10.0, shutdown=30.0)
+    t0 = time.time()
+    insp.record_start("ALLREDUCE:g")
+    insp.check(now=t0 + 15)
+    assert warn_c._solo().get() == w0 + 1
+    assert abort_c._solo().get() == a0
+
+    insp.check(now=t0 + 40)
+    assert abort_c._solo().get() == a0 + 1
+
+
+def test_watchdog_publishes_metrics_snapshots():
+    """The watchdog thread doubles as the fleet metrics publisher: with a
+    reporter attached, metrics/rank/<rank> appears on the KV."""
+    from horovod_tpu.metrics import fleet
+    from horovod_tpu.runner.rendezvous import (
+        RendezvousClient, RendezvousServer)
+
+    srv = RendezvousServer(prefer_native=False)
+    port = srv.start(0)
+    try:
+        client = RendezvousClient("127.0.0.1", port, srv.secret)
+        reporter = stall_mod.KvRankReporter(client, rank=5)
+        insp = stall_mod.StallInspector(
+            warn_time_seconds=60.0, check_interval_seconds=0.05,
+            reporter=reporter)
+        insp.start()
+        try:
+            deadline = time.time() + 10
+            snaps = []
+            while time.time() < deadline and not snaps:
+                snaps = fleet.read_fleet(client)
+                time.sleep(0.05)
+        finally:
+            insp.stop()
+        assert snaps, "watchdog never published a metrics snapshot"
+        assert snaps[0]["rank"] == 5
+        assert "metrics" in snaps[0]
+        # The stall heartbeat rides the same channel (unchanged behavior).
+        assert client.get("stall/rank/5") is not None
+    finally:
+        srv.stop()

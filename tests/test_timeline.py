@@ -1,0 +1,172 @@
+"""Timeline writer semantics: bracketing, cycle marks, writer selection,
+flush batching, and Python↔native record parity (reference: timeline.cc
+TimelineWriter; complements the collective-level coverage in test_aux.py).
+"""
+
+import json
+import time
+
+import pytest
+
+from horovod_tpu.utils import timeline as tl_mod
+
+
+def _read_trace(path):
+    return json.loads(path.read_text())
+
+
+# ---------------------------------------------------------------------------
+# Event bracketing
+# ---------------------------------------------------------------------------
+
+def test_activity_bracketing_overlapping_tokens(tmp_path):
+    """Concurrent brackets are token-scoped: interleaved start/end pairs
+    must each produce their own X event with the right tensor name."""
+    f = tmp_path / "tl.json"
+    tl = tl_mod.Timeline(str(f), rank=2)
+    t_a = tl.activity_start("tensor_a", "ALLREDUCE")
+    t_b = tl.activity_start("tensor_b", "ALLGATHER")
+    tl.activity_end(t_a)
+    tl.activity_end(t_b)
+    # Ending an already-ended/unknown token is a no-op, not an event.
+    tl.activity_end(t_a)
+    tl.activity_end(999)
+    tl.close()
+    events = _read_trace(f)
+    assert len(events) == 2
+    by_tid = {e["tid"]: e for e in events}
+    assert by_tid["tensor_a"]["name"] == "ALLREDUCE"
+    assert by_tid["tensor_b"]["name"] == "ALLGATHER"
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["pid"] == 2
+        assert e["dur"] >= 0
+        assert e["ts"] >= 0
+
+
+def test_mark_cycles_disabled_emits_nothing(tmp_path):
+    f = tmp_path / "tl.json"
+    tl = tl_mod.Timeline(str(f), rank=0, mark_cycles=False)
+    tl.mark_cycle()
+    tl.mark_cycle()
+    tl.close()
+    assert _read_trace(f) == []
+
+
+def test_instant_scope_and_args(tmp_path):
+    f = tmp_path / "tl.json"
+    tl = tl_mod.Timeline(str(f), rank=1, mark_cycles=True)
+    tl.mark_cycle()
+    tl.instant("evt", category="elastic", args={"np": 4})
+    tl.close()
+    events = _read_trace(f)
+    assert [e["name"] for e in events] == ["CYCLE_1", "evt"]
+    for e in events:
+        assert e["ph"] == "i"
+        assert e["s"] == "p"  # process scope must survive the writer
+    assert events[1]["args"] == {"np": 4}
+
+
+# ---------------------------------------------------------------------------
+# Writer selection / fallback
+# ---------------------------------------------------------------------------
+
+def test_python_writer_forced_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_TIMELINE_DISABLE_NATIVE", "1")
+    tl = tl_mod.Timeline(str(tmp_path / "tl.json"), rank=0)
+    try:
+        assert isinstance(tl._writer, tl_mod._TimelineWriter)
+    finally:
+        tl.close()
+
+
+def test_fallback_when_native_unavailable(tmp_path, monkeypatch):
+    """Native adapter construction failing (no prebuilt .so) must degrade
+    to the Python writer, never propagate out of Timeline()."""
+    def boom(filename):
+        raise RuntimeError("native library not prebuilt")
+
+    monkeypatch.delenv("HOROVOD_TIMELINE_DISABLE_NATIVE", raising=False)
+    monkeypatch.setattr(tl_mod, "_NativeWriterAdapter", boom)
+    tl = tl_mod.Timeline(str(tmp_path / "tl.json"), rank=0)
+    try:
+        assert isinstance(tl._writer, tl_mod._TimelineWriter)
+        tl.instant("still_works")
+    finally:
+        tl.close()
+    assert _read_trace(tmp_path / "tl.json")[0]["name"] == "still_works"
+
+
+# ---------------------------------------------------------------------------
+# Flush batching (the writer must not fsync per event, but an idle queue
+# must leave the file current so crash dumps stay useful)
+# ---------------------------------------------------------------------------
+
+def test_writer_flushes_when_queue_drains(tmp_path):
+    f = tmp_path / "tl.json"
+    w = tl_mod._TimelineWriter(str(f))
+    try:
+        w.enqueue({"name": "e1", "ph": "i", "ts": 1.0, "pid": 0, "tid": "t"})
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if f.exists() and '"e1"' in f.read_text():
+                break
+            time.sleep(0.01)
+        # Queue drained -> flushed: the record is on disk BEFORE close.
+        assert '"e1"' in f.read_text()
+    finally:
+        w.close()
+    assert _read_trace(f)[0]["name"] == "e1"
+
+
+def test_writer_burst_produces_valid_trace(tmp_path):
+    f = tmp_path / "tl.json"
+    w = tl_mod._TimelineWriter(str(f))
+    for i in range(500):
+        w.enqueue({"name": f"e{i}", "ph": "i", "ts": float(i),
+                   "pid": 0, "tid": "t"})
+    w.close()
+    events = _read_trace(f)
+    assert len(events) == 500
+    assert events[0]["name"] == "e0" and events[-1]["name"] == "e499"
+
+
+# ---------------------------------------------------------------------------
+# Python <-> native writer parity (the Timeline.instant "s":"p" scope and
+# any future top-level Chrome-trace key must survive the native path)
+# ---------------------------------------------------------------------------
+
+def _native_writer(path):
+    from horovod_tpu._native import load
+    if load(build_if_missing=True) is None:
+        pytest.skip("native library unavailable (no g++?)")
+    return tl_mod._NativeWriterAdapter(str(path))
+
+
+def test_native_roundtrip_matches_python_writer(tmp_path):
+    records = [
+        # activity (X, with dur)
+        {"name": "ALLREDUCE", "cat": "collective", "ph": "X", "ts": 10.5,
+         "dur": 42.0, "pid": 3, "tid": "grad.w"},
+        # instant with process scope + args (Timeline.instant shape)
+        {"name": "CYCLE_1", "cat": "cycle", "ph": "i", "s": "p",
+         "ts": 99.9, "pid": 3, "tid": "cycle", "args": {"n": 1, "s": "x"}},
+        # async-begin with an id — the pairing key must not be dropped
+        {"name": "span", "cat": "c", "ph": "b", "id": 7, "ts": 1.0,
+         "pid": 0, "tid": "t"},
+        # escaping hazards
+        {"name": 'q"u\\o', "cat": "c\nat", "ph": "i", "ts": 2.0,
+         "pid": 0, "tid": "t"},
+    ]
+    wp = tl_mod._TimelineWriter(str(tmp_path / "py.json"))
+    wn = _native_writer(tmp_path / "nat.json")
+    for r in records:
+        wp.enqueue(dict(r))
+        wn.enqueue(dict(r))
+    wp.close()
+    wn.close()
+    py = _read_trace(tmp_path / "py.json")
+    nat = _read_trace(tmp_path / "nat.json")
+    assert len(py) == len(nat) == len(records)
+    for p, n in zip(py, nat):
+        assert p == n, f"record diverged through native writer: {p} vs {n}"
