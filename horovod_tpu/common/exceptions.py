@@ -9,6 +9,21 @@ class HorovodTpuError(Exception):
     """Base class for all framework errors."""
 
 
+class RendezvousConnectionError(HorovodTpuError):
+    """Transport-level rendezvous failure (connect refused, reset,
+    mid-flight drop).  Distinct from logical server errors (key timeout,
+    barrier timeout) so retry policies can retry ONLY the transport
+    class: transport errors are safe to retry for idempotent ops, while
+    a logical timeout already consumed its deadline."""
+
+
+class CheckpointCorruptError(HorovodTpuError):
+    """A persisted checkpoint failed integrity verification (digest
+    mismatch, truncated or unreadable payload).  Restore paths treat it
+    as 'this step is unusable' and roll back to the previous good step
+    rather than crashing the job."""
+
+
 class HorovodInternalError(HorovodTpuError):
     """A collective failed mid-flight; elastic training treats this as a
     signal to restore state and re-initialize (reference:

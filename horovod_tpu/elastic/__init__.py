@@ -28,17 +28,18 @@ import copy
 import logging
 import queue
 import threading
-import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
 
+from .. import faults as _faults
 from ..common import basics
 from ..common.exceptions import (
     HorovodInternalError,
     HostsUpdatedInterrupt,
 )
+from ..faults import RetryPolicy
 from ..ops import collectives as C
 from ..ops import functions as F
 
@@ -77,6 +78,7 @@ class State:
         pass
 
     def commit(self) -> None:
+        _faults.point("state.commit")
         self.save()
         self.check_host_updates()
 
@@ -112,18 +114,34 @@ class ObjectState(State):
     def __init__(self, **kwargs):
         super().__init__()
         self._saved: Dict[str, Any] = {}
+        self._prev_saved: Optional[Dict[str, Any]] = None
         for k, v in kwargs.items():
             setattr(self, k, v)
         self._known = list(kwargs.keys())
         self.save()
 
     def save(self) -> None:
-        self._saved = {k: copy.deepcopy(getattr(self, k))
-                       for k in self._known}
+        # Build the snapshot fully, then swap — an exception mid-snapshot
+        # (dying backend, unpicklable attr) must never leave `_saved`
+        # half-updated.  The previous snapshot is kept as a restore
+        # fallback.
+        snap = {k: copy.deepcopy(getattr(self, k)) for k in self._known}
+        if self._saved:
+            self._prev_saved = self._saved
+        self._saved = snap
 
     def restore(self) -> None:
-        for k, v in self._saved.items():
-            setattr(self, k, copy.deepcopy(v))
+        try:
+            for k, v in self._saved.items():
+                setattr(self, k, copy.deepcopy(v))
+        except Exception:  # noqa: BLE001 — damaged snapshot
+            if not self._prev_saved:
+                raise
+            logger.warning(
+                "last commit unusable — rolling back one more commit")
+            self._saved = self._prev_saved
+            for k, v in self._saved.items():
+                setattr(self, k, copy.deepcopy(v))
 
     def sync(self) -> None:
         synced = F.broadcast_object(
@@ -156,20 +174,37 @@ class TpuState(ObjectState):
         )
 
     def save(self) -> None:
-        self._saved = {
+        # Snapshot fully before swapping (see ObjectState.save): a
+        # collective failure can kill the backend mid-`_to_host`, and a
+        # partial `_saved` would corrupt the very state restore needs.
+        snap = {
             "params": self._to_host(self.params),
             "opt_state": self._to_host(self.opt_state),
         }
         for k in self._known:
             if k not in ("params", "opt_state"):
-                self._saved[k] = copy.deepcopy(getattr(self, k))
+                snap[k] = copy.deepcopy(getattr(self, k))
+        if self._saved:
+            self._prev_saved = self._saved
+        self._saved = snap
 
-    def restore(self) -> None:
-        self.params = self._saved["params"]
-        self.opt_state = self._saved["opt_state"]
+    def _restore_from(self, saved: Dict[str, Any]) -> None:
+        self.params = saved["params"]
+        self.opt_state = saved["opt_state"]
         for k in self._known:
             if k not in ("params", "opt_state"):
-                setattr(self, k, copy.deepcopy(self._saved[k]))
+                setattr(self, k, copy.deepcopy(saved[k]))
+
+    def restore(self) -> None:
+        try:
+            self._restore_from(self._saved)
+        except Exception:  # noqa: BLE001 — damaged snapshot
+            if not self._prev_saved:
+                raise
+            logger.warning(
+                "last commit unusable — rolling back one more commit")
+            self._saved = self._prev_saved
+            self._restore_from(self._saved)
 
     def on_hosts_updated(self) -> None:
         # A membership change keeps the CURRENT (post-commit) values, but
@@ -260,21 +295,22 @@ def _reset() -> None:
     if have_client:
         # The driver may be mid-restart of the rendezvous server or not yet
         # have published the next generation — retry transient failures
-        # instead of killing a healthy worker.
-        last_err = None
-        for _ in range(15):
-            try:
-                refresh_from_control_plane()
-                last_err = None
-                break
-            except HorovodInternalError:
-                raise
-            except Exception as e:  # HorovodTpuError, socket errors
-                last_err = e
-                time.sleep(2.0)
-        if last_err is not None:
+        # under the shared policy instead of killing a healthy worker.
+        # (Capped backoff ~2s preserves the old loop's ~30s patience;
+        # HOROVOD_RESET_RETRY_* tunes it.)
+        try:
+            RetryPolicy.from_env(
+                "RESET", max_attempts=15, base_delay=0.5, multiplier=2.0,
+                max_delay=2.0, jitter=0.1).run(
+                refresh_from_control_plane,
+                retry_on=(Exception,),
+                give_up_on=(HorovodInternalError,),
+                site="elastic.reset")
+        except HorovodInternalError:
+            raise
+        except Exception as e:  # HorovodTpuError, socket errors
             raise HorovodInternalError(
-                f"cannot re-rendezvous with elastic driver: {last_err}")
+                f"cannot re-rendezvous with elastic driver: {e}") from e
     basics.init()
 
 

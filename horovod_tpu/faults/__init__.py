@@ -1,0 +1,169 @@
+"""Deterministic fault injection for every failure path in the runtime.
+
+Named fault points are threaded through the control plane (rendezvous),
+the collectives' eager bracket, the elastic driver, worker heartbeats,
+and checkpoint I/O.  A seeded schedule parsed from ``HOROVOD_FAULT_SPEC``
+decides, per call, whether a point errors, delays, hangs, or kills the
+process — so CI can replay an exact failure sequence and chaos runs are
+reproducible from (spec, seed) alone.
+
+    HOROVOD_FAULT_SPEC="rendezvous.put:err:0.1,collective.allreduce:delay:50ms"
+    HOROVOD_FAULT_SEED=7            # replay key (default 0)
+    HOROVOD_FAULT_HOSTS=hostB       # only activate on these HOROVOD_HOSTNAMEs
+
+Instrumented code calls ``faults.point("rendezvous.put")`` — a no-op
+(one None check) when no schedule is installed.  Every injection counts
+into ``hvd_fault_injections_total{point,mode}``.
+
+The catalog below is the closed set of point names; `point()` refuses
+unknown names while a schedule is active, and
+``scripts/check_fault_points.py`` lints code/catalog/docs drift the same
+way the metrics catalog is linted.
+
+See docs/FAULT_TOLERANCE.md for the full grammar and recipes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import List, Optional
+
+from ..common.exceptions import HorovodTpuError
+from .retry import RetryPolicy  # noqa: F401  (re-export)
+from .spec import (  # noqa: F401  (re-export)
+    FaultAction,
+    FaultInjected,
+    FaultSchedule,
+    parse_duration,
+    parse_spec,
+)
+
+logger = logging.getLogger("horovod_tpu.faults")
+
+__all__ = [
+    "CATALOG", "FaultInjected", "FaultSchedule", "RetryPolicy",
+    "active", "clear", "install", "parse_spec", "point",
+]
+
+# Every fault point the runtime exposes.  Kept flat + literal so the lint
+# script can parse it without importing jax.
+CATALOG = {
+    # control plane (runner/rendezvous.py, client side)
+    "rendezvous.connect":
+        "Before a client TCP connect to the rendezvous server.",
+    "rendezvous.put": "Before a client PUT request.",
+    "rendezvous.get": "Before a client GET request.",
+    "rendezvous.wait": "Before a client WAIT request.",
+    "rendezvous.delete": "Before a client DEL request.",
+    "rendezvous.keys": "Before a client KEYS request.",
+    "rendezvous.barrier": "Before a client BARRIER request.",
+    # collectives (ops/collectives.py `_traced.__enter__`); injected
+    # errors surface as HorovodInternalError — the elastic recovery path.
+    "collective.allreduce": "Eager allreduce dispatch.",
+    "collective.allgather": "Eager allgather dispatch.",
+    "collective.allgather_sizes": "Allgather size-exchange dispatch.",
+    "collective.broadcast": "Eager broadcast dispatch.",
+    "collective.alltoall": "Eager alltoall dispatch.",
+    "collective.alltoall_splits": "Alltoall split-exchange dispatch.",
+    "collective.reducescatter": "Eager reducescatter dispatch.",
+    # elastic driver (runner/elastic/driver.py)
+    "elastic.publish": "Before the driver publishes a new generation.",
+    "elastic.spawn": "Before the driver spawns one worker process.",
+    # elastic worker (runner/elastic_worker.py)
+    "worker.heartbeat":
+        "Before a worker publishes one heartbeat (err = dropped beat, "
+        "hang = silent worker: alive but lease-expiring).",
+    "worker.refresh":
+        "Before a worker fetches the current generation info.",
+    # state / checkpoint I/O (elastic/__init__.py, utils/checkpoint.py)
+    "state.commit": "Inside State.commit, before the snapshot.",
+    "checkpoint.save": "Before a durable checkpoint write.",
+    "checkpoint.restore": "Before a durable checkpoint read.",
+}
+
+_lock = threading.Lock()
+_schedule: Optional[FaultSchedule] = None
+_env_loaded = False
+
+
+def _load_from_env() -> Optional[FaultSchedule]:
+    spec = os.environ.get("HOROVOD_FAULT_SPEC") \
+        or os.environ.get("HVD_TPU_FAULT_SPEC")
+    if not spec:
+        return None
+    hosts = os.environ.get("HOROVOD_FAULT_HOSTS")
+    if hosts:
+        me = os.environ.get("HOROVOD_HOSTNAME", "")
+        if me not in [h.strip() for h in hosts.split(",") if h.strip()]:
+            logger.debug("fault spec scoped to %s; %r not in scope",
+                         hosts, me)
+            return None
+    seed = int(os.environ.get("HOROVOD_FAULT_SEED", "0"))
+    actions = parse_spec(spec)
+    for a in actions:
+        if a.point not in CATALOG:
+            raise HorovodTpuError(
+                f"HOROVOD_FAULT_SPEC names unknown fault point "
+                f"{a.point!r}; known points: {sorted(CATALOG)}")
+    sched = FaultSchedule(actions, seed=seed)
+    logger.warning("fault injection armed (seed=%d): %s", seed,
+                   sched.points)
+    return sched
+
+
+def _current() -> Optional[FaultSchedule]:
+    global _schedule, _env_loaded
+    if not _env_loaded:
+        with _lock:
+            if not _env_loaded:
+                _schedule = _load_from_env()
+                _env_loaded = True
+    return _schedule
+
+
+def install(spec, seed: int = 0) -> FaultSchedule:
+    """Programmatically arm a schedule (tests, chaos harnesses).  `spec`
+    is a spec string or a FaultSchedule."""
+    global _schedule, _env_loaded
+    sched = spec if isinstance(spec, FaultSchedule) else \
+        FaultSchedule(parse_spec(spec), seed=seed)
+    with _lock:
+        _schedule = sched
+        _env_loaded = True
+    return sched
+
+
+def clear() -> None:
+    """Disarm fault injection (env spec is NOT re-read afterwards)."""
+    global _schedule, _env_loaded
+    with _lock:
+        _schedule = None
+        _env_loaded = True
+
+
+def active() -> bool:
+    """True when a schedule is armed — call-site guard for hot paths
+    that would otherwise build the point name per call."""
+    return _current() is not None
+
+
+def point(name: str) -> None:
+    """Fire fault point `name`: no-op without a schedule; otherwise may
+    raise FaultInjected, sleep, or exit per the armed spec."""
+    sched = _current()
+    if sched is None:
+        return
+    if name not in CATALOG:
+        raise HorovodTpuError(
+            f"fault point {name!r} is not registered in faults.CATALOG "
+            "(add it there and to docs/FAULT_TOLERANCE.md)")
+    sched.fire(name)
+
+
+def points_hit(name: str) -> int:
+    """How many times `name` fired under the current schedule (0 when
+    disarmed) — test/assert helper."""
+    sched = _current()
+    return sched.call_count(name) if sched is not None else 0

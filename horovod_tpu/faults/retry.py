@@ -1,0 +1,131 @@
+"""Shared retry policy: exponential backoff + jitter + deadline.
+
+One policy class used by every control-plane retry loop in the runtime
+(rendezvous connects, idempotent KV reads, elastic re-rendezvous) so the
+knobs live in one place and every retry shows up in one metric
+(`hvd_retries_total{site}`).
+
+Env tuning — global defaults, overridable per site prefix::
+
+    HOROVOD_RETRY_MAX_ATTEMPTS / HOROVOD_<SITE>_RETRY_MAX_ATTEMPTS
+    HOROVOD_RETRY_BASE_DELAY   / HOROVOD_<SITE>_RETRY_BASE_DELAY    (s)
+    HOROVOD_RETRY_MAX_DELAY    / HOROVOD_<SITE>_RETRY_MAX_DELAY     (s)
+    HOROVOD_RETRY_MULTIPLIER   / HOROVOD_<SITE>_RETRY_MULTIPLIER
+    HOROVOD_RETRY_JITTER       / HOROVOD_<SITE>_RETRY_JITTER  (fraction)
+    HOROVOD_RETRY_DEADLINE     / HOROVOD_<SITE>_RETRY_DEADLINE      (s)
+
+e.g. `HOROVOD_RENDEZVOUS_RETRY_MAX_ATTEMPTS=10` raises only the
+rendezvous client's connect attempts.  `HVD_TPU_` prefixes work too
+(common/util.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from ..common import util
+
+logger = logging.getLogger("horovod_tpu.faults.retry")
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Exponential backoff: attempt k (0-based) sleeps
+    ``min(base_delay * multiplier**k, max_delay)`` plus up to ``jitter``
+    fraction of that, bounded by ``max_attempts`` tries and an optional
+    wall-clock ``deadline`` over the whole loop."""
+
+    max_attempts: int = 5
+    base_delay: float = 0.5
+    max_delay: float = 30.0
+    multiplier: float = 2.0
+    jitter: float = 0.1
+    deadline: Optional[float] = None
+    seed: Optional[int] = None
+
+    @classmethod
+    def from_env(cls, site: str = "", **defaults) -> "RetryPolicy":
+        """Build a policy from env, most-specific wins:
+        HOROVOD_<SITE>_RETRY_* > HOROVOD_RETRY_* > `defaults` kwargs >
+        the dataclass defaults."""
+        base = cls(**defaults)
+        pre = f"{site.upper()}_RETRY" if site else "RETRY"
+
+        def _f(name: str, cur: float) -> float:
+            return util.env_float(
+                f"{pre}_{name}", util.env_float(f"RETRY_{name}", cur))
+
+        deadline = base.deadline if base.deadline is not None else -1.0
+        deadline = _f("DEADLINE", deadline)
+        return cls(
+            max_attempts=util.env_int(
+                f"{pre}_MAX_ATTEMPTS",
+                util.env_int("RETRY_MAX_ATTEMPTS", base.max_attempts)),
+            base_delay=_f("BASE_DELAY", base.base_delay),
+            max_delay=_f("MAX_DELAY", base.max_delay),
+            multiplier=_f("MULTIPLIER", base.multiplier),
+            jitter=_f("JITTER", base.jitter),
+            deadline=None if deadline < 0 else deadline,
+            seed=base.seed,
+        )
+
+    def backoff(self, attempt: int) -> float:
+        """Deterministic (jitter-free) delay after 0-based `attempt`."""
+        return min(self.base_delay * self.multiplier ** attempt,
+                   self.max_delay)
+
+    def delays(self, rng: Optional[random.Random] = None):
+        """The sleep sequence between attempts (len == max_attempts-1)."""
+        rng = rng or random.Random(self.seed)
+        for k in range(max(0, self.max_attempts - 1)):
+            d = self.backoff(k)
+            yield d + d * self.jitter * rng.random()
+
+    def run(self, fn: Callable,
+            retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+            give_up_on: Tuple[Type[BaseException], ...] = (),
+            site: str = "retry",
+            sleep: Callable[[float], None] = time.sleep):
+        """Call `fn()` under this policy.  Exceptions in `give_up_on`
+        propagate immediately; `retry_on` ones are retried until attempts
+        or deadline run out, then the last error is re-raised.  Each
+        retry increments `hvd_retries_total{site}`."""
+        start = time.monotonic()
+        rng = random.Random(self.seed)
+        last: Optional[BaseException] = None
+        for attempt in range(max(1, self.max_attempts)):
+            try:
+                return fn()
+            except give_up_on:
+                raise
+            except retry_on as e:
+                last = e
+                if attempt >= self.max_attempts - 1:
+                    break
+                d = self.backoff(attempt)
+                d += d * self.jitter * rng.random()
+                if (self.deadline is not None
+                        and time.monotonic() - start + d > self.deadline):
+                    logger.debug("%s: deadline %.1fs exhausted after "
+                                 "attempt %d", site, self.deadline,
+                                 attempt + 1)
+                    break
+                _record_retry(site)
+                logger.debug("%s: attempt %d failed (%s); retrying in "
+                             "%.2fs", site, attempt + 1, e, d)
+                sleep(d)
+        assert last is not None
+        raise last
+
+
+def _record_retry(site: str) -> None:
+    try:
+        from ..metrics import catalog as _met
+        if _met.enabled():
+            _met.retries.labels(site).inc()
+    except Exception:  # noqa: BLE001 — retries must not fail on telemetry
+        pass

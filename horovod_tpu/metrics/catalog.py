@@ -80,6 +80,34 @@ elastic_rank_removed = _REG.counter(
 elastic_restarts = _REG.counter(
     "hvd_elastic_restarts_total",
     "Elastic generation resets (driver reset_count increments).")
+elastic_slots = _REG.gauge(
+    "hvd_elastic_slots",
+    "Worker slots in the currently-published generation (driver-side; "
+    "below the requested np = degraded mode).")
+
+# -- fault tolerance (faults/, runner/elastic/driver.py, checkpoint) --------
+fault_injections = _REG.counter(
+    "hvd_fault_injections_total",
+    "Faults injected by the HOROVOD_FAULT_SPEC schedule, by point/mode.",
+    ("point", "mode"))
+retries = _REG.counter(
+    "hvd_retries_total",
+    "RetryPolicy retries (sleep-then-reattempt events), by call site.",
+    ("site",))
+worker_lease_expired = _REG.counter(
+    "hvd_worker_lease_expired_total",
+    "Workers declared failed because their heartbeat lease expired "
+    "while the process was still alive (driver-side).")
+worker_respawns = _REG.counter(
+    "hvd_worker_respawns_total",
+    "Worker processes respawned after a failure (driver-side).")
+hosts_blacklisted = _REG.counter(
+    "hvd_hosts_blacklisted_total",
+    "Hosts blacklisted (failure strikes or respawn budget exhausted).")
+checkpoint_rollbacks = _REG.counter(
+    "hvd_checkpoint_rollbacks_total",
+    "Corrupt durable checkpoints skipped during restore (rolled back "
+    "to an older good step).")
 
 _enabled = not util.env_bool("METRICS_DISABLE", False)
 

@@ -47,9 +47,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import faults as _faults
 from ..common import basics
 from ..common.basics import GLOBAL_AXIS, ProcessSet
-from ..common.exceptions import HorovodTpuError
+from ..common.exceptions import HorovodInternalError, HorovodTpuError
+from ..faults import FaultInjected
 from ..metrics import catalog as _met
 from ..utils import consistency as _cc
 from ..utils import stall_inspector as _stall
@@ -169,6 +171,16 @@ class _traced:
         self._ps = 0
 
     def __enter__(self):
+        if _faults.active():
+            # Injected errors surface as HorovodInternalError — the same
+            # class a real mid-flight collective failure raises — so the
+            # elastic restore/re-init path is what gets exercised.
+            pt = f"collective.{self._kind.lower()}"
+            if pt in _faults.CATALOG:
+                try:
+                    _faults.point(pt)
+                except FaultInjected as e:
+                    raise HorovodInternalError(str(e)) from e
         if self._si is not None:
             self._key = self._si.record_start(self._desc)
         if self._tl is not None:

@@ -19,14 +19,65 @@ import threading
 import time
 from typing import Optional
 
+from .. import faults as _faults
+from ..common import util as _util
 from ..common.exceptions import HorovodTpuError
+from ..faults import FaultInjected, RetryPolicy
 from .rendezvous import RendezvousClient
 
 logger = logging.getLogger("horovod_tpu.runner.elastic_worker")
 
 _POLL_INTERVAL_S = 0.5
 _client_thread: Optional[threading.Thread] = None
+_heartbeat_thread: Optional[threading.Thread] = None
 _known_gen = -1
+
+# Distinguishes this incarnation's heartbeats from a predecessor's on the
+# same host:slot — the driver detects liveness by VALUE CHANGE, so two
+# incarnations must never publish identical payloads.
+_HEARTBEAT_NONCE = f"{os.getpid():x}-{os.urandom(4).hex()}"
+
+
+def lease_ttl() -> float:
+    """Heartbeat lease TTL in seconds (0 disables heartbeats).  The
+    driver injects its resolved value into worker env so both sides
+    agree on the deadline."""
+    return _util.env_float("ELASTIC_LEASE_TTL", 15.0)
+
+
+def heartbeat_key() -> str:
+    return ("elastic/heartbeat/"
+            f"{os.environ.get('HOROVOD_HOSTNAME', 'localhost')}:"
+            f"{os.environ.get('HOROVOD_SLOT', '0')}")
+
+
+def publish_heartbeat(client: RendezvousClient, seq: int,
+                      ttl: float) -> None:
+    """One TTL'd heartbeat: a changing KV value (driver watches for
+    change with its own clock — immune to cross-host clock skew) plus a
+    server-side lease renewal for barrier fast-fail (Python engine)."""
+    _faults.point("worker.heartbeat")
+    key = heartbeat_key()
+    client.put(key, json.dumps(
+        {"seq": seq, "nonce": _HEARTBEAT_NONCE, "ts": time.time()}))
+    client.renew_lease(f"worker/{key.rsplit('/', 1)[1]}", ttl)
+
+
+def _heartbeat_loop(ttl: float) -> None:
+    interval = _util.env_float(
+        "HEARTBEAT_INTERVAL", max(ttl / 3.0, 0.5))
+    client = client_from_env()
+    seq = 0
+    while True:
+        seq += 1
+        try:
+            publish_heartbeat(client, seq, ttl)
+        except FaultInjected:
+            logger.warning("heartbeat %d dropped (injected fault)", seq)
+        except Exception:  # noqa: BLE001 — keep beating through restarts
+            logger.debug("heartbeat %d failed (server mid-restart?)", seq,
+                         exc_info=True)
+        time.sleep(interval)
 
 
 def _elastic_env() -> bool:
@@ -59,6 +110,7 @@ def refresh_from_control_plane(timeout: float = 60.0) -> dict:
     longer assigned, exits cleanly (the driver is tearing us down).
     """
     global _known_gen
+    _faults.point("worker.refresh")
     client = client_from_env()
     gen = current_generation(client)
     if gen < 0:
@@ -109,13 +161,26 @@ def _poll_loop() -> None:
 
 def maybe_start_notification_client() -> None:
     """Called from `hvd.elastic.run`'s wrapper (reference:
-    WorkerNotificationManager.init)."""
-    global _client_thread
+    WorkerNotificationManager.init).  Starts the generation-watch thread
+    and the heartbeat-lease publisher.  The initial registration runs
+    under the shared RetryPolicy: a worker spawned while the driver is
+    still publishing the first generation must not die on the race."""
+    global _client_thread, _heartbeat_thread
     if not _elastic_env() or _client_thread is not None:
         return
-    refresh_from_control_plane()
+    RetryPolicy.from_env(
+        "REGISTRATION", max_attempts=10, base_delay=0.5,
+        multiplier=2.0, max_delay=4.0, jitter=0.2).run(
+        refresh_from_control_plane,
+        retry_on=(HorovodTpuError, OSError),
+        site="worker.registration")
     _client_thread = threading.Thread(target=_poll_loop, daemon=True)
     _client_thread.start()
+    ttl = lease_ttl()
+    if ttl > 0 and _heartbeat_thread is None:
+        _heartbeat_thread = threading.Thread(
+            target=_heartbeat_loop, args=(ttl,), daemon=True)
+        _heartbeat_thread.start()
 
 
 def is_joining_worker() -> bool:

@@ -89,6 +89,19 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         help="Slots per discovered host (elastic).")
     parser.add_argument("--reset-limit", type=int, dest="reset_limit")
 
+    # Fault tolerance (defaults resolve from HOROVOD_* env; see
+    # docs/FAULT_TOLERANCE.md).
+    parser.add_argument("--lease-ttl", type=float, dest="lease_ttl",
+                        help="Heartbeat lease TTL seconds (0 disables).")
+    parser.add_argument("--lease-start-grace", type=float,
+                        dest="lease_start_grace",
+                        help="Heartbeat silence allowed after spawn.")
+    parser.add_argument("--blacklist-threshold", type=int,
+                        dest="blacklist_threshold",
+                        help="Failure strikes before a host is blacklisted.")
+    parser.add_argument("--max-respawns", type=int, dest="max_respawns",
+                        help="Per-host respawn budget before blacklisting.")
+
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="Training command to run on every slot.")
     return parser.parse_args(argv)
@@ -171,6 +184,10 @@ def make_settings(args: argparse.Namespace) -> Settings:
         host_discovery_script=args.host_discovery_script,
         slots_per_host=args.slots_per_host,
         reset_limit=args.reset_limit,
+        lease_ttl=args.lease_ttl,
+        lease_start_grace=args.lease_start_grace,
+        blacklist_threshold=args.blacklist_threshold,
+        max_respawns=args.max_respawns,
     )
 
 

@@ -31,7 +31,12 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..common.exceptions import HorovodTpuError
+from ..common.exceptions import (
+    HorovodTpuError,
+    RendezvousConnectionError,
+)
+from .. import faults as _faults
+from ..faults import FaultInjected, RetryPolicy
 
 logger = logging.getLogger("horovod_tpu.runner.rendezvous")
 
@@ -72,6 +77,9 @@ class KVStore:
         self._cv = threading.Condition()
         # barrier name -> (generation, arrived_count)
         self._barriers: Dict[str, Tuple[int, int]] = {}
+        # lease name -> monotonic expiry deadline (heartbeat leases:
+        # workers renew, barrier waiters fail fast on expiry)
+        self._leases: Dict[str, float] = {}
 
     def put(self, key: str, value: str) -> None:
         with self._cv:
@@ -101,12 +109,44 @@ class KVStore:
         with self._cv:
             return sorted(k for k in self._data if k.startswith(prefix))
 
-    def barrier(self, name: str, count: int, timeout: float) -> bool:
+    # -- leases ----------------------------------------------------------
+    def renew_lease(self, name: str, ttl: float) -> None:
+        """Refresh lease `name` for `ttl` seconds (ttl <= 0 revokes)."""
+        with self._cv:
+            self._leases[name] = time.monotonic() + ttl
+            self._cv.notify_all()
+
+    def lease_expired(self, name: str) -> bool:
+        """True only for a lease that was granted and has lapsed.  A name
+        never leased reads as NOT expired — barrier participants without
+        heartbeats degrade to plain timeout semantics."""
+        with self._cv:
+            deadline = self._leases.get(name)
+            return deadline is not None and deadline <= time.monotonic()
+
+    def _nearest_lease_expiry(self, names) -> Optional[float]:
+        """Soonest expiry among known leases in `names` (caller holds
+        the cv)."""
+        deadlines = [self._leases[n] for n in names if n in self._leases]
+        return min(deadlines) if deadlines else None
+
+    def barrier(self, name: str, count: int, timeout: float,
+                participants: Optional[List[str]] = None) -> bool:
         """Block until `count` callers reach barrier `name`.  Generation
         counter makes the barrier reusable (successive barriers with the
-        same name don't bleed into each other)."""
+        same name don't bleed into each other).
+
+        `participants` optionally names the lease of every expected
+        participant: if any of those leases expires mid-barrier the wait
+        fails promptly (within a lease-check wakeup, not the full
+        `timeout`) — a dead worker must not stall the fleet for the
+        whole barrier deadline."""
         deadline = time.monotonic() + timeout
         with self._cv:
+            if participants:
+                for p in participants:
+                    if self.lease_expired_locked(p):
+                        return False  # known-dead peer: don't even arrive
             gen, arrived = self._barriers.get(name, (0, 0))
             arrived += 1
             my_gen = gen
@@ -116,20 +156,38 @@ class KVStore:
                 return True
             self._barriers[name] = (gen, arrived)
             while True:
+                # Order matters: release check FIRST, so a barrier that
+                # completed in the same instant a deadline/lease lapsed
+                # still reports success.
                 cur_gen, _ = self._barriers.get(name, (0, 0))
                 if cur_gen > my_gen:
                     return True
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 or not self._cv.wait(remaining):
-                    # Re-check before withdrawing: the last participant may
-                    # have released the barrier in the same instant our
-                    # deadline expired.
+                now = time.monotonic()
+                expired = participants and any(
+                    self.lease_expired_locked(p) for p in participants)
+                if expired or now >= deadline:
+                    # A lapsed peer can never arrive — fail fast instead
+                    # of waiting out the timeout.  Withdraw our arrival
+                    # so a retry with surviving membership starts clean.
                     g, a = self._barriers.get(name, (0, 0))
-                    if g > my_gen:
-                        return True
                     if g == my_gen and a > 0:
                         self._barriers[name] = (g, a - 1)
                     return False
+                wait_for = deadline - now
+                if participants:
+                    nearest = self._nearest_lease_expiry(participants)
+                    if nearest is not None:
+                        # Wake at the next possible lease expiry (plus a
+                        # hair for clock granularity) even if nobody
+                        # notifies — that's what makes the failure prompt.
+                        wait_for = min(wait_for,
+                                       max(nearest - now, 0.0) + 0.01)
+                self._cv.wait(wait_for)
+
+    def lease_expired_locked(self, name: str) -> bool:
+        """lease_expired for callers already holding the cv."""
+        deadline = self._leases.get(name)
+        return deadline is not None and deadline <= time.monotonic()
 
 
 class _LoopbackStore:
@@ -157,12 +215,22 @@ class _LoopbackStore:
     def keys(self, prefix: str = "") -> List[str]:
         return self._c.keys(prefix)
 
-    def barrier(self, name: str, count: int, timeout: float) -> bool:
+    def barrier(self, name: str, count: int, timeout: float,
+                participants: Optional[List[str]] = None) -> bool:
         try:
-            self._c.barrier(name, count, timeout)
+            self._c.barrier(name, count, timeout,
+                            participants=participants)
             return True
         except HorovodTpuError:
             return False
+
+    def renew_lease(self, name: str, ttl: float) -> bool:
+        return self._c.renew_lease(name, ttl)
+
+    def lease_expired(self, name: str) -> bool:
+        # The native engine has no lease table (yet): absent lease reads
+        # as not-expired, matching KVStore semantics for unknown names.
+        return False
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -231,8 +299,12 @@ class RendezvousServer:
             return {"ok": True, "keys": self.store.keys(req.get("prefix", ""))}
         if op == "BARRIER":
             ok = self.store.barrier(req["name"], int(req["count"]),
-                                    float(req.get("timeout", 30)))
+                                    float(req.get("timeout", 30)),
+                                    participants=req.get("participants"))
             return {"ok": ok} if ok else {"ok": False, "error": "barrier timeout"}
+        if op == "LEASE":
+            self.store.renew_lease(req["name"], float(req.get("ttl", 0)))
+            return {"ok": True}
         if op == "PING":
             return {"ok": True, "value": "pong"}
         if op == "SHUTDOWN":
@@ -287,34 +359,43 @@ class RendezvousServer:
 class RendezvousClient:
     """Worker-side client (reference: runner/http/http_client.py).
 
-    One short-lived connection per request; retries with backoff so
-    workers can start before the server."""
+    One short-lived connection per request.  Two retry layers, both
+    driven by the shared RetryPolicy (faults/retry.py):
+
+      - every request retries the *connection* (the server may not be up
+        yet, or mid-restart);
+      - idempotent ops (GET/WAIT/KEYS/PING) additionally retry
+        transport failures *mid-flight* — re-reading a key is safe.
+        Non-idempotent ops (PUT/BARRIER arrival) never re-send: the
+        request may already have been delivered and applied.
+    """
 
     def __init__(self, addr: str, port: int, secret: str,
-                 connect_retries: int = 3):
+                 connect_retries: int = 3,
+                 retry: Optional[RetryPolicy] = None):
         self.addr = addr
         self.port = port
         self.secret = secret
-        self.connect_retries = connect_retries
+        self.retry = retry or RetryPolicy.from_env(
+            "RENDEZVOUS", max_attempts=connect_retries,
+            base_delay=0.5, multiplier=2.0, max_delay=5.0, jitter=0.1)
+        self.connect_retries = self.retry.max_attempts
 
-    def _request(self, req: dict, timeout: float = 60.0) -> dict:
-        # Retry only the *connection*; once the request is on the wire it
-        # may have been delivered, and re-sending a non-idempotent op
-        # (BARRIER arrival, PUT) would double-count it.
-        last_err: Optional[Exception] = None
-        sock = None
-        for attempt in range(self.connect_retries):
-            try:
-                sock = socket.create_connection(
-                    (self.addr, self.port), timeout=timeout)
-                break
-            except (ConnectionError, socket.timeout, OSError) as e:
-                last_err = e
-                time.sleep(0.5 * (attempt + 1))
-        if sock is None:
-            raise HorovodTpuError(
-                f"Cannot reach rendezvous server {self.addr}:{self.port}: "
-                f"{last_err}")
+    def _connect(self, timeout: float) -> socket.socket:
+        _faults.point("rendezvous.connect")
+        try:
+            return socket.create_connection(
+                (self.addr, self.port), timeout=timeout)
+        except (ConnectionError, socket.timeout, OSError) as e:
+            raise RendezvousConnectionError(
+                f"Cannot reach rendezvous server "
+                f"{self.addr}:{self.port}: {e}") from e
+
+    def _request_once(self, req: dict, timeout: float) -> dict:
+        sock = self.retry.run(
+            lambda: self._connect(timeout),
+            retry_on=(RendezvousConnectionError, FaultInjected),
+            site="rendezvous.connect")
         try:
             with sock:
                 sock.sendall(_encode(self.secret, req))
@@ -324,40 +405,70 @@ class RendezvousClient:
                     raise ConnectionError("empty rendezvous response")
                 return _decode(self.secret, line)
         except (ConnectionError, socket.timeout, OSError) as e:
-            raise HorovodTpuError(
+            raise RendezvousConnectionError(
                 f"Rendezvous request {req.get('op')} to "
                 f"{self.addr}:{self.port} failed mid-flight: {e}") from e
 
+    def _request(self, req: dict, timeout: float = 60.0,
+                 idempotent: bool = False) -> dict:
+        if not idempotent:
+            return self._request_once(req, timeout)
+        return self.retry.run(
+            lambda: self._request_once(req, timeout),
+            retry_on=(RendezvousConnectionError,),
+            site=f"rendezvous.{req.get('op', '?').lower()}")
+
     def put(self, key: str, value: str) -> None:
+        _faults.point("rendezvous.put")
         resp = self._request({"op": "PUT", "key": key, "value": value})
         if not resp.get("ok"):
             raise HorovodTpuError(resp.get("error", "PUT failed"))
 
     def get(self, key: str) -> Optional[str]:
-        resp = self._request({"op": "GET", "key": key})
+        _faults.point("rendezvous.get")
+        resp = self._request({"op": "GET", "key": key}, idempotent=True)
         return resp.get("value")
 
     def wait(self, key: str, timeout: float = 30.0) -> str:
+        _faults.point("rendezvous.wait")
         resp = self._request({"op": "WAIT", "key": key, "timeout": timeout},
-                             timeout=timeout + 10)
+                             timeout=timeout + 10, idempotent=True)
         if not resp.get("ok"):
             raise HorovodTpuError(resp.get("error", f"WAIT {key} failed"))
         return resp["value"]
 
     def delete(self, key: str) -> bool:
+        _faults.point("rendezvous.delete")
         return bool(self._request({"op": "DEL", "key": key}).get("ok"))
 
     def keys(self, prefix: str = "") -> List[str]:
-        return self._request({"op": "KEYS", "prefix": prefix}).get("keys", [])
+        _faults.point("rendezvous.keys")
+        return self._request({"op": "KEYS", "prefix": prefix},
+                             idempotent=True).get("keys", [])
 
-    def barrier(self, name: str, count: int, timeout: float = 30.0) -> None:
-        resp = self._request(
-            {"op": "BARRIER", "name": name, "count": count,
-             "timeout": timeout},
-            timeout=timeout + 10)
+    def barrier(self, name: str, count: int, timeout: float = 30.0,
+                participants: Optional[List[str]] = None) -> None:
+        _faults.point("rendezvous.barrier")
+        req = {"op": "BARRIER", "name": name, "count": count,
+               "timeout": timeout}
+        if participants:
+            req["participants"] = list(participants)
+        resp = self._request(req, timeout=timeout + 10)
         if not resp.get("ok"):
             raise HorovodTpuError(
                 resp.get("error", f"barrier {name} failed"))
+
+    def renew_lease(self, name: str, ttl: float) -> bool:
+        """Refresh heartbeat lease `name`.  Best-effort: returns False
+        instead of raising when the engine doesn't support leases (the
+        native C++ server) or the server is unreachable — a missed renew
+        must never kill an otherwise-healthy worker."""
+        try:
+            return bool(
+                self._request({"op": "LEASE", "name": name,
+                               "ttl": ttl}).get("ok"))
+        except HorovodTpuError:
+            return False
 
     def ping(self) -> bool:
         try:

@@ -47,6 +47,13 @@ class Settings:
     slots_per_host: Optional[int] = None
     reset_limit: Optional[int] = None
 
+    # Fault tolerance (None = resolve from HOROVOD_* env, see
+    # ElasticDriver.__init__ and docs/FAULT_TOLERANCE.md).
+    lease_ttl: Optional[float] = None          # heartbeat lease TTL (s)
+    lease_start_grace: Optional[float] = None  # silence allowed post-spawn
+    blacklist_threshold: Optional[int] = None  # strikes before blacklist
+    max_respawns: Optional[int] = None         # per-host respawn budget
+
     # Rendezvous / coordination (filled by the launch path).
     rendezvous_addr: Optional[str] = None
     rendezvous_port: Optional[int] = None

@@ -28,6 +28,7 @@ Two storage paths behind one API, chosen by the runtime mode:
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import pickle
@@ -35,11 +36,15 @@ import re
 import shutil
 from typing import Any, Callable, List, Optional
 
+from .. import faults as _faults
 from ..common import basics
+from ..common.exceptions import CheckpointCorruptError
+from ..metrics import catalog as _met
 
 logger = logging.getLogger("horovod_tpu.checkpoint")
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+_DIGEST_FILE = "state.sha256"
 
 
 def _to_host(tree: Any) -> Any:
@@ -81,6 +86,7 @@ class CheckpointManager:
         durable data (the Horovod convention — every example and keras
         callback in the reference guards on `hvd.rank() == 0`); other
         ranks no-op and return False."""
+        _faults.point("checkpoint.save")
         if not self._multiprocess():
             import orbax.checkpoint as ocp
 
@@ -94,9 +100,22 @@ class CheckpointManager:
         host = _to_host(state)
         final = os.path.join(self._dir, f"step_{step}")
         tmp = final + ".tmp"
-        os.makedirs(tmp, exist_ok=True)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)  # leftovers from a crash mid-save
+        os.makedirs(tmp)
+        # Payload + digest sidecar, both fsync'd, then one atomic rename:
+        # a crash at ANY point leaves either the previous complete
+        # checkpoint or a .tmp dir that the next save sweeps away — never
+        # a truncated step_N that restore would trust.
+        blob = pickle.dumps(host)
         with open(os.path.join(tmp, "state.pkl"), "wb") as f:
-            pickle.dump(host, f)
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(tmp, _DIGEST_FILE), "w") as f:
+            f.write(hashlib.sha256(blob).hexdigest())
+            f.flush()
+            os.fsync(f.fileno())
         if os.path.exists(final):
             shutil.rmtree(final)
         os.replace(tmp, final)  # atomic publish
@@ -147,6 +166,7 @@ class CheckpointManager:
         return broadcast_object(mine, root_rank=0)
 
     def _read(self, step: int, template: Any) -> Any:
+        _faults.point("checkpoint.restore")
         if not self._multiprocess():
             import orbax.checkpoint as ocp
 
@@ -155,12 +175,65 @@ class CheckpointManager:
                 return mgr.restore(
                     step, args=ocp.args.StandardRestore(template))
             return mgr.restore(step)
-        with open(os.path.join(self._dir, f"step_{step}", "state.pkl"),
-                  "rb") as f:
-            return pickle.load(f)
+        return self._read_pickle(step)
 
-    def _restore_bcast(self, choose_step: Callable[[], Optional[int]],
-                       template: Any) -> Optional[Any]:
+    def _read_pickle(self, step: int) -> Any:
+        """Read + verify one pickle checkpoint.  Any integrity problem
+        (digest mismatch, truncation, unreadable payload) surfaces as
+        CheckpointCorruptError so callers can roll back."""
+        path = os.path.join(self._dir, f"step_{step}", "state.pkl")
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} unreadable: {e}") from e
+        digest_path = os.path.join(
+            self._dir, f"step_{step}", _DIGEST_FILE)
+        if os.path.exists(digest_path):  # pre-digest checkpoints pass
+            with open(digest_path) as f:
+                want = f.read().strip()
+            got = hashlib.sha256(blob).hexdigest()
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} digest mismatch "
+                    f"(want {want[:12]}…, got {got[:12]}…)")
+        try:
+            return pickle.loads(blob)
+        except Exception as e:  # noqa: BLE001 — truncated/garbled pickle
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} failed to unpickle: "
+                f"{type(e).__name__}: {e}") from e
+
+    def _quarantine(self, step: int) -> None:
+        """Move a corrupt step_N aside as step_N.corrupt (kept for
+        forensics, excluded from step listings) so rollback can't pick
+        it again."""
+        src = os.path.join(self._dir, f"step_{step}")
+        dst = src + ".corrupt"
+        try:
+            shutil.rmtree(dst, ignore_errors=True)
+            os.replace(src, dst)
+        except OSError:
+            shutil.rmtree(src, ignore_errors=True)
+        if _met.enabled():
+            _met.checkpoint_rollbacks.inc()
+
+    def _read_latest_good(self, template: Any) -> Optional[Any]:
+        """Newest step first; corrupt steps are quarantined and the scan
+        rolls back to the next older checkpoint (automatic rollback to
+        the last good step)."""
+        for step in reversed(self._pickle_steps()):
+            try:
+                return self._read(step, template)
+            except CheckpointCorruptError as e:
+                logger.warning(
+                    "checkpoint step %d corrupt (%s) — rolling back", step, e)
+                self._quarantine(step)
+        return None
+
+    def _restore_bcast(self, read_fn: Callable[[], Optional[Any]]) -> \
+            Optional[Any]:
         """Rank 0 reads (or records the failure); EVERY rank reaches the
         broadcast, so ranks neither deadlock nor diverge even when the
         files exist only on rank 0's disk."""
@@ -170,9 +243,7 @@ class CheckpointManager:
         err = None
         if basics.rank() == 0:
             try:
-                step = choose_step()
-                if step is not None:
-                    out = self._read(step, template)
+                out = read_fn()
             except Exception as e:  # noqa: BLE001 — surface on ALL ranks
                 err = f"{type(e).__name__}: {e}"
         out, err = broadcast_object((out, err), root_rank=0)
@@ -186,7 +257,7 @@ class CheckpointManager:
         path)."""
         if not self._multiprocess():
             return self._read(step, template)
-        return self._restore_bcast(lambda: step, template)
+        return self._restore_bcast(lambda: self._read(step, template))
 
     def restore_latest(self, template: Any = None) -> Optional[Any]:
         if not self._multiprocess():
@@ -194,9 +265,9 @@ class CheckpointManager:
             if step is None:
                 return None
             return self._read(step, template)
-        # _local_latest, NOT latest_step: the chooser runs on rank 0
-        # inside the broadcast and must not itself be collective.
-        return self._restore_bcast(self._local_latest, template)
+        # The reader runs on rank 0 inside the broadcast (must not itself
+        # be collective) and rolls back past corrupt steps.
+        return self._restore_bcast(lambda: self._read_latest_good(template))
 
     def close(self) -> None:
         if self._orbax is not None:

@@ -1,0 +1,609 @@
+"""Fault-tolerance tests: retry policies, the deterministic injection
+harness, heartbeat-lease barrier semantics, checkpoint corruption
+rollback, driver respawn budgets, and the worker-hang e2e recovery cycle
+(lease expiry -> blacklist -> shrunken generation -> completion).
+"""
+
+import os
+import shutil
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import faults
+from horovod_tpu.common.exceptions import (
+    CheckpointCorruptError,
+    HorovodTpuError,
+)
+from horovod_tpu.faults import (
+    FaultInjected,
+    FaultSchedule,
+    RetryPolicy,
+    parse_duration,
+    parse_spec,
+)
+from horovod_tpu.runner.rendezvous import KVStore
+
+from test_elastic_integration import ElasticJob
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """Every test starts and ends with no armed schedule."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_backoff_sequence_capped(self):
+        p = RetryPolicy(max_attempts=5, base_delay=0.1, multiplier=2.0,
+                        max_delay=0.4, jitter=0.0)
+        assert list(p.delays()) == pytest.approx([0.1, 0.2, 0.4, 0.4])
+
+    def test_run_retries_until_success(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        p = RetryPolicy(max_attempts=5, base_delay=0.01, jitter=0.0)
+        assert p.run(flaky, retry_on=(OSError,), site="test.flaky",
+                     sleep=sleeps.append) == "ok"
+        assert calls["n"] == 3
+        assert sleeps == pytest.approx([0.01, 0.02])
+
+    def test_exhaustion_reraises_last_error(self):
+        p = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0)
+        with pytest.raises(OSError, match="always"):
+            p.run(lambda: (_ for _ in ()).throw(OSError("always")),
+                  retry_on=(OSError,), sleep=lambda d: None)
+
+    def test_give_up_on_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def fatal():
+            calls["n"] += 1
+            raise ValueError("fatal")
+
+        p = RetryPolicy(max_attempts=5, base_delay=0.0, jitter=0.0)
+        with pytest.raises(ValueError):
+            p.run(fatal, retry_on=(Exception,), give_up_on=(ValueError,),
+                  sleep=lambda d: None)
+        assert calls["n"] == 1
+
+    def test_deadline_stops_retrying(self):
+        calls = {"n": 0}
+
+        def failing():
+            calls["n"] += 1
+            raise OSError("nope")
+
+        # First backoff (10s) already exceeds the 0.05s deadline.
+        p = RetryPolicy(max_attempts=10, base_delay=10.0, jitter=0.0,
+                        deadline=0.05)
+        with pytest.raises(OSError):
+            p.run(failing, retry_on=(OSError,), sleep=lambda d: None)
+        assert calls["n"] == 1
+
+    def test_env_layering(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_RETRY_BASE_DELAY", "0.25")
+        monkeypatch.setenv("HOROVOD_FOO_RETRY_MAX_ATTEMPTS", "7")
+        p = RetryPolicy.from_env("FOO", max_attempts=3, base_delay=1.0,
+                                 jitter=0.0)
+        assert p.max_attempts == 7      # site-specific beats defaults
+        assert p.base_delay == 0.25     # global env beats kwargs
+        q = RetryPolicy.from_env("BAR", max_attempts=3)
+        assert q.max_attempts == 3      # FOO's override is FOO-only
+
+
+# ---------------------------------------------------------------------------
+# Spec grammar + deterministic schedule
+# ---------------------------------------------------------------------------
+
+class TestFaultSpec:
+    def test_parse_duration(self):
+        assert parse_duration("250us") == pytest.approx(250e-6)
+        assert parse_duration("50ms") == pytest.approx(0.05)
+        assert parse_duration("2s") == pytest.approx(2.0)
+        assert parse_duration("1.5") == pytest.approx(1.5)
+        with pytest.raises(HorovodTpuError):
+            parse_duration("5 parsecs")
+
+    def test_parse_spec_grammar(self):
+        acts = parse_spec("rendezvous.put:err:0.1,"
+                          "collective.allreduce:delay:50ms,"
+                          "worker.heartbeat@4:hang:600s,"
+                          "checkpoint.save:exit:137")
+        a, b, c, d = acts
+        assert (a.point, a.mode, a.prob) == ("rendezvous.put", "err", 0.1)
+        assert (b.mode, b.duration) == ("delay", pytest.approx(0.05))
+        assert (c.from_call, c.duration) == (4, pytest.approx(600.0))
+        assert (d.mode, d.exit_code) == ("exit", 137)
+
+    @pytest.mark.parametrize("bad", [
+        "rendezvous.put",            # no mode
+        "x:frobnicate",              # unknown mode
+        "x:delay",                   # delay without duration
+        "x@zero:err",                # bad trigger
+        "x@0:err",                   # trigger < 1
+        "x:err:1.5",                 # prob out of range
+    ])
+    def test_parse_spec_rejects(self, bad):
+        with pytest.raises(HorovodTpuError):
+            parse_spec(bad)
+
+    def test_probabilistic_schedule_is_deterministic(self):
+        def pattern(seed):
+            sched = FaultSchedule(parse_spec("rendezvous.get:err:0.5"),
+                                  seed=seed)
+            hits = []
+            for _ in range(100):
+                try:
+                    sched.fire("rendezvous.get")
+                    hits.append(0)
+                except FaultInjected:
+                    hits.append(1)
+            return hits
+
+        assert pattern(7) == pattern(7)       # same seed: exact replay
+        assert pattern(7) != pattern(8)       # different seed: different
+        assert 20 < sum(pattern(7)) < 80      # roughly the asked p
+
+    def test_from_call_trigger(self):
+        sched = FaultSchedule(parse_spec("worker.heartbeat@3:err"))
+        sched.fire("worker.heartbeat")
+        sched.fire("worker.heartbeat")
+        with pytest.raises(FaultInjected):
+            sched.fire("worker.heartbeat")
+        assert sched.call_count("worker.heartbeat") == 3
+
+    def test_delay_mode_sleeps(self):
+        sched = FaultSchedule(parse_spec("rendezvous.get:delay:50ms"))
+        slept = []
+        sched.fire("rendezvous.get", _sleep=slept.append)
+        assert slept == pytest.approx([0.05])
+
+
+# ---------------------------------------------------------------------------
+# Registry (install / clear / point)
+# ---------------------------------------------------------------------------
+
+class TestFaultRegistry:
+    def test_point_is_noop_when_disarmed(self):
+        faults.point("rendezvous.put")  # no schedule: must not raise
+        assert not faults.active()
+
+    def test_install_fire_and_clear(self):
+        faults.install("rendezvous.put:err")
+        assert faults.active()
+        with pytest.raises(FaultInjected):
+            faults.point("rendezvous.put")
+        assert faults.points_hit("rendezvous.put") == 1
+        faults.clear()
+        faults.point("rendezvous.put")  # disarmed again
+
+    def test_armed_registry_rejects_unknown_point_names(self):
+        faults.install("rendezvous.put:err")
+        with pytest.raises(HorovodTpuError, match="not registered"):
+            faults.point("bogus.name")
+
+    def test_env_loading_respects_host_scope(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FAULT_SPEC", "rendezvous.put:err")
+        monkeypatch.setenv("HOROVOD_FAULT_HOSTS", "hostB")
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "hostA")
+        assert faults._load_from_env() is None
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "hostB")
+        assert faults._load_from_env() is not None
+
+    def test_env_loading_rejects_unknown_points(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FAULT_SPEC", "no.such:err")
+        monkeypatch.delenv("HOROVOD_FAULT_HOSTS", raising=False)
+        with pytest.raises(HorovodTpuError, match="unknown fault point"):
+            faults._load_from_env()
+
+
+# ---------------------------------------------------------------------------
+# KVStore barrier x heartbeat leases
+# ---------------------------------------------------------------------------
+
+class TestBarrierLeases:
+    def test_refuses_when_participant_already_expired(self):
+        kv = KVStore()
+        kv.renew_lease("worker/h:0", 0.01)
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        assert kv.barrier("b", 2, timeout=10.0,
+                          participants=["worker/h:0"]) is False
+        assert time.monotonic() - t0 < 1.0
+
+    def test_fast_fail_when_lease_expires_mid_wait(self):
+        kv = KVStore()
+        kv.renew_lease("worker/h:1", 0.3)
+        t0 = time.monotonic()
+        ok = kv.barrier("b", 2, timeout=30.0, participants=["worker/h:1"])
+        elapsed = time.monotonic() - t0
+        assert ok is False
+        assert elapsed < 5.0, (  # promptly: ~lease expiry, NOT 30s timeout
+            f"barrier took {elapsed:.1f}s — lease fast-fail broken")
+        # The failed arrival was withdrawn: the barrier is immediately
+        # reusable by surviving membership.
+        assert kv.barrier("b", 1, timeout=1.0) is True
+
+    def test_completes_while_leases_healthy(self):
+        kv = KVStore()
+        kv.renew_lease("worker/h:0", 30.0)
+        kv.renew_lease("worker/h:1", 30.0)
+        results = []
+        parts = ["worker/h:0", "worker/h:1"]
+        t = threading.Thread(target=lambda: results.append(
+            kv.barrier("b", 2, timeout=10.0, participants=parts)))
+        t.start()
+        time.sleep(0.1)
+        assert kv.barrier("b", 2, timeout=10.0, participants=parts) is True
+        t.join(timeout=5)
+        assert results == [True]
+
+    def test_never_leased_participant_degrades_to_timeout(self):
+        kv = KVStore()  # native engine / no heartbeats: plain timeout
+        t0 = time.monotonic()
+        assert kv.barrier("b", 2, timeout=0.3,
+                          participants=["worker/unknown:0"]) is False
+        assert 0.25 < time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint hardening (atomic save, digest verify, rollback)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def pickle_mgr(tmp_path, monkeypatch):
+    """CheckpointManager forced onto the rank-0 pickle path (the orbax
+    path delegates integrity to orbax)."""
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    monkeypatch.setattr(ckpt.CheckpointManager, "_multiprocess",
+                        staticmethod(lambda: True))
+    monkeypatch.setattr(ckpt.basics, "rank", lambda: 0)
+    return ckpt.CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=None)
+
+
+class TestCheckpointHardening:
+    def test_save_writes_payload_plus_digest(self, pickle_mgr):
+        assert pickle_mgr.save(1, {"w": np.arange(4), "step": 1})
+        d = os.path.join(pickle_mgr._dir, "step_1")
+        assert os.path.exists(os.path.join(d, "state.pkl"))
+        assert os.path.exists(os.path.join(d, "state.sha256"))
+        out = pickle_mgr._read_pickle(1)
+        assert out["step"] == 1 and list(out["w"]) == [0, 1, 2, 3]
+
+    def test_digest_mismatch_raises_corrupt(self, pickle_mgr):
+        pickle_mgr.save(1, {"step": 1})
+        p = os.path.join(pickle_mgr._dir, "step_1", "state.pkl")
+        with open(p, "ab") as f:
+            f.write(b"garbage appended by a torn write")
+        with pytest.raises(CheckpointCorruptError, match="digest"):
+            pickle_mgr._read_pickle(1)
+
+    def test_truncation_without_digest_raises_corrupt(self, pickle_mgr):
+        pickle_mgr.save(1, {"step": 1})
+        d = os.path.join(pickle_mgr._dir, "step_1")
+        os.remove(os.path.join(d, "state.sha256"))  # pre-digest layout
+        with open(os.path.join(d, "state.pkl"), "r+b") as f:
+            f.truncate(3)
+        with pytest.raises(CheckpointCorruptError, match="unpickle"):
+            pickle_mgr._read_pickle(1)
+
+    def test_rollback_to_last_good_step(self, pickle_mgr):
+        pickle_mgr.save(1, {"step": 1})
+        pickle_mgr.save(2, {"step": 2})
+        p = os.path.join(pickle_mgr._dir, "step_2", "state.pkl")
+        with open(p, "wb") as f:
+            f.write(b"\x00" * 16)
+        out = pickle_mgr._read_latest_good(None)
+        assert out == {"step": 1}
+        # Corrupt step quarantined for forensics, gone from listings.
+        assert os.path.isdir(os.path.join(pickle_mgr._dir,
+                                          "step_2.corrupt"))
+        assert pickle_mgr._pickle_steps() == [1]
+
+    def test_all_corrupt_returns_none(self, pickle_mgr):
+        pickle_mgr.save(1, {"step": 1})
+        with open(os.path.join(pickle_mgr._dir, "step_1", "state.pkl"),
+                  "wb") as f:
+            f.write(b"junk")
+        assert pickle_mgr._read_latest_good(None) is None
+
+    def test_stale_tmp_dir_is_swept(self, pickle_mgr):
+        tmp = os.path.join(pickle_mgr._dir, "step_5.tmp")
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+            f.write(b"half a checkpoint from a crashed save")
+        assert pickle_mgr.save(5, {"step": 5})
+        assert pickle_mgr._read_pickle(5) == {"step": 5}
+        assert not os.path.exists(tmp)
+
+    def test_save_and_restore_fault_points(self, pickle_mgr):
+        faults.install("checkpoint.save:err")
+        with pytest.raises(FaultInjected):
+            pickle_mgr.save(1, {"step": 1})
+        faults.install("checkpoint.restore:err")
+        pickle_mgr.save(1, {"step": 1})
+        with pytest.raises(FaultInjected):
+            pickle_mgr._read(1, None)
+
+
+# ---------------------------------------------------------------------------
+# In-memory elastic state: atomic snapshots + fallback restore
+# ---------------------------------------------------------------------------
+
+class _Undeepcopyable:
+    def __deepcopy__(self, memo):
+        raise RuntimeError("snapshot damaged")
+
+
+class TestStateRollback:
+    def test_object_state_falls_back_to_previous_commit(self):
+        state = hvd.elastic.ObjectState(epoch=1)
+        state.epoch = 2
+        state.save()
+        # Damage the latest snapshot; restore() must roll back one commit
+        # instead of crashing the recovery path.
+        state._saved = {"epoch": _Undeepcopyable()}
+        state.restore()
+        assert state.epoch == 1
+
+    def test_tpu_state_falls_back_to_previous_commit(self):
+        state = hvd.elastic.TpuState(
+            params={"w": np.ones(2)}, opt_state=None, epoch=0)
+        state.params = {"w": np.zeros(2)}
+        state.epoch = 5
+        state.save()
+        prev = state._prev_saved  # the constructor-time snapshot
+        state.params = {"w": np.full(2, 9.0)}
+        state._saved = {}  # torn snapshot (no keys at all)
+        state.restore()
+        assert state._saved is prev
+        assert list(state.params["w"]) == [1.0, 1.0]
+        assert state.epoch == 0
+
+    def test_commit_fault_point(self):
+        state = hvd.elastic.ObjectState(epoch=0)
+        faults.install("state.commit:err")
+        with pytest.raises(FaultInjected):
+            state.commit()
+
+
+# ---------------------------------------------------------------------------
+# Driver: lease monitoring + respawn budget (fakes, no processes)
+# ---------------------------------------------------------------------------
+
+class _FakeHandle:
+    def __init__(self, rc=None):
+        self.rc = rc
+        self.pid = 4242
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+
+class _FakeTransport:
+    def __init__(self, spawn_rc=None):
+        self.spawn_rc = spawn_rc
+        self.spawned = []
+        self.terminated = []
+
+    def command_for(self, slot, settings, env):
+        return ["true"]
+
+    def execute(self, cmd, env, prefix):
+        h = _FakeHandle(rc=self.spawn_rc)
+        self.spawned.append(h)
+        return h
+
+    def terminate(self, handles):
+        for h in handles:
+            h.terminated = True
+            h.rc = -15
+        self.terminated.extend(handles)
+
+
+class _FakeKV:
+    def __init__(self):
+        self.data = {}
+
+    def get(self, key):
+        return self.data.get(key)
+
+    def put(self, key, value):
+        self.data[key] = value
+
+
+def _make_driver(monkeypatch, hosts, transport, **settings_kw):
+    from horovod_tpu.runner.elastic.discovery import HostDiscovery
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.settings import Settings
+
+    monkeypatch.setenv("HVD_TPU_FAKE_LOCAL_HOSTS",
+                       ",".join(h for h, _ in hosts))
+
+    class FixedDiscovery(HostDiscovery):
+        def find_available_hosts_and_slots(self):
+            return dict(hosts)
+
+    settings = Settings(num_proc=sum(s for _, s in hosts),
+                        command=["true"], rendezvous_addr="127.0.0.1",
+                        rendezvous_port=1, **settings_kw)
+    driver = ElasticDriver(settings, FixedDiscovery(), transport)
+    # No real server in unit tests: an in-memory KV catches the
+    # generation publications.
+    fake_kv = _FakeKV()
+    driver.server = SimpleNamespace(kv=lambda: fake_kv, secret="s",
+                                    stop=lambda: None)
+    driver._kv = fake_kv
+    driver._backoff_base = 0.0  # no spawn backoff waits in unit tests
+    return driver
+
+
+class TestDriverLeases:
+    def test_changing_heartbeat_extends_deadline(self, monkeypatch):
+        tr = _FakeTransport()
+        d = _make_driver(monkeypatch, [("hostX", 1)], tr, lease_ttl=5.0)
+        key = ("hostX", 0)
+        h = _FakeHandle(rc=None)
+        d.workers[key] = (h, 0, 0)
+        d._hb_deadline[key] = time.time() - 1  # would expire...
+        d._kv.put("elastic/heartbeat/hostX:0", "beat-1")
+        assert d._check_leases(time.time()) is False  # ...but value changed
+        assert key in d.workers and not h.terminated
+        assert d._hb_deadline[key] > time.time()
+
+    def test_expired_lease_fails_live_worker(self, monkeypatch):
+        from horovod_tpu.runner.elastic import registration
+
+        tr = _FakeTransport()
+        d = _make_driver(monkeypatch, [("hostX", 1)], tr, lease_ttl=5.0,
+                         blacklist_threshold=100)
+        key = ("hostX", 0)
+        h = _FakeHandle(rc=None)  # process ALIVE — no exit signal exists
+        d.workers[key] = (h, 0, 0)
+        d._hb_value[key] = "beat-1"
+        d._kv.put("elastic/heartbeat/hostX:0", "beat-1")  # unchanged
+        d._hb_deadline[key] = time.time() - 0.1
+        assert d._check_leases(time.time()) is True
+        for _ in range(200):  # termination runs off the monitor thread
+            if h.terminated:
+                break
+            time.sleep(0.01)
+        assert h.terminated
+        assert key not in d.workers  # no double-strike via the exit reap
+        assert d.registry.failure_reasons("hostX") == {
+            registration.LEASE_EXPIRED: 1}
+
+    def test_lease_check_interval_gated(self, monkeypatch):
+        tr = _FakeTransport()
+        d = _make_driver(monkeypatch, [("hostX", 1)], tr, lease_ttl=5.0)
+        now = time.time()
+        d._check_leases(now)
+        probe = ("hostX", 0)
+        d.workers[probe] = (_FakeHandle(rc=None), 0, 0)
+        d._hb_deadline[probe] = now - 1
+        # Second call inside the check interval: no work done.
+        assert d._check_leases(now) is False
+        assert probe in d.workers
+
+    def test_disabled_when_ttl_zero(self, monkeypatch):
+        d = _make_driver(monkeypatch, [("hostX", 1)], _FakeTransport(),
+                         lease_ttl=0.0)
+        d.workers[("hostX", 0)] = (_FakeHandle(rc=None), 0, 0)
+        d._hb_deadline[("hostX", 0)] = time.time() - 10
+        assert d._check_leases(time.time()) is False
+
+
+class TestRespawnBudget:
+    def test_budget_exhaustion_blacklists_host(self, monkeypatch):
+        # Workers die instantly; strikes alone never blacklist
+        # (threshold=100) so only the respawn budget can stop the loop.
+        tr = _FakeTransport(spawn_rc=1)
+        d = _make_driver(monkeypatch, [("hostX", 1)], tr,
+                         lease_ttl=0.0, blacklist_threshold=100,
+                         max_respawns=2)
+        d._active_hosts = {"hostX": 1}
+        d._publish_generation(d._compute_assignments(d._active_hosts))
+        d._spawn_missing_workers()
+
+        rc = None
+        for _ in range(50):
+            rc = d._monitor_once()
+            if rc is not None:
+                break
+        assert rc == 1  # blacklisted sole host -> below min_np -> abort
+        assert d.registry.is_blacklisted("hostX")
+        # 1 initial spawn + exactly max_respawns respawns, not one more.
+        assert len(tr.spawned) == 3
+        assert d._respawns["hostX"] == 2
+
+    def test_spawn_failure_strikes_host(self, monkeypatch):
+        from horovod_tpu.runner.elastic import registration
+
+        tr = _FakeTransport()
+        tr.execute = lambda *a, **k: (_ for _ in ()).throw(
+            OSError("ssh: connection refused"))
+        d = _make_driver(monkeypatch, [("hostX", 1)], tr,
+                         lease_ttl=0.0, blacklist_threshold=100)
+        d._active_hosts = {"hostX": 1}
+        d._publish_generation(d._compute_assignments(d._active_hosts))
+        d._spawn_missing_workers()
+        assert d.registry.failure_reasons("hostX") == {
+            registration.SPAWN: 1}
+        assert d._need_transition
+
+    def test_spawn_env_carries_lease_ttl(self, monkeypatch):
+        captured = {}
+        tr = _FakeTransport()
+        orig = tr.execute
+
+        def capture(cmd, env, prefix):
+            captured.update(env)
+            return orig(cmd, env, prefix)
+
+        tr.execute = capture
+        d = _make_driver(monkeypatch, [("hostX", 1)], tr, lease_ttl=7.5)
+        d._active_hosts = {"hostX": 1}
+        d._publish_generation(d._compute_assignments(d._active_hosts))
+        d._spawn_missing_workers()
+        assert captured["HOROVOD_ELASTIC_LEASE_TTL"] == "7.5"
+
+
+# ---------------------------------------------------------------------------
+# E2E: hung worker -> lease expiry -> blacklist -> degraded generation ->
+# survivor completes from committed state.  No process-exit signal is ever
+# produced by the hung worker: the driver fails it while alive.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.integration
+class TestWorkerHangRecovery:
+    def test_hang_detected_and_job_completes_degraded(self, tmp_path):
+        job = ElasticJob(
+            tmp_path, [("hostA", 1), ("hostB", 1)],
+            num_epochs=16, epoch_time=0.5,
+            extra_env={
+                # hostB's heartbeat thread hangs after its 3rd beat; the
+                # worker process itself stays alive and keeps training.
+                "HOROVOD_FAULT_SPEC": "worker.heartbeat@4:hang:600s",
+                "HOROVOD_FAULT_HOSTS": "hostB",
+                "HOROVOD_ELASTIC_LEASE_TTL": "2",
+                "HOROVOD_ELASTIC_START_GRACE": "30",
+            })
+        rc, out = job.wait(timeout=180)
+        assert rc == 0, out
+        # The driver failed the worker from lease expiry, not an exit.
+        assert "heartbeat lease EXPIRED" in out, out
+        assert "blacklisting host hostB" in out, out
+        # Degraded continuation: the published generation shrank but the
+        # job ran on at size 1 >= min_np.
+        assert "DEGRADED" in out, out
+        hist = job.histories()
+        a = hist["hostA-0"]
+        assert a[-1]["event"] == "exit" and a[-1]["size"] == 1
+        assert max(r["epoch"] for r in a) == 16
+        # hostB was killed by the driver mid-run: it never recorded a
+        # voluntary exit and never raised a failure of its own.
+        b = hist.get("hostB-0", [])
+        assert all(r["event"] not in ("exit", "failing") for r in b)
+        # Survivor's committed progress is monotone (resumed, not reset).
+        commits = [r["epoch"] for r in a if r["event"] == "commit"]
+        assert commits == sorted(commits)
