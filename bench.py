@@ -145,7 +145,13 @@ def probe_accelerator() -> str:
 # The measured step (shared by main bench and the sim-scaling child)
 # ---------------------------------------------------------------------------
 
-def build_step(opt, cfg, distributed: bool):
+def build_step(opt, cfg, distributed: bool,
+               reduce_grads_in_step: bool = True):
+    """The measured train step.  `reduce_grads_in_step=False` leaves the
+    gradient allreduce to `opt` itself (hvd.DistributedOptimizer with
+    fused_apply: per-bucket reduce + apply chains instead of an
+    allreduce barrier before one global update — the overlap-aware
+    pipeline, the sim-scaling default)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -175,7 +181,8 @@ def build_step(opt, cfg, distributed: bool):
         (loss, ns), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state["params"])
         if distributed:
-            grads = hvd.allreduce(grads)
+            if reduce_grads_in_step:
+                grads = hvd.allreduce(grads)
             # Stats computed per-shard must be re-replicated before the
             # step returns them under out_specs=P(): ONE fused pmean of
             # the whole batch_stats tree (vs r02's 2 collectives per BN
@@ -241,27 +248,44 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
     per_chip = 16
     batch = per_chip * n_devices
     v = resnet_init(jax.random.PRNGKey(0), 18, num_classes=100)
-    opt = optax.sgd(0.01, momentum=0.9)
+    base_opt = optax.sgd(0.01, momentum=0.9)
+    # Default pipeline: reverse-availability bucketing + per-bucket fused
+    # optimizer apply (hvd.DistributedOptimizer handles the reduction).
+    # HOROVOD_BENCH_LEGACY_PIPELINE=1 restores the r05 barriered path
+    # (one allreduce of the whole tree, then one global opt.update) for
+    # before/after comparison.
+    legacy = os.environ.get("HOROVOD_BENCH_LEGACY_PIPELINE") == "1"
+    pipeline = "legacy" if (legacy or not distributed) else "overlap"
+    if pipeline == "overlap":
+        opt = hvd.DistributedOptimizer(base_opt, fused_apply=True)
+        step_fn = build_step(opt, v["config"], distributed=True,
+                             reduce_grads_in_step=False)
+    else:
+        opt = base_opt
+        step_fn = build_step(opt, v["config"], distributed=distributed)
     state = {"params": v["params"], "batch_stats": v["batch_stats"]}
     opt_state = opt.init(state["params"])
     x = jax.random.normal(jax.random.PRNGKey(1), (batch, 32, 32, 3),
                           jnp.float32)
     y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 100)
 
-    step = hvd.data_parallel(
-        build_step(opt, v["config"], distributed=distributed))
+    step = hvd.data_parallel(step_fn)
     sb = hvd.shard_batch((x, y))
     # More iters at n=1: its ~0.4s steps carry most of the efficiency
     # ratio's run-to-run noise on the shared core.
     iters = 12 if n_devices == 1 else 6
     t, _, _ = time_steps(step, state, opt_state, sb, warmup=2, iters=iters)
     print(json.dumps({"n": n_devices, "step_time_s": t,
+                      "pipeline": pipeline,
                       "per_chip_img_sec": batch / t / n_devices}))
 
 
-def _run_sim(n: int, distributed: bool, timeout: float):
+def _run_sim(n: int, distributed: bool, timeout: float,
+             legacy: bool = False):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    if legacy:
+        env["HOROVOD_BENCH_LEGACY_PIPELINE"] = "1"
     cmd = [sys.executable, os.path.abspath(__file__), "--sim-child", str(n)]
     if not distributed:
         cmd.append("--no-dist")
@@ -303,10 +327,16 @@ def sim_scaling_efficiency(timeout: float = 600.0,
     spread, and a bootstrap percentile CI (2.5/97.5, deterministic
     seed) of the trimmed median ships alongside so the >=0.90 gate can
     be read against an interval, not a point.  Returns
-    (median, spread, effs, ci, n_rejected).
+    (median, spread, effs, ci, n_rejected, extras) where `extras` is a
+    dict with the collective-share decomposition.
 
-    Also reports the per-step collective share: T8(dist) - T8(no dist),
-    the same decomposition the reference's timeline gives per tensor.
+    Collective share is T8(dist) - T8(no dist) — the same
+    decomposition the reference's timeline gives per tensor — measured
+    for BOTH pipelines: the overlap-aware default (reverse-availability
+    buckets + fused per-bucket apply) and the legacy barriered path
+    (HOROVOD_BENCH_LEGACY_PIPELINE), so the record carries a
+    before/after comparison of how much per-step time the collectives
+    cost under each.
     """
     import numpy as _np
 
@@ -359,12 +389,28 @@ def sim_scaling_efficiency(timeout: float = 600.0,
         log(f"sim-scaling: only {len(effs)} valid pairs "
             f"({rejected} rejected) — no estimate")
         return None
+    extras = {}
     t8_nodist = _run_sim(8, False, timeout)
     if t8_nodist is not None and t8s:
         t8m = sorted(t8s)[len(t8s) // 2]
+        share = (t8m - t8_nodist) / t8m
         log(f"sim-scaling n=8 compute-only: {t8_nodist*1e3:.1f} ms/step "
             f"-> collective share {(t8m - t8_nodist)*1e3:.1f} ms/step "
-            f"({100 * (t8m - t8_nodist) / t8m:.1f}%)")
+            f"({100 * share:.1f}%)")
+        extras["t8_ms"] = round(t8m * 1e3, 1)
+        extras["t8_nodist_ms"] = round(t8_nodist * 1e3, 1)
+        extras["collective_share"] = round(share, 4)
+        # Before/after: the legacy barriered pipeline's n=8 step on the
+        # same mesh, timed back-to-back so host load is comparable.
+        t8_legacy = _run_sim(8, True, timeout, legacy=True)
+        if t8_legacy is not None:
+            legacy_share = (t8_legacy - t8_nodist) / t8_legacy
+            log(f"sim-scaling n=8 legacy pipeline: {t8_legacy*1e3:.1f} "
+                f"ms/step -> collective share "
+                f"{(t8_legacy - t8_nodist)*1e3:.1f} ms/step "
+                f"({100 * legacy_share:.1f}%)")
+            extras["t8_legacy_ms"] = round(t8_legacy * 1e3, 1)
+            extras["collective_share_legacy"] = round(legacy_share, 4)
 
     def _trimmed_median(vals):
         s = _np.sort(_np.asarray(vals))
@@ -393,7 +439,7 @@ def sim_scaling_efficiency(timeout: float = 600.0,
     log(f"sim-scaling: trimmed median {median:.4f}, spread "
         f"{spread:.4f}, CI [{ci[0]:.4f}, {ci[1]:.4f}] over "
         f"{len(effs)} valid pairs ({rejected} rejected)")
-    return median, spread, effs, ci, rejected
+    return median, spread, effs, ci, rejected, extras
 
 
 # ---------------------------------------------------------------------------
@@ -701,7 +747,7 @@ def main():
         log(f"sim scaling failed: {type(e).__name__}: {e}")
         eff = None
     if eff is not None:
-        median, spread, effs, ci, rejected = eff
+        median, spread, effs, ci, rejected, extras = eff
         # eff > 1.0 pairs were rejected inside the estimator, so the
         # trimmed median is already <= 1.0 by construction.
         result["scaling_eff_sim8"] = round(median, 4)
@@ -710,6 +756,10 @@ def main():
         result["scaling_eff_sim8_ci"] = [round(ci[0], 4),
                                          round(ci[1], 4)]
         result["scaling_eff_sim8_rejected"] = rejected
+        if extras:
+            # Collective-share decomposition under the overlap pipeline
+            # (default) and the legacy barriered pipeline (before/after).
+            result["sim8_collective_share"] = extras
 
     emit(result)
 

@@ -136,6 +136,7 @@ from .parallel.data_parallel import (  # noqa: F401
     distributed_grad,
     DistributedGradientTape,
     error_feedback_init,
+    gradient_bucket_partition,
     shard_batch,
 )
 

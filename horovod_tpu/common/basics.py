@@ -463,6 +463,12 @@ def add_process_set(ranks: Sequence[int]) -> ProcessSet:
     """Register a process set over `ranks` and build its sub-mesh."""
     st = _state()
     ranks = sorted(int(r) for r in ranks)
+    if len(set(ranks)) != len(ranks):
+        dups = sorted({r for r in ranks if ranks.count(r) > 1})
+        raise HorovodTpuError(
+            f"process set ranks contain duplicates {dups}: each rank "
+            "may appear at most once (a duplicated rank would reach XLA "
+            "as a non-partition axis_index_groups and fail opaquely)")
     if any(r < 0 or r >= st.size for r in ranks):
         raise HorovodTpuError(f"process set ranks {ranks} out of range")
     sub_devices = np.asarray([st.devices[r] for r in ranks])

@@ -57,6 +57,14 @@ grad_bytes_per_step = _REG.gauge(
     "hvd_grad_bytes_per_step",
     "Static gradient bytes per compiled step (recorded at trace time; "
     "multiply by hvd_steps_total for in-jit traffic).")
+buckets_per_step = _REG.gauge(
+    "hvd_buckets_per_step",
+    "Gradient fusion buckets per reduction (one collective issues per "
+    "bucket; recorded at trace time for compiled steps).")
+bucket_bytes = _REG.gauge(
+    "hvd_bucket_bytes",
+    "Mean raw gradient payload bytes per fusion bucket (recorded "
+    "alongside hvd_buckets_per_step).")
 optimizer_syncs = _REG.counter(
     "hvd_optimizer_syncs_total",
     "DistributedOptimizer cross-rank gradient syncs executed eagerly.")

@@ -310,6 +310,14 @@ def transformer_extend(params: Dict, cache: Dict, tokens,
     The chunk must fit without wrapping the ring: pos % max_len + c <=
     max_len (enforced eagerly when pos is concrete).  c == 1 is
     numerically identical to `transformer_decode_step`.
+
+    Windowed configs (`cfg.attn_window`) additionally require pos <
+    max_len: once the ring has wrapped, the chunk's slot-position
+    reconstruction anchors at its LAST query, so keys that are still
+    inside an EARLIER query's window may already have been evicted —
+    the earlier rows would silently attend over a truncated window.
+    Use `transformer_decode_step` past max_len instead (its single
+    query is exactly the anchor, so no such skew exists).
     """
     dt = cfg.compute_dtype
     B, c = tokens.shape
@@ -322,6 +330,13 @@ def transformer_extend(params: Dict, cache: Dict, tokens,
                 f"extend chunk of {c} tokens at pos {int(pos)} would "
                 f"wrap the ring (max_len {S}); split the chunk or size "
                 f"the cache larger")
+        if cfg.attn_window and int(pos) >= S:
+            raise ValueError(
+                f"extend on a wrapped windowed ring (attn_window "
+                f"{cfg.attn_window}, pos {int(pos)} >= max_len {S}) "
+                "would silently drop still-in-window keys for the "
+                "chunk's earlier queries; decode token-by-token with "
+                "transformer_decode_step past max_len")
     x = params["embed"][tokens].astype(dt)                # [B,c,D]
     x, ck, cv = _layer_walk(
         params, cache["k"], cache["v"], x,
@@ -348,8 +363,13 @@ def transformer_speculative_generate(
 
     - temperature == 0 (greedy): accept while the draft token equals the
       target argmax; the first mismatch position is replaced by the
-      target's own argmax.  Output is EXACTLY the target-only greedy
-      sequence (tested token-for-token).
+      target's own argmax.  Under matched precision the output is the
+      target-only greedy sequence token for token; when two logits are
+      within numerical noise of each other (near-ties, especially under
+      bf16 compute), the chunked verify pass and the step-by-step chain
+      may break the tie differently — equivalence then holds up to
+      those near-tie positions (tested with a tolerance-aware argmax
+      comparison).
     - temperature > 0: standard speculative SAMPLING (Leviathan et al. /
       Chen et al.): draft token x accepted with probability
       min(1, p_target(x)/p_draft(x)); on first rejection, resample from

@@ -347,6 +347,13 @@ def _tracer_set_groups(kind: str, process_set: Optional[ProcessSet],
     world = lax.axis_size(ax)
     members = [int(r) for r in process_set.ranks]
     n = len(members)
+    if len(set(members)) != n:
+        # add_process_set rejects duplicates; guard here too for sets
+        # built by other means — XLA would otherwise fail opaquely on a
+        # non-partition group list.
+        raise HorovodTpuError(
+            f"{kind}: process set ranks {members} contain duplicates — "
+            "axis_index_groups must cover the axis exactly once")
     if world % n != 0:
         raise HorovodTpuError(
             f"{kind} with a non-global process_set inside jit requires "

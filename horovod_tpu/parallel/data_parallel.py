@@ -43,72 +43,147 @@ def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
     )
 
 
-def _buckets_by_size(tensors, threshold_bytes):
-    """Greedy size-capped bucket index lists (fusion-buffer analog)."""
+def _bucket_permutation(n, bucket_order):
+    """Leaf traversal order for bucket formation: "forward" (leaf order),
+    "reverse" (reverse leaf order — backward-availability order, since
+    autodiff produces the LAST layer's gradients first), or an explicit
+    permutation of range(n)."""
+    if bucket_order is None or bucket_order == "forward":
+        return list(range(n))
+    if bucket_order == "reverse":
+        return list(range(n - 1, -1, -1))
+    if isinstance(bucket_order, str):
+        raise ValueError(
+            f"bucket_order must be 'forward', 'reverse', or an explicit "
+            f"permutation sequence, got {bucket_order!r}")
+    perm = [int(i) for i in bucket_order]
+    if sorted(perm) != list(range(n)):
+        raise ValueError(
+            f"bucket_order permutation must rearrange range({n}) "
+            f"exactly once each, got {perm}")
+    return perm
+
+
+def _buckets_by_nbytes(nbytes, threshold_bytes, bucket_order="forward"):
+    """Greedy size-capped bucketing over per-item byte counts; buckets
+    hold ORIGINAL indices, in `bucket_order` traversal order."""
     buckets = [[]]
     cur_bytes = 0
-    for i, t in enumerate(tensors):
-        nbytes = t.size * t.dtype.itemsize
-        if buckets[-1] and cur_bytes + nbytes > threshold_bytes:
+    for i in _bucket_permutation(len(nbytes), bucket_order):
+        if buckets[-1] and cur_bytes + nbytes[i] > threshold_bytes:
             buckets.append([])
             cur_bytes = 0
         buckets[-1].append(i)
-        cur_bytes += nbytes
+        cur_bytes += nbytes[i]
     return buckets
 
 
-def allreduce_gradients(
-    grads: Any,
+def _buckets_by_size(tensors, threshold_bytes, bucket_order="forward"):
+    """Greedy size-capped bucket index lists (fusion-buffer analog).
+
+    `bucket_order` picks the traversal: "reverse" forms the first bucket
+    from the LAST leaves — the ones backward produces first — so its
+    collective can issue while earlier layers' backward still runs
+    (PyTorch-DDP bucket ordering)."""
+    return _buckets_by_nbytes(
+        [t.size * t.dtype.itemsize for t in tensors],
+        threshold_bytes, bucket_order)
+
+
+def gradient_bucket_partition(
+    leaves: Sequence[Any],
+    compression=Compression.none,
+    fusion_threshold_bytes: Optional[int] = None,
+    bucket_order=None,
+) -> list:
+    """The bucket partition `allreduce_gradients` will use for `leaves`:
+    a list of original-leaf-index lists, each covering every leaf exactly
+    once, in collective-issue order.
+
+    Shared by the per-bucket fused optimizer apply
+    (parallel/optimizer.py) so init-time state partitioning and
+    update-time reduction can never diverge.  Sizes are wire sizes
+    (post-compression), computed via `jax.eval_shape` — no compute.
+    For quantized wires the integer leaves (reduced exactly) form their
+    own leading bucket.
+    """
+    from ..utils.autotune import (current_bucket_order,
+                                  current_fusion_threshold,
+                                  current_min_buckets)
+    if fusion_threshold_bytes is None:
+        fusion_threshold_bytes = current_fusion_threshold()
+    if bucket_order is None:
+        bucket_order = current_bucket_order()
+    from ..ops.compression import _CooperativeCompressor
+    _coop = (isinstance(compression, type)
+             and issubclass(compression, _CooperativeCompressor))
+
+    def _cap(nbytes):
+        # The autotuner's per-bucket-count knob: force at least
+        # `min_buckets` buckets by capping the effective threshold.
+        m = current_min_buckets()
+        if m > 1 and nbytes:
+            return min(fusion_threshold_bytes,
+                       max(1, -(-sum(nbytes) // m)))
+        return fusion_threshold_bytes
+
+    if _coop:
+        float_idx = [i for i, t in enumerate(leaves)
+                     if jnp.issubdtype(t.dtype, jnp.floating)]
+        int_idx = [i for i in range(len(leaves)) if i not in set(float_idx)]
+        # Quantized ring rides a flat f32 staging buffer: 4 bytes/elem.
+        nbytes = [leaves[i].size * 4 for i in float_idx]
+        buckets = _buckets_by_nbytes(nbytes, _cap(nbytes), bucket_order)
+        parts = [[float_idx[j] for j in b] for b in buckets if b]
+        return ([int_idx] if int_idx else []) + parts
+    nbytes = []
+    for t in leaves:
+        spec = jax.eval_shape(lambda x: compression.compress(x)[0], t)
+        nbytes.append(spec.size * spec.dtype.itemsize)
+    return [b for b in
+            _buckets_by_nbytes(nbytes, _cap(nbytes), bucket_order) if b]
+
+
+def reduce_gradient_buckets(
+    leaves: Sequence[Any],
     op: C.ReduceOp = C.Average,
     compression=Compression.none,
     axis_name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
     fusion_threshold_bytes: Optional[int] = None,
-    error_feedback_state: Any = None,
-) -> Any:
-    """Average a gradient pytree across ranks with wire compression and
-    fusion-buffer-style bucketing (reference: FusionBufferManager — here
-    bucketing is concatenation in the traced graph; multiple buckets let
-    XLA overlap collectives with remaining backward compute).
+    bucket_order=None,
+    error_feedback_leaves=None,
+):
+    """Reduce a flat gradient-leaf list bucket by bucket.
 
-    `fusion_threshold_bytes` defaults to HOROVOD_FUSION_THRESHOLD (64 MB,
-    the reference default), overridden live by the autotuner when
-    HOROVOD_AUTOTUNE=1.
+    Returns `(bucket_results, new_ef)`: `bucket_results` is a list of
+    `(original_indices, reduced_leaves)` pairs in collective-issue order
+    (the partition from `gradient_bucket_partition`), and `new_ef` is
+    the updated per-float-leaf EF residual list in original float-leaf
+    order (None unless `error_feedback_leaves` was passed).
 
-    `error_feedback_state` (quantized wires only; create with
-    `error_feedback_init(grads)`): standard EF compression — each rank
-    adds its carried residual to the gradient before encoding and keeps
-    the new LOCAL encode error for the next step, so the per-step
-    quantization bias telescopes away (time-averaged error O(1/t)
-    instead of a persistent bias).  When passed, the return value is
-    `(reduced, new_error_feedback_state)`; thread the state through
-    your step like optimizer state."""
-    if fusion_threshold_bytes is None:
-        from ..utils.autotune import current_fusion_threshold
-        fusion_threshold_bytes = current_fusion_threshold()
-    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    This is the single reduction engine behind `allreduce_gradients`
+    (which reassembles the full tree) and the per-bucket fused optimizer
+    apply (parallel/optimizer.py, which consumes each bucket the moment
+    its reduction exists instead of barriering on all of them).
+    """
     from ..ops.compression import _CooperativeCompressor
     _cooperative = (isinstance(compression, type) and
                     issubclass(compression, _CooperativeCompressor))
-    if error_feedback_state is not None and not _cooperative:
+    if error_feedback_leaves is not None and not _cooperative:
         raise ValueError(
             "error_feedback_state only applies to the quantized wire "
             "formats (Compression.int8 / fp8_*) — exact and fp16/bf16 "
             "wires have no compression error to feed back")
-    if not leaves:
-        return ((grads, error_feedback_state)
-                if error_feedback_state is not None else grads)
+    parts = gradient_bucket_partition(
+        leaves, compression=compression,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        bucket_order=bucket_order)
     if _met.enabled():
-        nbytes = sum(l.size * l.dtype.itemsize for l in leaves
-                     if hasattr(l, "size") and hasattr(l, "dtype"))
-        if any(isinstance(l, jax.core.Tracer) for l in leaves):
-            # Trace time — this branch fires once per compile, not per
-            # step: record the static per-step payload (multiply by
-            # hvd_steps_total for in-jit traffic).  Incrementing a
-            # counter here would silently count compiles, not steps.
-            _met.grad_bytes_per_step.set(nbytes)
-        else:
-            _met.grad_bytes_reduced.inc(nbytes)
+        raw = sum(l.size * l.dtype.itemsize for l in leaves
+                  if hasattr(l, "size") and hasattr(l, "dtype"))
+        _met.buckets_per_step.set(len(parts))
+        _met.bucket_bytes.set(raw // max(1, len(parts)))
     if _cooperative:
         wire = compression.wire
         # Cooperative wire format: the quantized ring allreduce IS the
@@ -131,66 +206,58 @@ def allreduce_gradients(
 
         # Quantized wire is float-only: integer leaves (step counters
         # etc.) must keep summing exactly, same as hierarchical.py's
-        # DCN-wire filter — route them through the exact grouped path.
-        float_idx = [i for i, t in enumerate(leaves)
-                     if jnp.issubdtype(t.dtype, jnp.floating)]
-        int_idx = [i for i in range(len(leaves)) if i not in float_idx]
-        ef_leaves = None
-        if error_feedback_state is not None:
-            ef_leaves, ef_def = jax.tree_util.tree_flatten(
-                error_feedback_state)
-            if len(ef_leaves) != len(float_idx):
-                raise ValueError(
-                    f"error_feedback_state has {len(ef_leaves)} leaves; "
-                    f"expected one per float gradient leaf "
-                    f"({len(float_idx)}) — build it with "
-                    f"error_feedback_init(grads)")
-        out = [None] * len(leaves)
-        new_ef = [None] * len(float_idx)
-        if int_idx:
-            exact = C.grouped_allreduce(
-                [leaves[i] for i in int_idx], op=op, axis_name=axis_name)
-            for i, r in zip(int_idx, exact):
-                out[i] = r
-        # Same size-capped bucketing as the exact path (fusion
-        # threshold / autotuner apply here too) so the ring collectives
-        # can overlap remaining backward compute.
-        buckets = _buckets_by_size(
-            [leaves[i] for i in float_idx], fusion_threshold_bytes)
-        for bidxs in buckets:
-            idxs = [float_idx[j] for j in bidxs] if float_idx else []
-            if not idxs:
+        # DCN-wire filter — the partition routes them into their own
+        # leading bucket on the exact grouped path.
+        float_ord = {}
+        for i, t in enumerate(leaves):
+            if jnp.issubdtype(t.dtype, jnp.floating):
+                float_ord[i] = len(float_ord)
+        if error_feedback_leaves is not None and \
+                len(error_feedback_leaves) != len(float_ord):
+            raise ValueError(
+                f"error_feedback_state has {len(error_feedback_leaves)} "
+                f"leaves; expected one per float gradient leaf "
+                f"({len(float_ord)}) — build it with "
+                f"error_feedback_init(grads)")
+        new_ef = [None] * len(float_ord)
+        results = []
+        for idxs in parts:
+            if idxs and idxs[0] not in float_ord:
+                exact = C.grouped_allreduce(
+                    [leaves[i] for i in idxs], op=op, axis_name=axis_name)
+                results.append((idxs, list(exact)))
                 continue
             flat = jnp.concatenate(
                 [leaves[i].astype(jnp.float32).reshape(-1) for i in idxs])
-            if ef_leaves is not None:
+            if error_feedback_leaves is not None:
                 # Sender-side EF inside the ring: the collective adds
                 # the residual, captures every wire encode's error at
                 # its sender, and hands the new residual back — the
                 # dropped bits telescope exactly across steps (see
                 # quantized_allreduce_shard).
                 ef_flat = jnp.concatenate(
-                    [ef_leaves[j].reshape(-1) for j in bidxs])
+                    [error_feedback_leaves[float_ord[i]].reshape(-1)
+                     for i in idxs])
                 reduced, err = quantized_allreduce_shard(
                     flat, axis_name, average=(op is C.Average),
                     wire=wire, error_feedback=ef_flat)
             else:
                 reduced = quantized_allreduce_shard(
                     flat, axis_name, average=(op is C.Average), wire=wire)
+            outs = []
             offset = 0
-            for j, i in zip(bidxs, idxs):
+            for i in idxs:
                 n = leaves[i].size
-                out[i] = (reduced[offset:offset + n]
-                          .reshape(leaves[i].shape)
-                          .astype(leaves[i].dtype))
-                if ef_leaves is not None:
-                    new_ef[j] = err[offset:offset + n].reshape(
+                outs.append(reduced[offset:offset + n]
+                            .reshape(leaves[i].shape)
+                            .astype(leaves[i].dtype))
+                if error_feedback_leaves is not None:
+                    new_ef[float_ord[i]] = err[offset:offset + n].reshape(
                         leaves[i].shape)
                 offset += n
-        result = jax.tree_util.tree_unflatten(treedef, out)
-        if ef_leaves is not None:
-            return result, jax.tree_util.tree_unflatten(ef_def, new_ef)
-        return result
+            results.append((idxs, outs))
+        return results, (new_ef if error_feedback_leaves is not None
+                         else None)
     compressed, ctxs = [], []
     for leaf in leaves:
         c, ctx = compression.compress(leaf)
@@ -198,16 +265,87 @@ def allreduce_gradients(
         ctxs.append(ctx)
     # Greedy size-capped buckets (fusion threshold analog); dtype grouping
     # within a bucket is grouped_allreduce's job.
-    buckets = _buckets_by_size(compressed, fusion_threshold_bytes)
-    out = [None] * len(leaves)
-    for idxs in buckets:
+    results = []
+    for idxs in parts:
         group = [compressed[i] for i in idxs]
         reduced = C.grouped_allreduce(
             group, op=op, axis_name=axis_name, process_set=process_set
         )
+        results.append(
+            (idxs, [compression.decompress(r, ctxs[i])
+                    for i, r in zip(idxs, reduced)]))
+    return results, None
+
+
+def allreduce_gradients(
+    grads: Any,
+    op: C.ReduceOp = C.Average,
+    compression=Compression.none,
+    axis_name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+    fusion_threshold_bytes: Optional[int] = None,
+    bucket_order=None,
+    error_feedback_state: Any = None,
+) -> Any:
+    """Average a gradient pytree across ranks with wire compression and
+    fusion-buffer-style bucketing (reference: FusionBufferManager — here
+    bucketing is concatenation in the traced graph; multiple buckets let
+    XLA overlap collectives with remaining backward compute).
+
+    `fusion_threshold_bytes` defaults to HOROVOD_FUSION_THRESHOLD (64 MB,
+    the reference default), overridden live by the autotuner when
+    HOROVOD_AUTOTUNE=1.
+
+    `bucket_order` picks the bucket-formation traversal — "forward",
+    "reverse" (the default, via HOROVOD_BUCKET_ORDER / the autotuner),
+    or an explicit permutation of the leaf indices.  Reverse is
+    backward-availability order: the first bucket holds the LAST
+    layers' gradients — the ones autodiff produces first — so its
+    collective can issue while earlier layers' backward still runs
+    (PyTorch-DDP bucket ordering).  Exact and fp16/bf16 wires are
+    bitwise order-invariant (bucketing never mixes elements across
+    leaves); quantized wires shift chunk-scale boundaries, so results
+    across orders agree only to wire tolerance.
+
+    `error_feedback_state` (quantized wires only; create with
+    `error_feedback_init(grads)`): standard EF compression — each rank
+    adds its carried residual to the gradient before encoding and keeps
+    the new LOCAL encode error for the next step, so the per-step
+    quantization bias telescopes away (time-averaged error O(1/t)
+    instead of a persistent bias).  When passed, the return value is
+    `(reduced, new_error_feedback_state)`; thread the state through
+    your step like optimizer state."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if not leaves:
+        return ((grads, error_feedback_state)
+                if error_feedback_state is not None else grads)
+    if _met.enabled():
+        nbytes = sum(l.size * l.dtype.itemsize for l in leaves
+                     if hasattr(l, "size") and hasattr(l, "dtype"))
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            # Trace time — this branch fires once per compile, not per
+            # step: record the static per-step payload (multiply by
+            # hvd_steps_total for in-jit traffic).  Incrementing a
+            # counter here would silently count compiles, not steps.
+            _met.grad_bytes_per_step.set(nbytes)
+        else:
+            _met.grad_bytes_reduced.inc(nbytes)
+    ef_leaves = ef_def = None
+    if error_feedback_state is not None:
+        ef_leaves, ef_def = jax.tree_util.tree_flatten(error_feedback_state)
+    results, new_ef = reduce_gradient_buckets(
+        leaves, op=op, compression=compression, axis_name=axis_name,
+        process_set=process_set,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        bucket_order=bucket_order, error_feedback_leaves=ef_leaves)
+    out = [None] * len(leaves)
+    for idxs, reduced in results:
         for i, r in zip(idxs, reduced):
-            out[i] = compression.decompress(r, ctxs[i])
-    return jax.tree_util.tree_unflatten(treedef, out)
+            out[i] = r
+    result = jax.tree_util.tree_unflatten(treedef, out)
+    if error_feedback_state is not None:
+        return result, jax.tree_util.tree_unflatten(ef_def, new_ef)
+    return result
 
 
 def error_feedback_init(grads: Any):
@@ -316,18 +454,23 @@ def data_parallel(
     # different input shardings and silently recompile the whole program
     # (observed: an extra full ResNet-50 compile inside the timed loop).
     #
-    # The cache key includes the live autotuner's fusion threshold: the
-    # bucketing inside the traced step bakes the threshold read at trace
-    # time, so when HOROVOD_AUTOTUNE proposes a new value the step must
-    # retrace to actually change the bucket count (reference:
+    # The cache key includes every live autotuner knob (fusion
+    # threshold, bucket order, min buckets): the bucketing inside the
+    # traced step bakes the values read at trace time, so when
+    # HOROVOD_AUTOTUNE proposes a new configuration the step must
+    # retrace to actually change the bucket structure (reference:
     # parameter_manager.cc re-tunes the running job's fusion buffer).
     compiled_cache = {}
 
     def _autotune_key():
         from ..utils import autotune as _at
-        if _at.get_manager() is None:
+        pm = _at.get_manager()
+        if pm is None:
             return None
-        return _at.tuned_fusion_threshold(-1)
+        # ALL live knob values (fusion threshold, bucket order, min
+        # buckets, ...): any proposal the tuner applies must force a
+        # retrace, or the step keeps running the old bucketing.
+        return tuple(pm.values().items())
 
     def _autotune_record(args):
         from ..utils import autotune as _at

@@ -118,24 +118,59 @@ def _leaf_wire(dt, average: bool, dcn_wire: Optional[str]):
     return dcn_wire if jnp.issubdtype(dt, jnp.floating) else None
 
 
+def _fusion_groups(leaves, fusion_threshold_bytes: Optional[int] = None,
+                   bucket_order=None):
+    """The fused-buffer grouping shared by `hierarchical_allreduce` and
+    `hierarchical_error_feedback_init` (their per-buffer decisions must
+    never diverge): a list of `(dtype, idx_list)` groups.
+
+    Default (`fusion_threshold_bytes=None`): one group per dtype,
+    first-occurrence order — the historical single-buffer-per-dtype
+    behavior (and the EF state shape contract that goes with it).  With
+    a threshold, each dtype group is further split into size-capped
+    sub-buckets so the slow DCN tier of one bucket can overlap the ICI
+    tier / consumer of another; `bucket_order` permutes the leaf
+    traversal exactly as in `allreduce_gradients` ("reverse" =
+    backward-availability order)."""
+    from .data_parallel import _bucket_permutation, _buckets_by_nbytes
+
+    info = [jnp.asarray(leaf) for leaf in leaves]
+    by_dtype: dict = {}
+    for i in _bucket_permutation(len(leaves), bucket_order):
+        by_dtype.setdefault(info[i].dtype, []).append(i)
+    groups = []
+    for dt, idxs in by_dtype.items():
+        if fusion_threshold_bytes is None:
+            groups.append((dt, idxs))
+            continue
+        nbytes = [info[i].size * info[i].dtype.itemsize for i in idxs]
+        # Traversal was already permuted above; bucket forward here.
+        for b in _buckets_by_nbytes(nbytes, fusion_threshold_bytes):
+            if b:
+                groups.append((dt, [idxs[j] for j in b]))
+    return groups
+
+
 def hierarchical_error_feedback_init(tree: Any, ici_size: int,
                                      dcn_wire: Optional[str] = None,
-                                     average: bool = True):
+                                     average: bool = True,
+                                     fusion_threshold_bytes: Optional[int]
+                                     = None,
+                                     bucket_order=None):
     """Zero EF residuals for `hierarchical_allreduce(...,
     error_feedback_state=...)`: one f32 zero array per fused
-    WIRE-ELIGIBLE dtype buffer of `tree` (same by-dtype grouping,
-    first-occurrence order), each sized to this rank's DCN shard
-    (`dcn_shard_size(buffer, ici_size)`).  `dcn_wire=None` reads the
-    env route the allreduce itself would use."""
+    WIRE-ELIGIBLE buffer of `tree` (same grouping as the allreduce —
+    by-dtype first-occurrence order, sub-bucketed when
+    `fusion_threshold_bytes` is set), each sized to this rank's DCN
+    shard (`dcn_shard_size(buffer, ici_size)`).  `dcn_wire=None` reads
+    the env route the allreduce itself would use.  Pass the SAME
+    `fusion_threshold_bytes` / `bucket_order` as the allreduce call."""
     leaves, _ = jax.tree_util.tree_flatten(tree)
-    by_dtype: dict = {}
-    for leaf in leaves:
-        dt = jnp.asarray(leaf).dtype
-        by_dtype.setdefault(dt, 0)
-        by_dtype[dt] += jnp.asarray(leaf).size
     state = []
-    for dt, total in by_dtype.items():
+    for dt, idxs in _fusion_groups(leaves, fusion_threshold_bytes,
+                                   bucket_order):
         if _leaf_wire(dt, average, dcn_wire):
+            total = sum(jnp.asarray(leaves[i]).size for i in idxs)
             state.append(jnp.zeros((dcn_shard_size(total, ici_size),),
                                    jnp.float32))
     return state
@@ -148,16 +183,27 @@ def hierarchical_allreduce(
     average: bool = True,
     dcn_wire: Optional[str] = None,
     error_feedback_state: Any = None,
+    fusion_threshold_bytes: Optional[int] = None,
+    bucket_order=None,
 ):
     """Hierarchical allreduce of a pytree (gradients), fused: all leaves
     of one dtype are concatenated into a single flat buffer so the three
     collectives run once per dtype, not once per tensor (the fusion-buffer
     behavior of the reference, in-graph).
 
+    `fusion_threshold_bytes` caps each fused buffer, splitting a dtype
+    group into multiple buckets whose collective triples the scheduler
+    can pipeline — bucket k's slow DCN leg overlaps bucket k+1's ICI
+    reduce-scatter and the consumer of bucket k-1 (see
+    `allreduce_gradients` for `bucket_order`; "reverse" is
+    backward-availability order).  Default None keeps the historical
+    one-buffer-per-dtype fusion.
+
     `error_feedback_state` (quantized `dcn_wire` only; build with
-    `hierarchical_error_feedback_init`): sender-side EF residuals for
-    the DCN leg, one per wire-eligible dtype buffer.  When passed, the
-    return value is `(reduced_tree, new_state)`."""
+    `hierarchical_error_feedback_init`, passing the SAME
+    threshold/order): sender-side EF residuals for the DCN leg, one per
+    wire-eligible fused buffer.  When passed, the return value is
+    `(reduced_tree, new_state)`."""
     from ..common.basics import GLOBAL_AXIS
 
     ici_axis = ici_axis or GLOBAL_AXIS
@@ -166,14 +212,12 @@ def hierarchical_allreduce(
         return ((tree, error_feedback_state)
                 if error_feedback_state is not None else tree)
     out = [None] * len(leaves)
-    by_dtype = {}
-    for i, leaf in enumerate(leaves):
-        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
     ef_iter = (iter(error_feedback_state)
                if error_feedback_state is not None else None)
     new_ef = []
     wired_buffers = 0
-    for dt, idxs in by_dtype.items():
+    for dt, idxs in _fusion_groups(leaves, fusion_threshold_bytes,
+                                   bucket_order):
         flats = [jnp.ravel(leaves[i]) for i in idxs]
         sizes = [f.size for f in flats]
         buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]

@@ -26,11 +26,12 @@ from ..common.basics import ProcessSet
 from ..metrics import catalog as _met
 from ..ops import collectives as C
 from ..ops.compression import Compression
-from .data_parallel import allreduce_gradients
+from .data_parallel import (allreduce_gradients, gradient_bucket_partition,
+                            reduce_gradient_buckets)
 
 
 class DistributedOptState(NamedTuple):
-    inner: Any
+    inner: Any          # inner optax state; per-bucket tuple when fused
     accum: Any          # local gradient accumulator
     counter: jnp.ndarray  # passes since last sync
 
@@ -44,25 +45,101 @@ def DistributedGradientTransformation(
     axis_name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
     fusion_threshold_bytes: Optional[int] = None,
+    bucket_order=None,
+    fused_apply: bool = False,
+    early_reduction: bool = False,
 ) -> optax.GradientTransformation:
     """Wrap `optimizer` so updates are computed from cross-rank-reduced
-    gradients.  See module docstring for the reference mapping."""
+    gradients.  See module docstring for the reference mapping.
+
+    `fused_apply=True` replaces the global apply barrier with per-bucket
+    update chains: the inner optimizer state is partitioned by the same
+    `gradient_bucket_partition` the reduction uses, and each bucket's
+    optax update is emitted against only that bucket's reduction result
+    — so XLA can schedule bucket k's param update while bucket k+1's
+    collective is still in flight.  Requires an ELEMENTWISE inner
+    optimizer (sgd/momentum/adam/...); transformations coupling leaves
+    across buckets (e.g. clip_by_global_norm) would see only their
+    bucket.  The partition is baked at `init`; if a live autotuner moves
+    the threshold/order afterwards, `update` raises rather than
+    silently mispartitioning — re-init after tunables change.
+    Incompatible with op=Adasum (delta-combining needs the full update).
+
+    `early_reduction=True` (with `backward_passes_per_step` > 1) reduces
+    EVERY pass's gradients cross-rank immediately — overlapping pass
+    k's collective with pass k+1's backward — and accumulates the
+    reduced values, applying without a further sync on the Nth pass.
+    Numerically identical by linearity of the reduction (bitwise for
+    exactly-representable addends); trades N-1 extra collectives for
+    overlap.  Incompatible with op=Adasum."""
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    if op is C.Adasum and (fused_apply or early_reduction):
+        raise ValueError(
+            "fused_apply / early_reduction are incompatible with "
+            "op=Adasum: Adasum combines post-update deltas, so there is "
+            "no per-bucket reduction result to consume early")
 
     def reduce_grads(grads):
         return allreduce_gradients(
             grads, op=op, compression=compression, axis_name=axis_name,
             process_set=process_set,
             fusion_threshold_bytes=fusion_threshold_bytes,
+            bucket_order=bucket_order,
         )
 
+    def _partition(leaves):
+        return gradient_bucket_partition(
+            leaves, compression=compression,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            bucket_order=bucket_order)
+
     def init_fn(params):
-        inner = optimizer.init(params)
+        if fused_apply:
+            leaves, _ = jax.tree_util.tree_flatten(params)
+            inner = tuple(
+                optimizer.init([leaves[i] for i in idxs])
+                for idxs in _partition(leaves))
+        else:
+            inner = optimizer.init(params)
         accum = jax.tree_util.tree_map(jnp.zeros_like, params)
         return DistributedOptState(inner, accum, jnp.zeros((), jnp.int32))
 
-    def _sync_update(grads, state, params):
+    def _fused_update(grads, state, params, pre_reduced):
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        p_leaves = (jax.tree_util.tree_flatten(params)[0]
+                    if params is not None else None)
+        parts = _partition(leaves)
+        if len(parts) != len(state.inner):
+            raise ValueError(
+                f"fused_apply bucket partition changed since init "
+                f"({len(state.inner)} -> {len(parts)} buckets): the "
+                "fusion threshold / bucket order moved under the state "
+                "(autotuner proposal?) — re-init the optimizer state "
+                "after tunables change")
+        if pre_reduced:
+            results = [(idxs, [leaves[i] for i in idxs]) for idxs in parts]
+        else:
+            results, _ = reduce_gradient_buckets(
+                leaves, op=op, compression=compression,
+                axis_name=axis_name, process_set=process_set,
+                fusion_threshold_bytes=fusion_threshold_bytes,
+                bucket_order=bucket_order)
+        out = [None] * len(leaves)
+        new_inner = []
+        # Apply each bucket's update against ONLY its own reduction
+        # result: no cross-bucket data dependency, so the scheduler is
+        # free to interleave updates with in-flight collectives.
+        for (idxs, reduced), bstate in zip(results, state.inner):
+            bparams = ([p_leaves[i] for i in idxs]
+                       if p_leaves is not None else None)
+            u, s2 = optimizer.update(list(reduced), bstate, bparams)
+            new_inner.append(s2)
+            for i, ui in zip(idxs, u):
+                out[i] = ui
+        return jax.tree_util.tree_unflatten(treedef, out), tuple(new_inner)
+
+    def _sync_update(grads, state, params, pre_reduced=False):
         if op is C.Adasum:
             # Adasum mode: compute the local delta first, then combine
             # deltas with the projection-corrected reduction (reference:
@@ -73,8 +150,12 @@ def DistributedGradientTransformation(
                                       process_set=process_set),
                 updates,
             )
+        elif fused_apply:
+            updates, inner = _fused_update(grads, state, params,
+                                           pre_reduced)
         else:
-            grads = reduce_grads(grads)
+            if not pre_reduced:
+                grads = reduce_grads(grads)
             updates, inner = optimizer.update(grads, state.inner, params)
         if _met.enabled() and not any(
                 isinstance(l, jax.core.Tracer)
@@ -93,11 +174,16 @@ def DistributedGradientTransformation(
 
         return optax.GradientTransformation(init_fn, update_fn)
 
-    # Local aggregation: accumulate N passes, sync on the Nth.
+    # Local aggregation: accumulate N passes, sync on the Nth.  With
+    # early_reduction the sync moves INTO each pass (reduce now, while
+    # the next microbatch's backward can overlap it) and the Nth pass
+    # applies the already-reduced accumulator.
     scale = (1.0 / backward_passes_per_step
              if average_aggregated_gradients else 1.0)
 
     def update_fn(grads, state, params=None):
+        if early_reduction:
+            grads = reduce_grads(grads)
         accum = jax.tree_util.tree_map(
             lambda a, g: a + g, state.accum, grads
         )
@@ -108,7 +194,8 @@ def DistributedGradientTransformation(
             agg = jax.tree_util.tree_map(
                 lambda a: (a * scale).astype(a.dtype), accum
             )
-            updates, inner = _sync_update(agg, state, params)
+            updates, inner = _sync_update(agg, state, params,
+                                          pre_reduced=early_reduction)
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, accum)
             return updates, inner, zeroed, jnp.zeros((), jnp.int32)
 

@@ -151,8 +151,11 @@ class _HostAgentImpl:
 class RayHostDiscovery(HostDiscovery):
     """Cluster membership from `ray.nodes()` (reference:
     horovod/ray/elastic.py RayHostDiscovery): alive nodes map to
-    {hostname: slots} with slots = floor(CPU / cpus_per_slot), capped to
-    at least `min_slots` when the node advertises no CPU resource."""
+    {hostname: slots} with slots = floor(CPU / cpus_per_slot).  The
+    `min_slots` floor applies ONLY when the node advertises no CPU
+    resource at all; a node advertising fractional/small CPU below
+    `cpus_per_slot` gets 0 slots — advertised capacity is authoritative
+    and is never oversubscribed."""
 
     def __init__(self, ray_mod=None, cpus_per_slot: int = 1,
                  min_slots: int = 1):
@@ -171,11 +174,16 @@ class RayHostDiscovery(HostDiscovery):
                     or node.get("NodeManagerAddress"))
             if not host:
                 continue
-            cpus = node.get("Resources", {}).get("CPU", 0)
-            slots = int(cpus) // self._cpus_per_slot
-            # The floor applies only when the node advertises no usable
-            # CPU resource — never oversubscribe a node that does.
-            hosts[host] = slots if slots > 0 else self._min_slots
+            resources = node.get("Resources", {})
+            if "CPU" in resources:
+                # Advertised CPU is authoritative: below cpus_per_slot
+                # the node gets 0 slots (get_host_assignments skips it)
+                # — never oversubscribe a node that advertises capacity.
+                hosts[host] = int(resources["CPU"]) // self._cpus_per_slot
+            else:
+                # The floor applies only when the node advertises no
+                # CPU resource at all (e.g. accelerator-only nodes).
+                hosts[host] = self._min_slots
         return hosts
 
 

@@ -323,6 +323,15 @@ def init_from_env() -> Optional[ParameterManager]:
     pm.register("fusion_threshold", 1 << 20, 256 << 20, log_scale=True,
                 integer=True,
                 initial=util.env_int("FUSION_THRESHOLD", 64 << 20))
+    # Overlap-pipeline knobs: bucket-formation order (0=forward,
+    # 1=reverse backward-availability) and a minimum bucket count that
+    # caps the effective threshold — more, smaller buckets give XLA's
+    # latency-hiding scheduler finer interleave points at the cost of
+    # per-collective overhead.  Both feed gradient_bucket_partition.
+    pm.register("bucket_order", 0, len(_BUCKET_ORDERS) - 1, integer=True,
+                initial=_BUCKET_ORDERS.index(_env_bucket_order()))
+    pm.register("min_buckets", 1, 16, integer=True,
+                initial=util.env_int("MIN_BUCKETS", 1))
     _manager = pm
     logger.info("autotune enabled: %s", pm.values())
     return pm
@@ -331,6 +340,48 @@ def init_from_env() -> Optional[ParameterManager]:
 def shutdown_manager() -> None:
     global _manager
     _manager = None
+
+
+# Bucket-formation traversal orders the tuner can pick between (index
+# into this tuple is the knob's integer value).
+_BUCKET_ORDERS = ("forward", "reverse")
+
+
+def _env_bucket_order() -> str:
+    order = util.getenv("BUCKET_ORDER") or "reverse"
+    if order not in _BUCKET_ORDERS:
+        raise ValueError(
+            f"HOROVOD_BUCKET_ORDER must be one of {_BUCKET_ORDERS}, "
+            f"got {order!r}")
+    return order
+
+
+def tuned_bucket_order(default: str) -> str:
+    """Bucket-formation order honoring the autotuner when active."""
+    if _manager is not None and "bucket_order" in _manager._tunables:
+        return _BUCKET_ORDERS[int(_manager.value("bucket_order"))]
+    return default
+
+
+def current_bucket_order() -> str:
+    """The live bucket-formation order: HOROVOD_BUCKET_ORDER ("reverse"
+    default — backward-availability order, see
+    allreduce_gradients), overridden by the autotuner when active."""
+    return tuned_bucket_order(_env_bucket_order())
+
+
+def tuned_min_buckets(default: int) -> int:
+    """Minimum gradient bucket count honoring the autotuner when
+    active (caps the effective fusion threshold)."""
+    if _manager is not None and "min_buckets" in _manager._tunables:
+        return max(1, int(_manager.value("min_buckets")))
+    return default
+
+
+def current_min_buckets() -> int:
+    """The live minimum bucket count: HOROVOD_MIN_BUCKETS (1 = no
+    floor), overridden by the autotuner when active."""
+    return tuned_min_buckets(max(1, util.env_int("MIN_BUCKETS", 1)))
 
 
 def tuned_fusion_threshold(default: int) -> int:

@@ -95,4 +95,66 @@ def scan_steps(step_fn: Callable[[Any, Any], Tuple[Any, Any]],
         scan_body(step_fn, k, out_mode))
 
 
-__all__ = ["repeat_body", "scan_body", "repeat_steps", "scan_steps"]
+def early_reduction_body(grad_fn: Callable[[Any, Any], Any], k: int,
+                         reduce_fn: Callable[[Any], Any] = None,
+                         average: bool = True) -> Callable:
+    """Unjitted `fn(params, batches) -> reduced_grads` accumulating
+    gradients over k microbatches stacked on a leading [k, ...] axis,
+    with microbatch j's cross-rank reduction issued BEFORE microbatch
+    j+1's backward — the overlap-aware alternative to accumulating
+    locally and reducing once on the Nth pass.
+
+    The loop is UNROLLED (not lax.scan) so XLA's latency-hiding
+    scheduler can pipeline reduction j against backward j+1, and the
+    partial sums alternate between TWO accumulators (double buffering):
+    consecutive iterations' adds carry no data dependency on each
+    other, keeping the accumulate off the collective's critical path.
+
+    `grad_fn(params, microbatch) -> grads` is the per-rank local
+    gradient; `reduce_fn(grads) -> reduced` is the cross-rank reduction
+    (default: `allreduce_gradients` with the live bucket order and
+    fusion threshold).  `average=True` divides the k-sum by k, matching
+    `backward_passes_per_step`'s average_aggregated_gradients.
+
+    Numerics: the reduction is linear, so
+    `sum_j reduce(g_j) == reduce(sum_j g_j)` mathematically; equality
+    is bitwise when every addend is exactly representable (e.g.
+    integer-valued floats with k a power of two — tested), and holds to
+    f32 tolerance otherwise.  Compose inside `hvd.data_parallel` /
+    shard_map like the other megastep bodies.
+    """
+    if not isinstance(k, int) or k < 1:
+        raise HorovodTpuError(
+            f"megastep: k must be an int >= 1, got {k!r}")
+    if reduce_fn is None:
+        from ..parallel.data_parallel import allreduce_gradients
+        reduce_fn = allreduce_gradients
+
+    def many(params, batches):
+        acc = [None, None]
+        for j in range(k):
+            mb = jax.tree.map(lambda b: b[j], batches)
+            r = reduce_fn(grad_fn(params, mb))
+            prev = acc[j % 2]
+            acc[j % 2] = r if prev is None else jax.tree.map(
+                lambda a, g: a + g, prev, r)
+        total = acc[0] if acc[1] is None else jax.tree.map(
+            lambda a, b: a + b, acc[0], acc[1])
+        if average:
+            total = jax.tree.map(
+                lambda g: (g / k).astype(g.dtype), total)
+        return total
+
+    return many
+
+
+def early_reduction_steps(grad_fn: Callable[[Any, Any], Any], k: int,
+                          reduce_fn: Callable[[Any], Any] = None,
+                          average: bool = True) -> Callable:
+    """Jitted `early_reduction_body` (params are read, not updated —
+    nothing to donate)."""
+    return jax.jit(early_reduction_body(grad_fn, k, reduce_fn, average))
+
+
+__all__ = ["repeat_body", "scan_body", "repeat_steps", "scan_steps",
+           "early_reduction_body", "early_reduction_steps"]

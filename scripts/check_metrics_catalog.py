@@ -1,7 +1,9 @@
 #!/usr/bin/env python3
 """Lint: every metric registered in horovod_tpu/metrics/catalog.py must be
 documented in docs/METRICS.md (and the doc must not list series the code
-no longer emits).
+no longer emits).  Likewise every autotuner knob registered in
+horovod_tpu/utils/autotune.py `init_from_env` must appear in
+docs/AUTOTUNE.md.
 
 Pure text parsing — no imports of horovod_tpu (CI machines running this
 lint need no jax).  Exit 1 on drift, printing one line per offense.
@@ -17,6 +19,8 @@ from pathlib import Path
 
 CATALOG = "horovod_tpu/metrics/catalog.py"
 DOC = "docs/METRICS.md"
+AUTOTUNE = "horovod_tpu/utils/autotune.py"
+AUTOTUNE_DOC = "docs/AUTOTUNE.md"
 
 # _REG.counter(\n    "hvd_name", ... — the name is the first string
 # literal after the registration call.
@@ -26,6 +30,9 @@ _REG_RE = re.compile(
 
 # Doc catalog rows: a markdown table line whose first cell is `hvd_*`.
 _DOC_ROW_RE = re.compile(r"^\|\s*`(hvd_[a-z0-9_]+)`", re.MULTILINE)
+
+# pm.register("knob_name", ... in autotune.py init_from_env.
+_KNOB_RE = re.compile(r"pm\.register\(\s*\"([a-z_]+)\"", re.MULTILINE)
 
 
 def main(argv=None) -> int:
@@ -53,8 +60,26 @@ def main(argv=None) -> int:
         print(f"stale doc entry: {name} (listed in {DOC}, not registered "
               f"in {CATALOG})")
         rc = 1
+
+    # Autotuner knobs: every registered knob must be named (as `knob`)
+    # somewhere in docs/AUTOTUNE.md.
+    knobs = set(_KNOB_RE.findall((root / AUTOTUNE).read_text()))
+    if not knobs:
+        print(f"error: no pm.register(...) knobs found in {AUTOTUNE} "
+              "(parser out of date?)")
+        return 1
+    at_doc_path = root / AUTOTUNE_DOC
+    at_doc = at_doc_path.read_text() if at_doc_path.exists() else ""
+    for knob in sorted(knobs):
+        if f"`{knob}`" not in at_doc:
+            print(f"undocumented autotune knob: {knob} (registered in "
+                  f"{AUTOTUNE} init_from_env, no `{knob}` mention in "
+                  f"{AUTOTUNE_DOC})")
+            rc = 1
+
     if rc == 0:
-        print(f"ok: {len(declared)} metrics declared and documented")
+        print(f"ok: {len(declared)} metrics declared and documented; "
+              f"{len(knobs)} autotune knobs documented")
     return rc
 
 

@@ -19,6 +19,7 @@ Usage: python scripts/chip_queue.py   # runs until queue done or killed
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -82,6 +83,28 @@ def probe() -> bool:
     return False
 
 
+def kill_process_group(proc):
+    """SIGTERM then SIGKILL the item's whole process group.  Bench items
+    spawn their own subprocess trees (bench.py sim children, launchers);
+    killing only the direct child leaves wedged grandchildren holding
+    the TPU tunnel."""
+    try:
+        pgid = os.getpgid(proc.pid)
+    except (ProcessLookupError, PermissionError):
+        return
+    for sig, grace in ((signal.SIGTERM, 10), (signal.SIGKILL, 5)):
+        try:
+            os.killpg(pgid, sig)
+        except (ProcessLookupError, PermissionError):
+            return
+        try:
+            proc.wait(timeout=grace)
+            return
+        except subprocess.TimeoutExpired:
+            continue
+    log(f"process group {pgid} survived SIGKILL (kernel-stuck?)")
+
+
 def run_item(name, argv, timeout):
     os.makedirs(LOGDIR, exist_ok=True)
     logpath = os.path.join(LOGDIR, f"{name}.log")
@@ -90,12 +113,16 @@ def run_item(name, argv, timeout):
     with open(logpath, "a") as f:
         f.write(f"\n==== {time.strftime('%F %T')} {' '.join(argv)}\n")
         f.flush()
+        # start_new_session puts the item in its own process group so a
+        # timeout can kill the whole tree, not just the direct child.
+        proc = subprocess.Popen(argv, cwd=REPO, stdout=f,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
         try:
-            r = subprocess.run(argv, cwd=REPO, stdout=f,
-                               stderr=subprocess.STDOUT, timeout=timeout)
-            rc = r.returncode
+            rc = proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
             rc = "timeout"
+            kill_process_group(proc)
     log(f"{name}: rc={rc} in {time.time() - t0:.0f}s")
     return rc
 

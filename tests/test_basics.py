@@ -64,3 +64,11 @@ def test_cannot_remove_global_set():
 def test_out_of_range_process_set():
     with pytest.raises(HorovodTpuError):
         hvd.add_process_set([0, 99])
+
+
+def test_duplicate_ranks_in_process_set_rejected():
+    # A repeated rank would silently shrink the set after dedup (and
+    # downstream axis_index_groups must cover the axis exactly once) —
+    # reject loudly at registration instead.
+    with pytest.raises(HorovodTpuError, match="duplicate"):
+        hvd.add_process_set([0, 2, 2, 4])

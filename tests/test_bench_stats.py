@@ -14,27 +14,34 @@ import bench  # noqa: E402
 
 
 def _feed(monkeypatch, times):
-    """times: list of (t1, t8) per pair (+ final t8_nodist appended)."""
+    """times: list of (t1, t8) per pair; the compute-only and legacy
+    pipeline probes of the extras block are fed the last pair's t8."""
     seq = []
     for t1, t8 in times:
         seq += [t1, t8]
     seq.append(times[-1][1])     # the compute-only probe
+    seq.append(times[-1][1])     # the legacy-pipeline probe
     it = iter(seq)
     monkeypatch.setattr(bench, "_run_sim",
-                        lambda n, dist, timeout: next(it))
+                        lambda n, dist, timeout, legacy=False: next(it))
 
 
 class TestSimScalingStats:
     def test_median_of_three_pairs(self, monkeypatch):
         monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "3")
         _feed(monkeypatch, [(1.0, 8.9), (1.0, 8.7), (1.0, 8.8)])
-        median, spread, effs, ci, rejected = \
+        median, spread, effs, ci, rejected, extras = \
             bench.sim_scaling_efficiency(runs=3)
         assert effs == pytest.approx([8 / 8.9, 8 / 8.7, 8 / 8.8])
         assert median == pytest.approx(8 / 8.8)
         assert spread == pytest.approx(8 / 8.7 - 8 / 8.9)
         assert rejected == 0
         assert min(effs) <= ci[0] <= ci[1] <= max(effs)
+        # Extras: both pipelines' collective-share decomposition rides
+        # along.  The probes are fed the median t8, so share == 0 here.
+        assert extras["t8_ms"] == pytest.approx(8800.0)
+        assert extras["collective_share"] == pytest.approx(0.0)
+        assert extras["collective_share_legacy"] == pytest.approx(0.0)
 
     def test_pairs_above_one_rejected(self, monkeypatch):
         # Contention-inflated t1 pushes a pair above 1.0: superlinear
@@ -44,7 +51,7 @@ class TestSimScalingStats:
         monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "3")
         _feed(monkeypatch, [(1.5, 8.0), (1.0, 8.9), (1.0, 9.0),
                             (1.0, 8.8)])
-        median, spread, effs, ci, rejected = \
+        median, spread, effs, ci, rejected, extras = \
             bench.sim_scaling_efficiency(runs=3)
         assert rejected == 1
         assert all(e <= 1.0 for e in effs)
@@ -58,7 +65,7 @@ class TestSimScalingStats:
         monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "5")
         _feed(monkeypatch, [(1.0, 8.0), (0.5, 8.0), (1.0, 8.2),
                             (1.0, 8.4), (1.0, 8.6)])
-        median, spread, effs, ci, rejected = \
+        median, spread, effs, ci, rejected, extras = \
             bench.sim_scaling_efficiency(runs=3)
         assert len(effs) == 5
         s = sorted(effs)
@@ -67,11 +74,11 @@ class TestSimScalingStats:
 
     def test_failed_pair_retried(self, monkeypatch):
         monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "3")
-        seq = [1.0, None, 1.0, 8.9, 1.0, 8.8, 1.0, 8.7, 8.5]
+        seq = [1.0, None, 1.0, 8.9, 1.0, 8.8, 1.0, 8.7, 8.5, 8.6]
         it = iter(seq)
         monkeypatch.setattr(bench, "_run_sim",
-                            lambda n, dist, timeout: next(it))
-        median, spread, effs, ci, rejected = \
+                            lambda n, dist, timeout, legacy=False: next(it))
+        median, spread, effs, ci, rejected, extras = \
             bench.sim_scaling_efficiency(runs=3)
         assert len(effs) == 3   # the failed attempt was retried
         assert rejected == 0
@@ -95,5 +102,5 @@ class TestSimScalingStats:
         seq = [1.5, 8.0] * 10 + [8.0]
         it = iter(seq)
         monkeypatch.setattr(bench, "_run_sim",
-                            lambda n, dist, timeout: next(it))
+                            lambda n, dist, timeout, legacy=False: next(it))
         assert bench.sim_scaling_efficiency(runs=3) is None

@@ -158,3 +158,178 @@ def test_data_parallel_training_decreases_loss(mesh):
         w, opt_state, loss = compiled(w, opt_state, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.1
+
+
+# ---------------------------------------------------------------------------
+# Overlap-aware pipeline: fused per-bucket apply + early reduction
+# ---------------------------------------------------------------------------
+
+
+def _stacked_grads(seed, shapes, integral=False):
+    rng = np.random.RandomState(seed)
+    out = []
+    for s in shapes:
+        v = rng.randn(N, *s)
+        if integral:
+            v = np.round(v * 4)
+        out.append(jnp.asarray(v, jnp.float32))
+    return out
+
+
+def _per_rank_updates(opt, params_leaves, stacked, steps=3):
+    """Run `steps` opt.update calls under shard_map with distinct
+    per-rank gradient shards; returns the final updates + params."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = hvd.global_mesh()
+    n = len(stacked)
+
+    def body(*xs):
+        grads = [x[0] for x in xs]
+        params = list(params_leaves)
+        state = opt.init(params)
+        for _ in range(steps):
+            u, state = opt.update(grads, state, params)
+            params = [p + ui for p, ui in zip(params, u)]
+        return params
+
+    sm = shard_map(
+        body, mesh=mesh,
+        in_specs=tuple(P(hvd.GLOBAL_AXIS) for _ in range(n)),
+        out_specs=P(), check_vma=False)
+    return jax.jit(sm)(*stacked)
+
+
+class TestFusedApply:
+    SHAPES = [(5, 3), (7,), (2, 2, 2), (11,)]
+
+    @pytest.mark.parametrize("compression_name,order,tol",
+                             [("none", "forward", 0.0),
+                              ("none", "reverse", 0.0),
+                              ("fp16", "reverse", 0.0),
+                              ("int8", "reverse", None)])
+    def test_fused_matches_barriered(self, compression_name, order, tol):
+        """Per-bucket fused apply must produce the same trajectory as
+        the barriered reduce-then-global-update path: SGD-momentum is
+        elementwise, and both paths reduce through identical buckets.
+        Exact/fp16 wires: bitwise.  int8: same collective sequence, so
+        still bitwise — asserted with zero tolerance too, but kept
+        separate in case the wire grows order-dependent rounding."""
+        comp = getattr(hvd.Compression, compression_name)
+        stacked = _stacked_grads(0, self.SHAPES)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        kw = dict(compression=comp, fusion_threshold_bytes=64,
+                  bucket_order=order, axis_name=hvd.GLOBAL_AXIS)
+        plain = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                         **kw)
+        fused = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                         fused_apply=True, **kw)
+        got_p = _per_rank_updates(plain, params, stacked)
+        got_f = _per_rank_updates(fused, params, stacked)
+        for a, b in zip(got_p, got_f):
+            if tol:
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=tol)
+            else:
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+
+    def test_fused_state_is_per_bucket(self):
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                       fused_apply=True,
+                                       fusion_threshold_bytes=64)
+        from horovod_tpu.parallel.data_parallel import \
+            gradient_bucket_partition
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        state = opt.init(params)
+        parts = gradient_bucket_partition(params,
+                                          fusion_threshold_bytes=64)
+        assert isinstance(state.inner, tuple)
+        assert len(state.inner) == len(parts) > 1
+
+    def test_partition_drift_raises(self, monkeypatch):
+        """The autotuner moving the fusion threshold between init and
+        update must fail loudly, not silently mispartition the state."""
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        grads = [jnp.ones(s, jnp.float32) for s in self.SHAPES]
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 26))
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1), fused_apply=True)
+        state = opt.init(params)
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "16")
+        with pytest.raises(ValueError, match="re-init"):
+            opt.update(grads, state, params)
+
+    def test_adasum_incompatible(self):
+        with pytest.raises(ValueError, match="Adasum"):
+            hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum,
+                                     fused_apply=True)
+        with pytest.raises(ValueError, match="Adasum"):
+            hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum,
+                                     backward_passes_per_step=2,
+                                     early_reduction=True)
+
+
+class TestEarlyReduction:
+    def test_matches_accumulate_then_sync_bitwise(self):
+        """Reducing every pass and accumulating the reduced values must
+        match accumulate-locally-then-reduce-once BIT FOR BIT when the
+        addends are exactly representable: integer-valued f32 grads and
+        k=4 a power of two (so the /k average is exact)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        k = 4
+        shapes = [(6,), (3, 2)]
+        mesh = hvd.global_mesh()
+        # [rank, pass, ...] integer-valued gradients, distinct per rank.
+        rng = np.random.RandomState(1)
+        stacked = [jnp.asarray(np.round(rng.randn(N, k, *s) * 8),
+                               jnp.float32) for s in shapes]
+        params = [jnp.zeros(s, jnp.float32) for s in shapes]
+
+        def run(early):
+            opt = hvd.DistributedOptimizer(
+                optax.sgd(1.0), backward_passes_per_step=k,
+                early_reduction=early, axis_name=hvd.GLOBAL_AXIS)
+
+            def body(*xs):
+                state = opt.init(list(params))
+                p = list(params)
+                for j in range(k):
+                    g = [x[0, j] for x in xs]
+                    u, state = opt.update(g, state, p)
+                    p = [pi + ui for pi, ui in zip(p, u)]
+                return p
+
+            sm = shard_map(
+                body, mesh=mesh,
+                in_specs=tuple(P(hvd.GLOBAL_AXIS) for _ in shapes),
+                out_specs=P(), check_vma=False)
+            return jax.jit(sm)(*stacked)
+
+        late, early = run(False), run(True)
+        for a, b in zip(late, early):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # And both equal the mean over all rank-pass gradients, averaged
+        # over the k passes, applied once with lr=1.
+        for p, s in zip(late, stacked):
+            ref = -np.mean(np.asarray(s), axis=(0, 1))
+            np.testing.assert_array_equal(np.asarray(p), ref)
+
+    def test_eager_early_reduction(self):
+        """Eager path (no mesh axis): every rank sees the same gradient,
+        so the early reduction is an identity average and the schedule
+        matches plain backward_passes_per_step exactly."""
+        w = jnp.ones((3,), jnp.float32)
+        g1 = jnp.asarray([2.0, 4.0, 6.0])
+        g2 = jnp.asarray([4.0, 2.0, 0.0])
+        opt = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                       backward_passes_per_step=2,
+                                       early_reduction=True)
+        state = opt.init(w)
+        u1, state = opt.update(g1, state, w)
+        np.testing.assert_array_equal(np.asarray(u1), 0.0)
+        u2, state = opt.update(g2, state, w)
+        np.testing.assert_array_equal(np.asarray(u2),
+                                      -np.asarray((g1 + g2) / 2))

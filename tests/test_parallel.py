@@ -292,3 +292,203 @@ class TestTransformer:
             sparams, sopt, loss = step(sparams, sopt, batch)
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.8, losses
+
+
+class TestGradientBucketing:
+    """Overlap-aware bucket pipeline (parallel/data_parallel.py):
+    reverse-availability bucket formation must never change WHAT is
+    reduced, only WHEN each bucket's collective can issue."""
+
+    def _stacked(self, seed, shapes, dtype=np.float32, integral=False):
+        rng = np.random.RandomState(seed)
+        out = []
+        for s in shapes:
+            v = rng.randn(hvd.size(), *s)
+            if integral:
+                v = np.round(v * 4)
+            out.append(jnp.asarray(v, dtype))
+        return out
+
+    def _reduce_per_rank(self, fn, stacked):
+        """Run fn(per-rank leaves) under shard_map, one distinct shard
+        per rank, results replicated."""
+        from jax import shard_map
+        mesh = hvd.global_mesh()
+        n_in = len(stacked)
+        sm = shard_map(
+            lambda *xs: fn([x[0] for x in xs]),
+            mesh=mesh, in_specs=tuple(P(hvd.GLOBAL_AXIS)
+                                      for _ in range(n_in)),
+            out_specs=P(), check_vma=False)
+        return jax.jit(sm)(*stacked)
+
+    def test_permutation_orders(self):
+        from horovod_tpu.parallel.data_parallel import _bucket_permutation
+        assert _bucket_permutation(3, None) == [0, 1, 2]
+        assert _bucket_permutation(3, "forward") == [0, 1, 2]
+        assert _bucket_permutation(3, "reverse") == [2, 1, 0]
+        assert _bucket_permutation(3, (1, 2, 0)) == [1, 2, 0]
+
+    def test_permutation_rejects_bad(self):
+        from horovod_tpu.parallel.data_parallel import _bucket_permutation
+        with pytest.raises(ValueError, match="bucket_order"):
+            _bucket_permutation(3, "sideways")
+        with pytest.raises(ValueError):
+            _bucket_permutation(3, [0, 0, 1])     # repeat
+        with pytest.raises(ValueError):
+            _bucket_permutation(3, [0, 1])        # short
+
+    def test_partition_forward_vs_reverse(self):
+        from horovod_tpu.parallel.data_parallel import \
+            gradient_bucket_partition
+        leaves = [np.zeros((4,), np.float32), np.zeros((2,), np.float32),
+                  np.zeros((8,), np.float32)]
+        fwd = gradient_bucket_partition(
+            leaves, fusion_threshold_bytes=24, bucket_order="forward")
+        rev = gradient_bucket_partition(
+            leaves, fusion_threshold_bytes=24, bucket_order="reverse")
+        assert fwd == [[0, 1], [2]]
+        # Reverse-availability: the LAST leaves (produced first by the
+        # backward pass) lead the partition.
+        assert rev == [[2], [1, 0]]
+        for part in (fwd, rev):
+            assert sorted(i for b in part for i in b) == [0, 1, 2]
+
+    def test_partition_one_bucket_under_default_threshold(self):
+        from horovod_tpu.parallel.data_parallel import \
+            gradient_bucket_partition
+        leaves = [np.zeros((16,), np.float32) for _ in range(5)]
+        assert len(gradient_bucket_partition(leaves)) == 1
+
+    def test_min_buckets_floor(self, monkeypatch):
+        from horovod_tpu.parallel.data_parallel import \
+            gradient_bucket_partition
+        leaves = [np.zeros((16,), np.float32) for _ in range(8)]
+        monkeypatch.setenv("HOROVOD_MIN_BUCKETS", "4")
+        parts = gradient_bucket_partition(leaves)
+        assert len(parts) >= 4
+        assert sorted(i for b in parts for i in b) == list(range(8))
+
+    def test_quantized_partition_isolates_int_leaves(self):
+        from horovod_tpu.parallel.data_parallel import \
+            gradient_bucket_partition
+        from horovod_tpu import Compression
+        leaves = [np.zeros((4,), np.float32), np.zeros((3,), np.int32),
+                  np.zeros((4,), np.float32)]
+        parts = gradient_bucket_partition(leaves,
+                                          compression=Compression.int8)
+        # Integer leaves reduce exactly in their own leading bucket.
+        assert parts[0] == [1]
+        assert sorted(i for b in parts for i in b) == [0, 1, 2]
+
+    @pytest.mark.parametrize("compression_name", ["none", "fp16"])
+    def test_order_invariance_bitwise(self, compression_name):
+        """Exact and fp16 wires never mix elements across leaves, so
+        forward and reverse bucket orders are BITWISE identical."""
+        from horovod_tpu import Compression
+        from horovod_tpu.parallel.data_parallel import allreduce_gradients
+        comp = getattr(Compression, compression_name)
+        stacked = self._stacked(0, [(5, 3), (7,), (2, 2, 2), (11,)])
+
+        def mk(order):
+            return self._reduce_per_rank(
+                lambda leaves: allreduce_gradients(
+                    leaves, compression=comp, fusion_threshold_bytes=64,
+                    bucket_order=order),
+                stacked)
+
+        fwd, rev = mk("forward"), mk("reverse")
+        for f, r in zip(fwd, rev):
+            np.testing.assert_array_equal(np.asarray(f), np.asarray(r))
+        # And both match the plain mean across ranks (exact wire).
+        if compression_name == "none":
+            for f, s in zip(fwd, stacked):
+                np.testing.assert_allclose(
+                    np.asarray(f), np.mean(np.asarray(s), axis=0),
+                    rtol=1e-6, atol=1e-6)
+
+    def test_quantized_order_tolerance_with_ef(self):
+        """int8 wire: bucket order shifts chunk-scale boundaries, so
+        forward vs reverse agree only to wire tolerance — and the EF
+        residual state threads through with one leaf per float grad."""
+        from horovod_tpu import Compression
+        from horovod_tpu.parallel.data_parallel import (
+            allreduce_gradients, error_feedback_init)
+        stacked = self._stacked(1, [(6, 4), (9,), (3, 5)])
+
+        def mk(order):
+            def f(leaves):
+                ef = error_feedback_init(leaves)
+                reduced, new_ef = allreduce_gradients(
+                    leaves, compression=Compression.int8,
+                    axis_name=hvd.GLOBAL_AXIS,
+                    fusion_threshold_bytes=80, bucket_order=order,
+                    error_feedback_state=ef)
+                return reduced, new_ef
+            return self._reduce_per_rank(f, stacked)
+
+        (r_f, ef_f), (r_r, ef_r) = mk("forward"), mk("reverse")
+        for a, b, s in zip(r_f, r_r, stacked):
+            ref = np.mean(np.asarray(s), axis=0)
+            scale = max(1.0, float(np.abs(ref).max()))
+            np.testing.assert_allclose(np.asarray(a), ref,
+                                       atol=0.1 * scale)
+            np.testing.assert_allclose(np.asarray(b), ref,
+                                       atol=0.1 * scale)
+        for e, s in zip(ef_f, stacked):
+            assert e.shape == s.shape[1:]
+        for e, s in zip(ef_r, stacked):
+            assert e.shape == s.shape[1:]
+
+    def test_explicit_permutation_matches_forward(self):
+        from horovod_tpu.parallel.data_parallel import allreduce_gradients
+        stacked = self._stacked(2, [(4,), (6,), (8,)])
+        base = self._reduce_per_rank(
+            lambda ls: allreduce_gradients(ls, bucket_order="forward"),
+            stacked)
+        perm = self._reduce_per_rank(
+            lambda ls: allreduce_gradients(ls, bucket_order=(2, 0, 1)),
+            stacked)
+        for a, b in zip(base, perm):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestHierarchicalBucketOrder:
+    def test_subbuckets_match_default(self):
+        """Sub-bucketed reverse-order hierarchical allreduce is
+        numerically identical to the historical one-buffer-per-dtype
+        path."""
+        from jax import shard_map
+        from horovod_tpu.parallel import hierarchical
+        from horovod_tpu.parallel.mesh import create_hierarchical_mesh
+        dcn, ici = 2, 4
+        mesh = create_hierarchical_mesh(dcn, ici,
+                                        devices=jax.devices()[:dcn * ici])
+        rng = np.random.RandomState(3)
+        stacked = {
+            "w": jnp.asarray(rng.randn(dcn * ici, 5, 3), jnp.float32),
+            "b": jnp.asarray(rng.randn(dcn * ici, 7), jnp.float32),
+        }
+
+        def run(**kw):
+            def f(tree):
+                local = {k: v[0] for k, v in tree.items()}
+                return hierarchical.hierarchical_allreduce(
+                    local, "dcn", **kw)
+            sm = shard_map(
+                f, mesh=mesh,
+                in_specs=({"w": P(("dcn", hvd.GLOBAL_AXIS)),
+                           "b": P(("dcn", hvd.GLOBAL_AXIS))},),
+                out_specs=P(), check_vma=False)
+            return jax.jit(sm)(stacked)
+
+        base = run()
+        bucketed = run(fusion_threshold_bytes=32, bucket_order="reverse")
+        for k in stacked:
+            np.testing.assert_allclose(
+                np.asarray(base[k]), np.asarray(bucketed[k]),
+                rtol=1e-6, atol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(bucketed[k]),
+                np.mean(np.asarray(stacked[k]), axis=0),
+                rtol=1e-5, atol=1e-5)

@@ -116,6 +116,24 @@ class TestRayHostDiscovery:
         d = RayHostDiscovery(fake_ray, cpus_per_slot=2)
         assert d.find_available_hosts_and_slots() == {"a": 2, "tiny": 1}
 
+    def test_advertised_small_cpu_gets_zero_slots(self, fake_ray):
+        # min_slots is a floor for nodes that advertise NO CPU resource
+        # at all; a node that advertises a small or fractional CPU count
+        # is telling us its true capacity and must NOT be rounded up —
+        # 1 // 2 == 0 slots, and get_host_assignments simply skips
+        # 0-slot hosts.
+        fake_ray.set_nodes([
+            {"Alive": True, "NodeManagerHostname": "small",
+             "Resources": {"CPU": 1}},
+            {"Alive": True, "NodeManagerHostname": "frac",
+             "Resources": {"CPU": 0.5}},
+            {"Alive": True, "NodeManagerHostname": "bare",
+             "Resources": {}},
+        ])
+        d = RayHostDiscovery(fake_ray, cpus_per_slot=2)
+        assert d.find_available_hosts_and_slots() == \
+            {"small": 0, "frac": 0, "bare": 1}
+
 
 def fn_elastic_size():
     import jax
