@@ -46,6 +46,7 @@ class NativeRendezvousServer:
     def __del__(self):
         try:
             self.stop()
+        # lint: allow-swallow(__del__ at interpreter shutdown must not raise)
         except Exception:
             pass
 
@@ -85,5 +86,6 @@ class NativeTimelineWriter:
     def __del__(self):
         try:
             self.close()
+        # lint: allow-swallow(__del__ at interpreter shutdown must not raise)
         except Exception:
             pass

@@ -1,0 +1,353 @@
+"""Catalog of every ``HOROVOD_*`` environment variable the codebase
+reads or sets — the single source of truth the ``env-registry`` static
+analyzer (scripts/hvdlint/envvars.py) enforces and ``docs/ENV_VARS.md``
+is generated from (``python scripts/gen_env_docs.py``).
+
+PURE STDLIB, no intra-package imports: the analyzer loads this file by
+path on CI machines with no jax installed, so it must execute alone.
+
+Conventions:
+
+* ``util.getenv``-based reads also accept an ``HVD_TPU_`` alias prefix
+  (``HOROVOD_<NAME>`` wins); the catalog lists the canonical name.
+* ``dynamic_site`` marks entries whose reads are runtime-built names
+  (the ``HOROVOD_[<SITE>_]RETRY_*`` family): the analyzer keeps them
+  "live" as long as the named file still performs dynamic env reads.
+* Adding a variable: declare it here FIRST, then read it in code, then
+  regenerate the docs — the lint fails on any of the three drifting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+__all__ = ["EnvVar", "CATALOG", "PREFIXES", "render_markdown"]
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: str          # human-readable default ("" = unset)
+    component: str        # grouping key for the generated doc
+    description: str
+    doc: str = ""         # docs/<FILE>.md cross-link, "" = none
+    dynamic_site: Optional[str] = None  # file building the name at runtime
+
+
+def _v(name, default, component, description, doc="", dynamic_site=None):
+    return EnvVar(name, default, component, description, doc, dynamic_site)
+
+
+CATALOG: Tuple[EnvVar, ...] = (
+    # -- topology / launcher contract ----------------------------------
+    _v("HOROVOD_RANK", "0", "topology",
+       "Global rank of this process; set by the launcher for every "
+       "worker (reference: gloo_run's env contract).", "COMPONENTS.md"),
+    _v("HOROVOD_SIZE", "1", "topology",
+       "World size (total worker count) set by the launcher.",
+       "COMPONENTS.md"),
+    _v("HOROVOD_LOCAL_RANK", "0", "topology",
+       "Rank of this process among workers on the same host.",
+       "COMPONENTS.md"),
+    _v("HOROVOD_LOCAL_SIZE", "1", "topology",
+       "Number of workers on this host.", "COMPONENTS.md"),
+    _v("HOROVOD_CROSS_RANK", "0", "topology",
+       "Index of this worker's host among all hosts (cross-host rank).",
+       "COMPONENTS.md"),
+    _v("HOROVOD_CROSS_SIZE", "1", "topology",
+       "Number of hosts participating in the job.", "COMPONENTS.md"),
+    _v("HOROVOD_NUM_PROCESSES", "1", "topology",
+       "jax.distributed world size used by hvd.init() when launched "
+       "through horovodrun_tpu / Ray / Spark / LSF.", "COMPONENTS.md"),
+    _v("HOROVOD_PROCESS_ID", "0", "topology",
+       "jax.distributed process index of this worker.", "COMPONENTS.md"),
+    _v("HOROVOD_COORDINATOR_ADDR", "(unset)", "topology",
+       "host:port of the jax.distributed coordinator; presence selects "
+       "the multi-process init path in hvd.init().", "COMPONENTS.md"),
+    _v("HOROVOD_COORDINATOR_BASE_PORT", "(derived)", "topology",
+       "Base port the elastic driver advances from when restarting the "
+       "jax.distributed coordinator across generations.", "ELASTIC.md"),
+    _v("HOROVOD_HOSTNAME", "(os hostname)", "topology",
+       "Logical host name override used for elastic slot attribution "
+       "and host-scoped fault injection.", "ELASTIC.md"),
+    _v("HOROVOD_SLOT", "(unset)", "topology",
+       "Elastic slot index assigned to this worker by the driver.",
+       "ELASTIC.md"),
+
+    # -- launcher compat / forwarding ----------------------------------
+    _v("HOROVOD_CONTROLLER", "xla", "launcher",
+       "Controller implementation advertised to workers (reference "
+       "parity knob; always 'xla' here).", "MIGRATION.md"),
+    _v("HOROVOD_CPU_OPERATIONS", "xla", "launcher",
+       "CPU collective implementation advertised to workers (reference "
+       "parity knob; always 'xla' here).", "MIGRATION.md"),
+    _v("HOROVOD_CYCLE_TIME", "(unset)", "launcher",
+       "Forwarded from `horovodrun_tpu --cycle-time-ms` (reference "
+       "background-loop cadence; informational on TPU).",
+       "MIGRATION.md"),
+    _v("HOROVOD_CACHE_CAPACITY", "(unset)", "launcher",
+       "Forwarded from `horovodrun_tpu --cache-capacity` (reference "
+       "response-cache size; informational on TPU).", "MIGRATION.md"),
+    _v("HOROVOD_LOG_LEVEL", "(unset)", "launcher",
+       "Worker log level forwarded from `horovodrun_tpu --log-level`.",
+       "COMPONENTS.md"),
+
+    # -- rendezvous ------------------------------------------------------
+    _v("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1", "rendezvous",
+       "Address of the launcher's rendezvous/KV server workers connect "
+       "back to.", "COMPONENTS.md"),
+    _v("HOROVOD_RENDEZVOUS_PORT", "(assigned)", "rendezvous",
+       "Port of the rendezvous/KV server.", "COMPONENTS.md"),
+    _v("HOROVOD_SECRET_KEY", "(generated)", "rendezvous",
+       "Shared HMAC secret authenticating every rendezvous/KV request.",
+       "COMPONENTS.md"),
+
+    # -- elastic ---------------------------------------------------------
+    _v("HOROVOD_ELASTIC", "0", "elastic",
+       "Set to 1 by the elastic driver: workers run the elastic "
+       "commit/restore protocol.", "ELASTIC.md"),
+    _v("HOROVOD_ELASTIC_GEN", "0", "elastic",
+       "Elastic generation counter; bumped by the driver on every "
+       "membership change, checked by collective consistency guards.",
+       "ELASTIC.md"),
+    _v("HOROVOD_ELASTIC_JOINING", "0", "elastic",
+       "1 for a worker joining an already-running generation (restores "
+       "state from peers before stepping).", "ELASTIC.md"),
+    _v("HOROVOD_ELASTIC_LEASE_TTL", "15.0", "elastic",
+       "Seconds a worker heartbeat lease lives; the driver fails "
+       "hung-but-alive workers whose lease lapses.",
+       "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_HEARTBEAT_INTERVAL", "lease_ttl/3 (min 0.5)", "elastic",
+       "Seconds between worker heartbeat-lease publishes; defaults to a "
+       "third of HOROVOD_ELASTIC_LEASE_TTL.", "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_BLACKLIST_THRESHOLD", "1", "elastic",
+       "Failure strikes before a host is blacklisted from respawn.",
+       "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_RESPAWN_BACKOFF_BASE", "1.0", "elastic",
+       "Base seconds of the exponential respawn backoff per host.",
+       "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_RESPAWN_BACKOFF_MAX", "30.0", "elastic",
+       "Cap in seconds of the exponential respawn backoff.",
+       "FAULT_TOLERANCE.md"),
+
+    # -- fault injection / retries --------------------------------------
+    _v("HOROVOD_FAULT_SPEC", "(unset)", "faults",
+       "Deterministic fault-injection schedule, e.g. "
+       "`rendezvous.put:err:0.1,collective.allreduce:delay:50ms`.",
+       "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_FAULT_SEED", "0", "faults",
+       "Seed for the fault-injection RNG; a given seed replays the "
+       "exact same fault sequence.", "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_FAULT_HOSTS", "(all)", "faults",
+       "Comma-separated hosts the fault spec applies to.",
+       "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_RETRY_MAX_ATTEMPTS", "5", "faults",
+       "Attempts for the shared RetryPolicy (global default; "
+       "`HOROVOD_<SITE>_RETRY_MAX_ATTEMPTS` overrides per site, e.g. "
+       "RENDEZVOUS, RESET).", "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_RETRY_BASE_DELAY", "0.5", "faults",
+       "Initial backoff seconds of the shared RetryPolicy "
+       "(`HOROVOD_<SITE>_RETRY_BASE_DELAY` overrides per site).",
+       "FAULT_TOLERANCE.md",
+       dynamic_site="horovod_tpu/faults/retry.py"),
+    _v("HOROVOD_RETRY_MAX_DELAY", "30.0", "faults",
+       "Backoff cap in seconds (`HOROVOD_<SITE>_RETRY_MAX_DELAY` "
+       "overrides per site).", "FAULT_TOLERANCE.md",
+       dynamic_site="horovod_tpu/faults/retry.py"),
+    _v("HOROVOD_RETRY_MULTIPLIER", "2.0", "faults",
+       "Exponential backoff multiplier (`HOROVOD_<SITE>_RETRY_"
+       "MULTIPLIER` overrides per site).", "FAULT_TOLERANCE.md",
+       dynamic_site="horovod_tpu/faults/retry.py"),
+    _v("HOROVOD_RETRY_JITTER", "0.1", "faults",
+       "Jitter fraction added to each backoff delay "
+       "(`HOROVOD_<SITE>_RETRY_JITTER` overrides per site).",
+       "FAULT_TOLERANCE.md",
+       dynamic_site="horovod_tpu/faults/retry.py"),
+    _v("HOROVOD_RETRY_DEADLINE", "(none)", "faults",
+       "Wall-clock seconds budget for the whole retry loop "
+       "(`HOROVOD_<SITE>_RETRY_DEADLINE` overrides per site).",
+       "FAULT_TOLERANCE.md",
+       dynamic_site="horovod_tpu/faults/retry.py"),
+
+    # -- metrics / stall watchdog ---------------------------------------
+    _v("HOROVOD_METRICS_DISABLE", "0", "metrics",
+       "1 disables all metric recording (hot paths skip the registry "
+       "entirely).", "METRICS.md"),
+    _v("HOROVOD_METRICS_PORT", "-1", "metrics",
+       "Port for the Prometheus exposition endpoint; -1 disables, 0 "
+       "picks a free port.", "METRICS.md"),
+    _v("HOROVOD_METRICS_KV_INTERVAL", "5.0", "metrics",
+       "Seconds between KV fleet-view snapshot publishes from the "
+       "stall watchdog thread.", "METRICS.md"),
+    _v("HOROVOD_STALL_CHECK_DISABLE", "0", "metrics",
+       "1 disables the stall inspector watchdog.", "METRICS.md"),
+    _v("HOROVOD_STALL_CHECK_TIME_SECONDS", "60.0", "metrics",
+       "Seconds a collective must be outstanding before a stall "
+       "warning (reference: stall_inspector.cc).", "METRICS.md"),
+    _v("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.0", "metrics",
+       "Seconds after which a stalled job aborts; 0 disables shutdown.",
+       "METRICS.md"),
+
+    # -- timeline --------------------------------------------------------
+    _v("HOROVOD_TIMELINE", "(unset)", "timeline",
+       "Path of the Chrome-trace timeline file; setting it enables the "
+       "timeline.", "TIMELINE.md"),
+    _v("HOROVOD_TIMELINE_ALL_RANKS", "0", "timeline",
+       "1 records a timeline on every rank instead of rank 0 only.",
+       "TIMELINE.md"),
+    _v("HOROVOD_TIMELINE_MARK_CYCLES", "0", "timeline",
+       "1 marks step/cycle boundaries in the timeline.", "TIMELINE.md"),
+    _v("HOROVOD_TIMELINE_DISABLE_NATIVE", "0", "timeline",
+       "1 forces the pure-Python timeline writer (skips the native C++ "
+       "buffered writer).", "TIMELINE.md"),
+
+    # -- autotune / gradient pipeline -----------------------------------
+    _v("HOROVOD_AUTOTUNE", "0", "autotune",
+       "1 enables the online autotuner (fusion threshold, bucket "
+       "order, min buckets).", "AUTOTUNE.md"),
+    _v("HOROVOD_AUTOTUNE_LOG", "(unset)", "autotune",
+       "CSV file the autotuner appends per-sample rates/values to.",
+       "AUTOTUNE.md"),
+    _v("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "3", "autotune",
+       "Samples discarded before the autotuner starts scoring.",
+       "AUTOTUNE.md"),
+    _v("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "10", "autotune",
+       "Steps aggregated into one autotuner throughput sample.",
+       "AUTOTUNE.md"),
+    _v("HOROVOD_AUTOTUNE_MAX_SAMPLES", "40", "autotune",
+       "Sample budget after which the autotuner freezes the best "
+       "configuration.", "AUTOTUNE.md"),
+    _v("HOROVOD_FUSION_THRESHOLD", "67108864", "autotune",
+       "Gradient-fusion bucket size in bytes (reference: "
+       "HOROVOD_FUSION_THRESHOLD).", "AUTOTUNE.md"),
+    _v("HOROVOD_MIN_BUCKETS", "1", "autotune",
+       "Lower bound on gradient buckets per step (overlap-aware "
+       "pipeline).", "AUTOTUNE.md"),
+    _v("HOROVOD_BUCKET_ORDER", "reverse", "autotune",
+       "Gradient bucketing order: reverse (availability order), "
+       "forward, or a comma permutation.", "AUTOTUNE.md"),
+
+    # -- collectives / ops ----------------------------------------------
+    _v("HOROVOD_HIERARCHICAL_ALLREDUCE", "0", "ops",
+       "1 routes multi-slice allreduce through ICI reduce-scatter -> "
+       "DCN allreduce -> ICI all-gather (reference knob name).",
+       "PERF_NOTES.md"),
+    _v("HOROVOD_HIERARCHICAL_DCN_WIRE", "(exact)", "ops",
+       "Wire format of the DCN leg of hierarchical allreduce: exact, "
+       "fp16 or int8 (quantized-wire trade-off).", "PERF_NOTES.md"),
+    _v("HOROVOD_COLLECTIVE_CONSISTENCY_CHECK", "0", "ops",
+       "1 enables the cross-rank shape/dtype/generation consistency "
+       "guard around collectives.", "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_JOIN_MODE", "0", "ops",
+       "1 arms hvd.join() semantics: ranks that exhausted data "
+       "contribute masked zeros.", "PROCESS_SETS.md"),
+    _v("HOROVOD_BACKEND_PROBE_TIMEOUT", "20.0", "ops",
+       "Seconds the guarded jax.devices() probe waits before declaring "
+       "the accelerator unreachable (bench.py uses 120).",
+       "COMPONENTS.md"),
+    _v("HOROVOD_ADASUM_PALLAS", "0", "ops",
+       "1 routes Adasum dot/norm/scaled-add through the fused Pallas "
+       "kernels.", "ADASUM.md"),
+    _v("HOROVOD_PALLAS_INTERPRET", "0", "ops",
+       "1 runs Pallas kernels in interpret mode (CPU testing of TPU "
+       "kernel code).", "PERF_NOTES.md"),
+    _v("HOROVOD_FLASH_ATTENTION", "0", "ops",
+       "1 enables the Pallas flash-attention kernel in ring/sequence "
+       "parallel attention.", "PERF_NOTES.md"),
+    _v("HOROVOD_FLASH_ATTENTION_MIN_T", "16384", "ops",
+       "Minimum sequence length before flash attention auto-engages on "
+       "TPU.", "PERF_NOTES.md"),
+    _v("HOROVOD_FLASH_BLOCK_Q", "128", "ops",
+       "Flash-attention query block rows.", "PERF_NOTES.md"),
+    _v("HOROVOD_FLASH_BLOCK_K", "128", "ops",
+       "Flash-attention key/value block rows.", "PERF_NOTES.md"),
+
+    # -- models ----------------------------------------------------------
+    _v("HOROVOD_CONV0_SPACE_TO_DEPTH", "auto (TPU: 1)", "models",
+       "Space-to-depth transform of the ResNet stem conv; exact "
+       "rewrite, default on when an MXU is present.", "PERF_NOTES.md"),
+
+    # -- bench harness ---------------------------------------------------
+    _v("HOROVOD_BENCH_BATCH", "0 (auto)", "bench",
+       "Global batch override for bench.py (0 picks the per-backend "
+       "default).", "BENCHMARKS.md"),
+    _v("HOROVOD_BENCH_MEGASTEP", "8", "bench",
+       "Megastep k for bench.py timing (1 restores one dispatch per "
+       "step).", "BENCHMARKS.md"),
+    _v("HOROVOD_BENCH_LEGACY_PIPELINE", "0", "bench",
+       "1 restores the pre-overlap barriered gradient pipeline for A/B "
+       "runs.", "BENCHMARKS.md"),
+    _v("HOROVOD_BENCH_PROBE_WINDOW", "900", "bench",
+       "Seconds bench.py waits for the accelerator probe subprocess.",
+       "BENCHMARKS.md"),
+    _v("HOROVOD_BENCH_SIM_RUNS", "7", "bench",
+       "Repetitions of each simulated-scaling bench point.",
+       "BENCHMARKS.md"),
+    _v("HOROVOD_BENCH_SIM_MAX_RUNS", "9", "bench",
+       "Cap on adaptive extra repetitions of noisy bench points.",
+       "BENCHMARKS.md"),
+    _v("HOROVOD_BENCH_XLA_FLAGS", "(unset)", "bench",
+       "Extra XLA_FLAGS appended for bench.py child processes.",
+       "BENCHMARKS.md"),
+)
+
+#: Literal prefixes that legitimately appear in code (startswith filters
+#: and env-forwarding serializers), not concrete variable reads.
+PREFIXES: Dict[str, str] = {
+    "HOROVOD_": "env-forwarding filters (ssh/LSF/Spark serialization, "
+                "util.getenv's accepted-prefix list) and f-string "
+                "construction of catalogued names",
+}
+
+_COMPONENT_ORDER = (
+    "topology", "launcher", "rendezvous", "elastic", "faults",
+    "metrics", "timeline", "autotune", "ops", "models", "bench",
+)
+
+_HEADER = """\
+# Environment variables
+
+<!-- GENERATED FILE — do not edit by hand.
+     Source of truth: horovod_tpu/common/env_catalog.py
+     Regenerate:      python scripts/gen_env_docs.py
+     Enforced by:     scripts/lint_all.py (env-registry analyzer) -->
+
+Every `HOROVOD_*` variable the codebase reads or sets.  `util.getenv`
+-based reads also accept the `HVD_TPU_` alias prefix (the `HOROVOD_`
+spelling wins when both are set).  The site-scoped retry family
+`HOROVOD_<SITE>_RETRY_{MAX_ATTEMPTS,BASE_DELAY,MAX_DELAY,MULTIPLIER,
+JITTER,DEADLINE}` (sites: `RENDEZVOUS`, `REGISTRATION`, `RESET`, ...)
+overrides the global `HOROVOD_RETRY_*` defaults per call site — see
+[FAULT_TOLERANCE.md](FAULT_TOLERANCE.md).
+
+See [STATIC_ANALYSIS.md](STATIC_ANALYSIS.md) for how the `env-registry`
+analyzer keeps this table, the catalog, and the code in sync.
+"""
+
+
+def render_markdown() -> str:
+    """docs/ENV_VARS.md content, deterministically, from CATALOG."""
+    out = [_HEADER]
+    by_comp: Dict[str, list] = {}
+    for v in CATALOG:
+        by_comp.setdefault(v.component, []).append(v)
+    comps = list(_COMPONENT_ORDER) + sorted(
+        set(by_comp) - set(_COMPONENT_ORDER))
+    for comp in comps:
+        entries = by_comp.get(comp)
+        if not entries:
+            continue
+        out.append(f"\n## {comp}\n")
+        out.append("| variable | default | description | doc |")
+        out.append("|---|---|---|---|")
+        for v in sorted(entries, key=lambda e: e.name):
+            doc = f"[{v.doc}]({v.doc})" if v.doc else ""
+            out.append(f"| `{v.name}` | `{v.default}` | "
+                       f"{v.description} | {doc} |")
+    out.append("")
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    print(render_markdown(), end="")

@@ -112,6 +112,7 @@ def probe_devices(timeout: Optional[float] = None):
         try:
             import jax
             jax.config.update("jax_platforms", env_plat)
+        # lint: allow-swallow(platform pin is best-effort; jax may be absent)
         except Exception:
             pass
 

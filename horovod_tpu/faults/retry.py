@@ -28,6 +28,7 @@ import time
 from typing import Callable, Optional, Tuple, Type
 
 from ..common import util
+from ..common.exceptions import HorovodInternalError
 
 logger = logging.getLogger("horovod_tpu.faults.retry")
 
@@ -118,7 +119,9 @@ class RetryPolicy:
                 logger.debug("%s: attempt %d failed (%s); retrying in "
                              "%.2fs", site, attempt + 1, e, d)
                 sleep(d)
-        assert last is not None
+        if last is None:
+            raise HorovodInternalError(
+                f"{site}: retry loop exited with no exception captured")
         raise last
 
 
@@ -127,5 +130,6 @@ def _record_retry(site: str) -> None:
         from ..metrics import catalog as _met
         if _met.enabled():
             _met.retries.labels(site).inc()
-    except Exception:  # noqa: BLE001 — retries must not fail on telemetry
+    # lint: allow-swallow(retries must not fail on metrics telemetry)
+    except Exception:  # noqa: BLE001
         pass

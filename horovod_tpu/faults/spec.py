@@ -203,5 +203,6 @@ def _record_injection(point: str, mode: str) -> None:
         from ..metrics import catalog as _met
         if _met.enabled():
             _met.fault_injections.labels(point, mode).inc()
-    except Exception:  # noqa: BLE001 — injection must not fail on telemetry
+    # lint: allow-swallow(injection must not fail on metrics telemetry)
+    except Exception:  # noqa: BLE001
         pass

@@ -65,7 +65,8 @@ class _CounterChild(_Child):
 
 class _GaugeChild(_Child):
     def set(self, value: float) -> None:
-        self._value = float(value)
+        with self._lock:
+            self._value = float(value)
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.exceptions import HorovodTpuError
 from ..parallel import moe as moe_mod
 from ..parallel import sequence as seq_mod
 from . import layers as L
@@ -304,7 +305,9 @@ def _forward_shard(params, tokens, cfg: TransformerConfig,
 
     B = x.shape[0]
     M = n_microbatches
-    assert B % M == 0, f"local batch {B} not divisible by {M} microbatches"
+    if B % M != 0:
+        raise HorovodTpuError(
+            f"local batch {B} not divisible by {M} microbatches")
     x_mb = x.reshape((M, B // M) + x.shape[1:])
     sp_params = {"blocks": blocks}
     if moe is not None:

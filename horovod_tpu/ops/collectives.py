@@ -132,6 +132,22 @@ class _joinable:
         return False
 
 
+_TRACE_STATE_CLEAN = getattr(jax.core, "trace_state_clean", None)
+
+
+def _host_clock() -> Optional[float]:
+    """time.perf_counter(), or None while a jax trace is active.
+
+    The metrics bracket may run under an outer jit/shard_map trace (the
+    public entry points only detect *their own* tracer inputs); a host
+    clock read there is a trace-time-once side effect and the recorded
+    latency would be the tracing time, not the dispatch time.  Skip the
+    sample instead."""
+    if _TRACE_STATE_CLEAN is not None and not _TRACE_STATE_CLEAN():
+        return None
+    return time.perf_counter()
+
+
 class _traced:
     """Timeline + stall-inspector + metrics bracket around one eager
     collective.
@@ -165,7 +181,7 @@ class _traced:
         self._key = None
         self._token = None
         self._tracked = False
-        self._t0 = 0.0
+        self._t0: Optional[float] = None
         self._nbytes = 0
         self._dtype = "none"
         self._ps = 0
@@ -186,7 +202,7 @@ class _traced:
         if self._tl is not None:
             self._token = self._tl.activity_start(
                 self._desc, self._desc.split(":", 1)[0])
-        self._t0 = time.perf_counter()
+        self._t0 = _host_clock()
         return self
 
     def stat(self, arr=None, dtype=None, process_set=None) -> None:
@@ -220,8 +236,9 @@ class _traced:
             _met.collective_calls.labels(*lbl).inc()
             if self._nbytes:
                 _met.collective_bytes.labels(*lbl).inc(self._nbytes)
-            _met.collective_latency.labels(*lbl).observe(
-                time.perf_counter() - self._t0)
+            t1 = _host_clock()
+            if self._t0 is not None and t1 is not None:
+                _met.collective_latency.labels(*lbl).observe(t1 - self._t0)
         return False
 
 __all__ = [

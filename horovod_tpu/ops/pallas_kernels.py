@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ..common import util
+from ..common.exceptions import HorovodTpuError
 
 try:
     from jax.experimental import pallas as pl
@@ -106,7 +107,9 @@ def fused_dot_norms(a: jax.Array, b: jax.Array) -> jax.Array:
     Reference: adasum.h DispatchComputeDotAndNormSqrds (which the MPI
     path runs over vector halves at every VHDD level).
     """
-    assert a.shape == b.shape, (a.shape, b.shape)
+    if a.shape != b.shape:
+        raise HorovodTpuError(
+            f"fused_dot_norms: shape mismatch {a.shape} vs {b.shape}")
     k, _ = a.shape
     at, rows = _tile(a)
     bt, _ = _tile(b)

@@ -42,6 +42,7 @@ def transformer_dryrun(n_devices: int) -> None:
     import numpy as np
     import optax
 
+    from ..common.exceptions import HorovodInternalError
     from ..models.transformer import (
         TransformerConfig,
         make_train_step,
@@ -69,7 +70,8 @@ def transformer_dryrun(n_devices: int) -> None:
         tokens = jax.random.randint(key, (batch, seqlen), 0, cfg.vocab_size)
         batch_sh = shard_batch((tokens[:, :-1], tokens[:, 1:]))
         params, opt_state, loss = step(params, opt_state, batch_sh)
-        assert np.isfinite(float(loss)), f"{tag}: loss={loss}"
+        if not np.isfinite(float(loss)):
+            raise HorovodInternalError(f"dryrun {tag}: loss={loss}")
         print(f"dryrun {tag}: loss={float(loss):.4f}")
 
     if n_devices % 8 == 0:

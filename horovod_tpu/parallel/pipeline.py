@@ -26,6 +26,8 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..common.exceptions import HorovodTpuError
+
 
 def gpipe_shard(stage_fn: Callable, stage_params: Any, x_mb, axis: str = "pp"):
     """GPipe forward inside shard_map.
@@ -81,7 +83,10 @@ def gpipe(mesh: Mesh, stage_fn: Callable, params: Any, x,
     x is [B, ...] with B divisible by n_microbatches."""
     pp = mesh.shape[axis]
     B = x.shape[0]
-    assert B % n_microbatches == 0, (B, n_microbatches)
+    if B % n_microbatches != 0:
+        raise HorovodTpuError(
+            f"gpipe: batch {B} not divisible by {n_microbatches} "
+            "microbatches")
     x_mb = x.reshape((n_microbatches, B // n_microbatches) + x.shape[1:])
 
     def shard_fn(params, x_mb):

@@ -273,14 +273,16 @@ class RayTransport(ExecTransport):
         for agent, pids in by_agent.items():
             try:
                 self._ray.get(agent.terminate.remote(pids))
-            except Exception:  # noqa: BLE001 — dead agent: workers
-                pass           # died with their node; nothing to kill
+            # lint: allow-swallow(dead agent: workers died with node)
+            except Exception:  # noqa: BLE001
+                pass
 
     def shutdown(self):
         for agent in self._agents.values():
             try:
                 self._ray.kill(agent)
-            except Exception:  # noqa: BLE001 — best-effort teardown
+            # lint: allow-swallow(best-effort teardown of ray actors)
+            except Exception:  # noqa: BLE001
                 pass
         self._agents.clear()
 

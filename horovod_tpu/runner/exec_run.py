@@ -109,7 +109,9 @@ def build_command(slot: SlotInfo, settings: Settings,
     """Local slots exec directly; remote slots go through ssh with the env
     serialized onto the remote command line (reference: gloo_run's
     get_remote_command)."""
-    assert settings.command
+    if not settings.command:
+        raise HorovodTpuError("no command to launch: settings.command "
+                              "is empty")
     if _is_local(slot.hostname):
         return list(settings.command)
     ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
@@ -202,8 +204,9 @@ def exec_run(settings: Settings, slots: List[SlotInfo],
         for p in procs:
             try:
                 p.wait(timeout=GRACEFUL_TERMINATION_TIME_S)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — kill follows anyway
+                logger.debug("pid %d did not exit in %ss (%s)",
+                             p.pid, GRACEFUL_TERMINATION_TIME_S, e)
         for f in out_files:
             f.close()
         server.stop()

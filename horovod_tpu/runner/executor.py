@@ -179,7 +179,8 @@ class Executor:
         if self._server is not None:
             try:
                 self._server.kv().put("exec/stop", "1")
-            except Exception:  # noqa: BLE001 — server may already be down
+            # lint: allow-swallow(stop signal; server may already be down)
+            except Exception:  # noqa: BLE001
                 pass
         deadline = time.monotonic() + 10
         for p in self._procs:

@@ -47,7 +47,8 @@ def tf_to_jax(t) -> Any:
     if hasattr(t, "__dlpack__"):
         try:
             return jax.dlpack.from_dlpack(t)
-        except Exception:  # noqa: BLE001 — unsupported dtype/layout
+        # lint: allow-swallow(dlpack unsupported dtype/layout; numpy fallback below)
+        except Exception:  # noqa: BLE001
             pass
     return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
 
@@ -86,6 +87,7 @@ def jax_to_tf(a, like=None):
             if dtype is not None and out.dtype != dtype:
                 out = tf.cast(out, dtype)
             return out
+        # lint: allow-swallow(dlpack export optional; host-copy fallback below)
         except Exception:  # noqa: BLE001
             pass
     arr = np.asarray(a)

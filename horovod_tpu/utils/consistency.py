@@ -135,7 +135,8 @@ def check(sig: Dict[str, Any], ranks=None) -> None:
     if s >= _GC_LAG:
         try:
             kv.delete(f"{_ns()}/{setid}/{s - _GC_LAG}/{me}")
-        except Exception:  # noqa: BLE001 — GC is best-effort
+        # lint: allow-swallow(KV GC is best-effort; stale rows are harmless)
+        except Exception:  # noqa: BLE001
             pass
 
 

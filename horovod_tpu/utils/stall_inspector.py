@@ -205,6 +205,7 @@ class StallInspector:
             if basics.is_initialized():
                 return (f"This process is rank {basics.rank()}/"
                         f"{basics.size()} (pid {os.getpid()})")
+        # lint: allow-swallow(diagnostic banner is best-effort)
         except Exception:  # noqa: BLE001
             pass
         try:

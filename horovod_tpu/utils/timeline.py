@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import logging
 import os
 import queue
 import threading
@@ -35,6 +36,8 @@ import time
 from typing import Optional
 
 from ..common import util
+
+logger = logging.getLogger("horovod_tpu.timeline")
 
 
 class _TimelineWriter:
@@ -144,8 +147,9 @@ def _make_writer(filename: str):
     if not util.env_bool("TIMELINE_DISABLE_NATIVE", False):
         try:
             return _NativeWriterAdapter(filename)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — native engine optional
+            logger.debug("native timeline writer unavailable (%s); "
+                         "using the Python writer", e)
     return _TimelineWriter(filename)
 
 

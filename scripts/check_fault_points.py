@@ -1,111 +1,37 @@
 #!/usr/bin/env python3
-"""Lint: the fault-point catalog, the code's `faults.point(...)` call
-sites, and docs/FAULT_TOLERANCE.md must agree.
+"""Lint (shim): the fault-point catalog, the code's `faults.point(...)`
+call sites, and docs/FAULT_TOLERANCE.md must agree.
 
-Mirrors scripts/check_metrics_catalog.py: pure text parsing, no
-horovod_tpu imports (CI machines running this lint need no jax).  Checks:
-
-  1. every point named in CATALOG (faults/__init__.py) has a table row in
-     docs/FAULT_TOLERANCE.md — and the doc lists no unknown points;
-  2. every `faults.point("...")` / `_faults.point("...")` literal in the
-     package names a cataloged point — and every cataloged point has at
-     least one call site (a catalog entry nothing fires is dead weight).
-
-Exit 1 on drift, printing one line per offense.
+The logic now lives in the hvdlint framework
+(scripts/hvdlint/catalogs.py:FaultPoints); this CLI is kept as a thin
+shim for existing callers/CI.  Prefer `python scripts/lint_all.py` for
+the whole suite.  Exit 1 on drift, one line per offense.
 
 Usage: python scripts/check_fault_points.py [repo_root]
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-CATALOG = "horovod_tpu/faults/__init__.py"
-DOC = "docs/FAULT_TOLERANCE.md"
-PKG = "horovod_tpu"
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-# Catalog entries: string keys of the CATALOG dict literal.
-_CAT_RE = re.compile(r"^\s*\"([a-z_]+\.[a-z_]+)\"\s*:", re.MULTILINE)
-
-# Doc rows: a markdown table line whose first cell is `a.b`.
-_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z_]+\.[a-z_]+)`", re.MULTILINE)
-
-# Call sites: faults.point("a.b") with any local alias ending in
-# "faults".  Dynamic names (f-strings) can't be linted — collectives
-# builds "collective.<kind>" at runtime, listed below.
-_SITE_RE = re.compile(r"faults\s*\.\s*point\(\s*\"([a-z_.]+)\"\s*\)")
-
-# Points fired through runtime-built names, with the file that builds
-# them — kept literal here so drift still fails the lint when the
-# builder disappears.
-_DYNAMIC_SITES = {
-    "horovod_tpu/ops/collectives.py": [
-        "collective.allreduce", "collective.allgather",
-        "collective.allgather_sizes", "collective.broadcast",
-        "collective.alltoall", "collective.alltoall_splits",
-        "collective.reducescatter",
-    ],
-}
-_DYNAMIC_MARKER = "collective.{self._kind.lower()}"
+from hvdlint import Project  # noqa: E402
+from hvdlint.catalogs import FaultPoints  # noqa: E402
 
 
 def main(argv=None) -> int:
-    root = Path(argv[1]) if argv and len(argv) > 1 else \
+    argv = argv if argv is not None else sys.argv
+    root = Path(argv[1]) if len(argv) > 1 else \
         Path(__file__).resolve().parent.parent
-    catalog_src = (root / CATALOG).read_text()
-    declared = set(_CAT_RE.findall(catalog_src))
-    if not declared:
-        print(f"error: no fault points found in {CATALOG} "
-              "(parser out of date?)")
+    findings = FaultPoints().run(Project(root))
+    for f in findings:
+        print(f.message)
+    if findings:
         return 1
-
-    rc = 0
-
-    doc_path = root / DOC
-    if not doc_path.exists():
-        print(f"error: {DOC} missing — every fault point in {CATALOG} "
-              "must be documented there")
-        return 1
-    documented = set(_DOC_ROW_RE.findall(doc_path.read_text()))
-    for name in sorted(declared - documented):
-        print(f"undocumented fault point: {name} (in {CATALOG}, no table "
-              f"row in {DOC})")
-        rc = 1
-    for name in sorted(documented - declared):
-        print(f"stale doc entry: {name} (listed in {DOC}, not in "
-              f"{CATALOG})")
-        rc = 1
-
-    fired = set()
-    for path in sorted((root / PKG).rglob("*.py")):
-        if path == root / CATALOG:
-            continue
-        src = path.read_text()
-        for name in _SITE_RE.findall(src):
-            fired.add(name)
-            if name not in declared:
-                print(f"unknown fault point fired: {name} "
-                      f"({path.relative_to(root)}) — add it to {CATALOG}")
-                rc = 1
-        rel = str(path.relative_to(root))
-        if rel in _DYNAMIC_SITES:
-            if _DYNAMIC_MARKER not in src:
-                print(f"error: {rel} no longer builds dynamic point names "
-                      f"(update _DYNAMIC_SITES in this script)")
-                rc = 1
-            else:
-                fired.update(_DYNAMIC_SITES[rel])
-    for name in sorted(declared - fired):
-        print(f"dead fault point: {name} (in {CATALOG} but nothing calls "
-              f"faults.point({name!r}))")
-        rc = 1
-
-    if rc == 0:
-        print(f"ok: {len(declared)} fault points declared, fired, and "
-              "documented")
-    return rc
+    print("ok: fault points declared, fired, and documented")
+    return 0
 
 
 if __name__ == "__main__":

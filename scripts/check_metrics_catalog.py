@@ -1,86 +1,38 @@
 #!/usr/bin/env python3
-"""Lint: every metric registered in horovod_tpu/metrics/catalog.py must be
-documented in docs/METRICS.md (and the doc must not list series the code
-no longer emits).  Likewise every autotuner knob registered in
-horovod_tpu/utils/autotune.py `init_from_env` must appear in
+"""Lint (shim): every metric registered in horovod_tpu/metrics/catalog.py
+must be documented in docs/METRICS.md, and every autotuner knob in
 docs/AUTOTUNE.md.
 
-Pure text parsing — no imports of horovod_tpu (CI machines running this
-lint need no jax).  Exit 1 on drift, printing one line per offense.
+The logic now lives in the hvdlint framework
+(scripts/hvdlint/catalogs.py:MetricsCatalog); this CLI is kept as a thin
+shim for existing callers/CI.  Prefer `python scripts/lint_all.py` for
+the whole suite.  Exit 1 on drift, one line per offense.
 
 Usage: python scripts/check_metrics_catalog.py [repo_root]
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-CATALOG = "horovod_tpu/metrics/catalog.py"
-DOC = "docs/METRICS.md"
-AUTOTUNE = "horovod_tpu/utils/autotune.py"
-AUTOTUNE_DOC = "docs/AUTOTUNE.md"
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-# _REG.counter(\n    "hvd_name", ... — the name is the first string
-# literal after the registration call.
-_REG_RE = re.compile(
-    r"_REG\.(?:counter|gauge|histogram)\(\s*\"(hvd_[a-z0-9_]+)\"",
-    re.MULTILINE)
-
-# Doc catalog rows: a markdown table line whose first cell is `hvd_*`.
-_DOC_ROW_RE = re.compile(r"^\|\s*`(hvd_[a-z0-9_]+)`", re.MULTILINE)
-
-# pm.register("knob_name", ... in autotune.py init_from_env.
-_KNOB_RE = re.compile(r"pm\.register\(\s*\"([a-z_]+)\"", re.MULTILINE)
+from hvdlint import Project  # noqa: E402
+from hvdlint.catalogs import MetricsCatalog  # noqa: E402
 
 
 def main(argv=None) -> int:
-    root = Path(argv[1]) if argv and len(argv) > 1 else \
+    argv = argv if argv is not None else sys.argv
+    root = Path(argv[1]) if len(argv) > 1 else \
         Path(__file__).resolve().parent.parent
-    catalog_src = (root / CATALOG).read_text()
-    declared = set(_REG_RE.findall(catalog_src))
-    if not declared:
-        print(f"error: no metric registrations found in {CATALOG} "
-              "(parser out of date?)")
+    findings = MetricsCatalog().run(Project(root))
+    for f in findings:
+        print(f.message)
+    if findings:
         return 1
-    doc_path = root / DOC
-    if not doc_path.exists():
-        print(f"error: {DOC} missing — every metric in {CATALOG} must "
-              "be documented there")
-        return 1
-    documented = set(_DOC_ROW_RE.findall(doc_path.read_text()))
-
-    rc = 0
-    for name in sorted(declared - documented):
-        print(f"undocumented metric: {name} (registered in {CATALOG}, "
-              f"no catalog row in {DOC})")
-        rc = 1
-    for name in sorted(documented - declared):
-        print(f"stale doc entry: {name} (listed in {DOC}, not registered "
-              f"in {CATALOG})")
-        rc = 1
-
-    # Autotuner knobs: every registered knob must be named (as `knob`)
-    # somewhere in docs/AUTOTUNE.md.
-    knobs = set(_KNOB_RE.findall((root / AUTOTUNE).read_text()))
-    if not knobs:
-        print(f"error: no pm.register(...) knobs found in {AUTOTUNE} "
-              "(parser out of date?)")
-        return 1
-    at_doc_path = root / AUTOTUNE_DOC
-    at_doc = at_doc_path.read_text() if at_doc_path.exists() else ""
-    for knob in sorted(knobs):
-        if f"`{knob}`" not in at_doc:
-            print(f"undocumented autotune knob: {knob} (registered in "
-                  f"{AUTOTUNE} init_from_env, no `{knob}` mention in "
-                  f"{AUTOTUNE_DOC})")
-            rc = 1
-
-    if rc == 0:
-        print(f"ok: {len(declared)} metrics declared and documented; "
-              f"{len(knobs)} autotune knobs documented")
-    return rc
+    print("ok: metrics and autotune knobs declared and documented")
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,49 @@
+#!/usr/bin/env python3
+"""Regenerate docs/ENV_VARS.md from horovod_tpu/common/env_catalog.py.
+
+The env-registry analyzer (scripts/lint_all.py) fails when the doc file
+drifts from the catalog, so run this after every catalog change.  Pure
+stdlib: the catalog module is loaded by path, never via the package.
+
+Usage: python scripts/gen_env_docs.py [repo_root] [--check]
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import sys
+from pathlib import Path
+
+
+def load_catalog(root: Path):
+    path = root / "horovod_tpu" / "common" / "env_catalog.py"
+    spec = importlib.util.spec_from_file_location("_hvd_env_catalog", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclasses resolves types via sys.modules
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv if argv is None else argv)
+    check = "--check" in argv
+    argv = [a for a in argv if a != "--check"]
+    root = Path(argv[1]) if len(argv) > 1 else \
+        Path(__file__).resolve().parent.parent
+    cat = load_catalog(root)
+    text = cat.render_markdown()
+    doc = root / "docs" / "ENV_VARS.md"
+    if check:
+        if not doc.exists() or doc.read_text() != text:
+            print(f"stale: {doc} — run python scripts/gen_env_docs.py")
+            return 1
+        print(f"ok: {doc} up to date ({len(cat.CATALOG)} variables)")
+        return 0
+    doc.parent.mkdir(parents=True, exist_ok=True)
+    doc.write_text(text)
+    print(f"wrote {doc} ({len(cat.CATALOG)} variables)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+"""hvdlint — AST-based static analysis for horovod_tpu invariants.
+
+Pluggable analyzers over pure stdlib ``ast`` (no jax, no horovod_tpu
+import — CI-safe).  Run the whole suite with ``scripts/lint_all.py``;
+tier-1 enforces it via ``tests/test_lint.py``.  docs/STATIC_ANALYSIS.md
+is the analyzer catalog + how-to-add-a-plugin guide.
+"""
+
+from .core import Analyzer, Finding, Project, run_all  # noqa: F401
+from .catalogs import FaultPoints, MetricsCatalog
+from .envvars import EnvVarRegistry
+from .excepts import ExceptionDiscipline
+from .locks import LockDiscipline
+from .purity import JitPurity
+
+#: The suite, in the order lint_all runs it.  Adding an analyzer =
+#: append an instance here (see docs/STATIC_ANALYSIS.md).
+ALL = [
+    LockDiscipline(),
+    JitPurity(),
+    EnvVarRegistry(),
+    ExceptionDiscipline(),
+    MetricsCatalog(),
+    FaultPoints(),
+]
+
+__all__ = ["Analyzer", "Finding", "Project", "run_all", "ALL",
+           "LockDiscipline", "JitPurity", "EnvVarRegistry",
+           "ExceptionDiscipline", "MetricsCatalog", "FaultPoints"]

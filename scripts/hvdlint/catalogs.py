@@ -1,0 +1,184 @@
+"""Catalog-drift analyzers: the two pre-framework text lints
+(scripts/check_metrics_catalog.py, scripts/check_fault_points.py)
+migrated to hvdlint plugins.  The original CLIs remain as thin shims.
+
+Both stay pure text parsing (regex over the source, no horovod_tpu
+import) so they keep working on partial trees — the metrics drift test
+runs the shim against a tmp root containing only the catalog + doc.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from .core import Analyzer, Finding, Project
+
+# ---------------------------------------------------------------------------
+# metrics catalog <-> docs/METRICS.md, autotune knobs <-> docs/AUTOTUNE.md
+# ---------------------------------------------------------------------------
+
+METRICS_CATALOG = "horovod_tpu/metrics/catalog.py"
+METRICS_DOC = "docs/METRICS.md"
+AUTOTUNE = "horovod_tpu/utils/autotune.py"
+AUTOTUNE_DOC = "docs/AUTOTUNE.md"
+
+_REG_RE = re.compile(
+    r"_REG\.(?:counter|gauge|histogram)\(\s*\"(hvd_[a-z0-9_]+)\"",
+    re.MULTILINE)
+_DOC_ROW_RE = re.compile(r"^\|\s*`(hvd_[a-z0-9_]+)`", re.MULTILINE)
+_KNOB_RE = re.compile(r"pm\.register\(\s*\"([a-z_]+)\"", re.MULTILINE)
+
+
+class MetricsCatalog(Analyzer):
+    name = "metrics-catalog"
+    description = ("every registered metric documented in docs/METRICS.md;"
+                   " every autotune knob documented in docs/AUTOTUNE.md")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        root = project.root
+        cat_path = root / METRICS_CATALOG
+        if not cat_path.is_file():
+            return [Finding(self.name, "error", METRICS_CATALOG, 1,
+                            f"error: {METRICS_CATALOG} missing")]
+        declared = set(_REG_RE.findall(cat_path.read_text()))
+        if not declared:
+            return [Finding(self.name, "error", METRICS_CATALOG, 1,
+                            f"error: no metric registrations found in "
+                            f"{METRICS_CATALOG} (parser out of date?)")]
+        doc_path = root / METRICS_DOC
+        if not doc_path.is_file():
+            return [Finding(self.name, "error", METRICS_DOC, 1,
+                            f"error: {METRICS_DOC} missing — every metric "
+                            f"in {METRICS_CATALOG} must be documented "
+                            "there")]
+        documented = set(_DOC_ROW_RE.findall(doc_path.read_text()))
+        for name in sorted(declared - documented):
+            findings.append(Finding(
+                self.name, "undocumented-metric", METRICS_CATALOG, 1,
+                f"undocumented metric: {name} (registered in "
+                f"{METRICS_CATALOG}, no catalog row in {METRICS_DOC})"))
+        for name in sorted(documented - declared):
+            findings.append(Finding(
+                self.name, "stale-doc-entry", METRICS_DOC, 1,
+                f"stale doc entry: {name} (listed in {METRICS_DOC}, not "
+                f"registered in {METRICS_CATALOG})"))
+
+        at_path = root / AUTOTUNE
+        if not at_path.is_file():
+            findings.append(Finding(
+                self.name, "error", AUTOTUNE, 1,
+                f"error: {AUTOTUNE} missing — autotune knob lint has "
+                "nothing to parse"))
+            return findings
+        knobs = set(_KNOB_RE.findall(at_path.read_text()))
+        if not knobs:
+            findings.append(Finding(
+                self.name, "error", AUTOTUNE, 1,
+                f"error: no pm.register(...) knobs found in {AUTOTUNE} "
+                "(parser out of date?)"))
+            return findings
+        at_doc_path = root / AUTOTUNE_DOC
+        at_doc = at_doc_path.read_text() if at_doc_path.is_file() else ""
+        for knob in sorted(knobs):
+            if f"`{knob}`" not in at_doc:
+                findings.append(Finding(
+                    self.name, "undocumented-knob", AUTOTUNE, 1,
+                    f"undocumented autotune knob: {knob} (registered in "
+                    f"{AUTOTUNE} init_from_env, no `{knob}` mention in "
+                    f"{AUTOTUNE_DOC})"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# fault-point catalog <-> call sites <-> docs/FAULT_TOLERANCE.md
+# ---------------------------------------------------------------------------
+
+FAULT_CATALOG = "horovod_tpu/faults/__init__.py"
+FAULT_DOC = "docs/FAULT_TOLERANCE.md"
+FAULT_PKG = "horovod_tpu"
+
+_CAT_RE = re.compile(r"^\s*\"([a-z_]+\.[a-z_]+)\"\s*:", re.MULTILINE)
+_FAULT_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z_]+\.[a-z_]+)`",
+                               re.MULTILINE)
+_SITE_RE = re.compile(r"faults\s*\.\s*point\(\s*\"([a-z_.]+)\"\s*\)")
+
+# Points fired through runtime-built names, with the file that builds
+# them — kept literal so drift still fails when the builder disappears.
+_DYNAMIC_SITES = {
+    "horovod_tpu/ops/collectives.py": [
+        "collective.allreduce", "collective.allgather",
+        "collective.allgather_sizes", "collective.broadcast",
+        "collective.alltoall", "collective.alltoall_splits",
+        "collective.reducescatter",
+    ],
+}
+_DYNAMIC_MARKER = "collective.{self._kind.lower()}"
+
+
+class FaultPoints(Analyzer):
+    name = "fault-points"
+    description = ("fault-point catalog <-> faults.point() call sites "
+                   "<-> docs/FAULT_TOLERANCE.md agreement")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        root = project.root
+        cat_path = root / FAULT_CATALOG
+        if not cat_path.is_file():
+            return [Finding(self.name, "error", FAULT_CATALOG, 1,
+                            f"error: {FAULT_CATALOG} missing")]
+        declared = set(_CAT_RE.findall(cat_path.read_text()))
+        if not declared:
+            return [Finding(self.name, "error", FAULT_CATALOG, 1,
+                            f"error: no fault points found in "
+                            f"{FAULT_CATALOG} (parser out of date?)")]
+
+        doc_path = root / FAULT_DOC
+        if not doc_path.is_file():
+            return [Finding(self.name, "error", FAULT_DOC, 1,
+                            f"error: {FAULT_DOC} missing — every fault "
+                            f"point in {FAULT_CATALOG} must be documented "
+                            "there")]
+        documented = set(_FAULT_DOC_ROW_RE.findall(doc_path.read_text()))
+        for name in sorted(declared - documented):
+            findings.append(Finding(
+                self.name, "undocumented-point", FAULT_CATALOG, 1,
+                f"undocumented fault point: {name} (in {FAULT_CATALOG}, "
+                f"no table row in {FAULT_DOC})"))
+        for name in sorted(documented - declared):
+            findings.append(Finding(
+                self.name, "stale-doc-entry", FAULT_DOC, 1,
+                f"stale doc entry: {name} (listed in {FAULT_DOC}, not in "
+                f"{FAULT_CATALOG})"))
+
+        fired = set()
+        pkg = root / FAULT_PKG
+        for path in sorted(pkg.rglob("*.py")) if pkg.is_dir() else []:
+            if path == cat_path:
+                continue
+            src = path.read_text()
+            rel = path.relative_to(root).as_posix()
+            for name in _SITE_RE.findall(src):
+                fired.add(name)
+                if name not in declared:
+                    findings.append(Finding(
+                        self.name, "unknown-point", rel, 1,
+                        f"unknown fault point fired: {name} ({rel}) — "
+                        f"add it to {FAULT_CATALOG}"))
+            if rel in _DYNAMIC_SITES:
+                if _DYNAMIC_MARKER not in src:
+                    findings.append(Finding(
+                        self.name, "error", rel, 1,
+                        f"error: {rel} no longer builds dynamic point "
+                        "names (update _DYNAMIC_SITES in "
+                        "hvdlint/catalogs.py)"))
+                else:
+                    fired.update(_DYNAMIC_SITES[rel])
+        for name in sorted(declared - fired):
+            findings.append(Finding(
+                self.name, "dead-point", FAULT_CATALOG, 1,
+                f"dead fault point: {name} (in {FAULT_CATALOG} but "
+                f"nothing calls faults.point({name!r}))"))
+        return findings

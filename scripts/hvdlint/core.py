@@ -1,0 +1,184 @@
+"""hvdlint core: project model, findings, pragmas, analyzer registry.
+
+The suite is pure stdlib (`ast` + `re`) by design: CI machines and
+pre-commit hooks run it without jax, and nothing here imports
+`horovod_tpu` (whose package __init__ pulls in the backend).  Analyzers
+that need runtime data (the env catalog) load the single module file by
+path instead of importing the package.
+
+Suppression pragma — one per rule class, reason REQUIRED:
+
+    risky_line()  # lint: allow-<rule>(why this is safe here)
+
+placed on the offending line or the line directly above it.  A pragma
+with an empty reason is itself a finding (`pragma/missing-reason`), so
+suppressions stay reviewable.  See docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+PRAGMA_RE = re.compile(r"#\s*lint:\s*allow-([a-z-]+)\(([^)]*)\)")
+
+#: Directories never scanned, whatever the scope (fixture trees in
+#: tests/ carry intentional violations; hvdlint's own sources mention
+#: every pattern it hunts).
+EXCLUDE_PARTS = {"tests", "hvdlint", "__pycache__", ".git"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    analyzer: str  # e.g. "lock-discipline"
+    rule: str      # e.g. "unlocked-write"
+    path: str      # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self, fmt: str = "text") -> str:
+        if fmt == "github":
+            return (f"::error file={self.path},line={self.line},"
+                    f"title={self.analyzer}/{self.rule}::{self.message}")
+        return (f"{self.path}:{self.line}: "
+                f"[{self.analyzer}/{self.rule}] {self.message}")
+
+
+class SourceFile:
+    """One parsed source file + its suppression pragmas."""
+
+    def __init__(self, root: Path, path: Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self._tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+        # line -> [(rule, reason)]
+        self.pragmas: Dict[int, List[Tuple[str, str]]] = {}
+        for i, ln in enumerate(self.lines, 1):
+            for m in PRAGMA_RE.finditer(ln):
+                self.pragmas.setdefault(i, []).append(
+                    (m.group(1), m.group(2).strip()))
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        """Parsed AST, or None when the file has a syntax error (the
+        runner reports parse errors once, centrally)."""
+        if self._tree is None and self.parse_error is None:
+            try:
+                self._tree = ast.parse(self.text, filename=self.rel)
+            except SyntaxError as e:
+                self.parse_error = e
+        return self._tree
+
+    def allowed(self, rule: str, line: int) -> bool:
+        """True when a reasoned allow-<rule> pragma covers `line`."""
+        for ln in (line, line - 1):
+            for r, reason in self.pragmas.get(ln, ()):
+                if r == rule and reason:
+                    return True
+        return False
+
+
+class Project:
+    """Lazy, cached view of the repo's python sources."""
+
+    def __init__(self, root):
+        self.root = Path(root).resolve()
+        self._files: Dict[str, SourceFile] = {}
+
+    def _load(self, path: Path) -> SourceFile:
+        rel = path.relative_to(self.root).as_posix()
+        sf = self._files.get(rel)
+        if sf is None:
+            sf = self._files[rel] = SourceFile(self.root, path)
+        return sf
+
+    def files(self, *rel_dirs: str,
+              top_level: bool = False) -> List[SourceFile]:
+        """All .py files under the given repo-relative dirs (recursive),
+        plus the repo root's immediate *.py when `top_level`.  Paths with
+        an excluded component (tests/, hvdlint/, ...) are skipped."""
+        out: List[SourceFile] = []
+        seen = set()
+        roots: List[Path] = []
+        for d in rel_dirs:
+            p = self.root / d
+            if p.is_dir():
+                roots.append(p)
+        for base in roots:
+            for path in sorted(base.rglob("*.py")):
+                rel = path.relative_to(self.root)
+                if EXCLUDE_PARTS.intersection(rel.parts):
+                    continue
+                if rel.as_posix() not in seen:
+                    seen.add(rel.as_posix())
+                    out.append(self._load(path))
+        if top_level:
+            for path in sorted(self.root.glob("*.py")):
+                if path.name not in seen:
+                    seen.add(path.name)
+                    out.append(self._load(path))
+        return out
+
+    def package_files(self) -> List[SourceFile]:
+        """The runtime package — the scope for code-invariant analyzers."""
+        return self.files("horovod_tpu")
+
+
+class Analyzer:
+    """Base class: subclasses set `name`/`description` and implement
+    run(project) -> [Finding].  Register instances in hvdlint.ALL."""
+
+    name = "?"
+    description = "?"
+
+    def run(self, project: Project) -> List[Finding]:
+        raise NotImplementedError
+
+    # -- helpers shared by AST analyzers --------------------------------
+    @staticmethod
+    def dotted(node: ast.AST) -> Optional[str]:
+        """'a.b.c' for a Name/Attribute chain, else None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+
+def run_all(project: Project, analyzers: Sequence[Analyzer],
+            only: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the suite; returns findings sorted by path/line.  Adds
+    parse-error and pragma-hygiene findings for every scanned file."""
+    wanted = set(only) if only else None
+    findings: List[Finding] = []
+    for a in analyzers:
+        if wanted is not None and a.name not in wanted:
+            continue
+        findings.extend(a.run(project))
+    # Files touched by any analyzer: report syntax errors once, and
+    # reasonless pragmas (a suppression nobody can review is a bug).
+    for rel in sorted(project._files):
+        sf = project._files[rel]
+        if sf.parse_error is not None:
+            findings.append(Finding(
+                "core", "parse-error", sf.rel,
+                sf.parse_error.lineno or 1,
+                f"cannot parse: {sf.parse_error.msg}"))
+        for line in sorted(sf.pragmas):
+            for rule, reason in sf.pragmas[line]:
+                if not reason:
+                    findings.append(Finding(
+                        "pragma", "missing-reason", sf.rel, line,
+                        f"allow-{rule} pragma needs a reason: "
+                        f"`# lint: allow-{rule}(<why>)`"))
+    dedup = sorted(set(findings), key=lambda f: (f.path, f.line, f.rule))
+    return dedup

@@ -1,0 +1,208 @@
+"""Env-var registry analyzer.
+
+Every ``HOROVOD_*`` environment variable the code touches must be
+declared in ``horovod_tpu/common/env_catalog.py`` (pure stdlib — loaded
+by file path, never through the package) and documented in the
+generated ``docs/ENV_VARS.md``.  Rules:
+
+* ``unknown-env`` — a ``HOROVOD_*`` literal (or a
+  ``util.getenv/env_bool/env_int/env_float("NAME")`` helper read, which
+  implies the ``HOROVOD_`` prefix) not declared in the catalog.
+* ``unknown-prefix`` — a literal ending in ``_`` (a startswith filter /
+  concat prefix) not declared in the catalog's ``PREFIXES``.
+* ``dynamic-env`` — a helper read whose name is built at runtime
+  (f-string) in a file the catalog does not register as a
+  ``dynamic_site`` of some entry.
+* ``dead-entry`` — a catalog entry nothing references (static literal,
+  helper read, or live dynamic site).
+* ``missing-description`` — a catalog entry with an empty description.
+* ``stale-docs`` — ``docs/ENV_VARS.md`` differs from what
+  ``env_catalog.render_markdown()`` generates (run
+  ``python scripts/gen_env_docs.py`` to refresh).
+
+Scope: ``horovod_tpu/``, ``scripts/``, ``examples/`` and top-level
+``*.py`` (benches, entry points); ``tests/`` is excluded.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Analyzer, Finding, Project, SourceFile
+
+CATALOG_REL = "horovod_tpu/common/env_catalog.py"
+DOC_REL = "docs/ENV_VARS.md"
+
+_NAME_RE = re.compile(r"HOROVOD_[A-Z0-9_]*")
+_ENV_HELPERS = {"getenv", "env_bool", "env_int", "env_float", "env_str"}
+
+
+def load_catalog(project: Project):
+    """Import env_catalog.py by path (no horovod_tpu package import, so
+    no jax).  Returns the module or None when the file is absent."""
+    path = project.root / CATALOG_REL
+    if not path.is_file():
+        return None
+    spec = importlib.util.spec_from_file_location("_hvd_env_catalog", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclasses resolves types via sys.modules
+    spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    return mod
+
+
+def _helper_read(node: ast.Call) -> Optional[Tuple[str, bool]]:
+    """(short_name, is_dynamic) for util.getenv/env_* style reads, else
+    None.  os.getenv is NOT a helper read (full names, literal rule)."""
+    f = node.func
+    leaf = base = None
+    if isinstance(f, ast.Attribute):
+        leaf = f.attr
+        base = f.value.id if isinstance(f.value, ast.Name) else None
+        if base == "os":
+            return None
+    elif isinstance(f, ast.Name):
+        leaf = f.id
+    if leaf not in _ENV_HELPERS or not node.args:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        if re.fullmatch(r"[A-Z][A-Z0-9_]*", arg.value):
+            return arg.value, False
+        return None
+    if isinstance(arg, ast.Name):
+        # Bare-variable forward (`env_bool(name)` delegating to
+        # `getenv(name)` inside the helper layer itself) — the concrete
+        # name is checked at the wrapper's own call sites.
+        return None
+    return "", True  # dynamic name construction (f-string / concat)
+
+
+class EnvVarRegistry(Analyzer):
+    name = "env-registry"
+    description = ("HOROVOD_* reads vs horovod_tpu/common/env_catalog.py "
+                   "vs generated docs/ENV_VARS.md")
+
+    def scope(self, project: Project) -> List[SourceFile]:
+        return project.files("horovod_tpu", "scripts", "examples",
+                             top_level=True)
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        cat = load_catalog(project)
+        if cat is None:
+            return [Finding(self.name, "missing-catalog", CATALOG_REL, 1,
+                            f"{CATALOG_REL} not found — every HOROVOD_* "
+                            "env var must be declared there")]
+        entries = {v.name: v for v in cat.CATALOG}
+        prefixes: Dict[str, str] = dict(cat.PREFIXES)
+        dynamic_sites = {v.dynamic_site for v in cat.CATALOG
+                         if v.dynamic_site}
+        referenced: Set[str] = set()
+        live_dynamic: Set[str] = set()
+
+        for sf in self.scope(project):
+            if sf.rel == CATALOG_REL:
+                continue
+            tree = sf.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str):
+                    findings.extend(self._check_literal(
+                        sf, node, entries, prefixes, referenced))
+                if isinstance(node, ast.Call):
+                    hr = _helper_read(node)
+                    if hr is None:
+                        continue
+                    short, dynamic = hr
+                    if dynamic:
+                        if sf.rel in dynamic_sites:
+                            live_dynamic.add(sf.rel)
+                        elif not sf.allowed("env", node.lineno):
+                            findings.append(Finding(
+                                self.name, "dynamic-env", sf.rel,
+                                node.lineno,
+                                "env name built at runtime; register "
+                                "this file as a dynamic_site of a "
+                                f"catalog entry in {CATALOG_REL}"))
+                        continue
+                    full = "HOROVOD_" + short
+                    if full in entries:
+                        referenced.add(full)
+                    elif not sf.allowed("env", node.lineno):
+                        findings.append(Finding(
+                            self.name, "unknown-env", sf.rel, node.lineno,
+                            f"{full} (helper read) is not declared in "
+                            f"{CATALOG_REL}"))
+
+        # liveness + doc checks against the catalog source for line nums
+        cat_sf = SourceFile(project.root, project.root / CATALOG_REL)
+        for name, v in sorted(entries.items()):
+            line = self._entry_line(cat_sf, name)
+            if v.dynamic_site:
+                if v.dynamic_site not in live_dynamic:
+                    findings.append(Finding(
+                        self.name, "dead-entry", CATALOG_REL, line,
+                        f"{name}: dynamic_site {v.dynamic_site} has no "
+                        "runtime-built env read any more"))
+            elif name not in referenced:
+                findings.append(Finding(
+                    self.name, "dead-entry", CATALOG_REL, line,
+                    f"{name} is cataloged but nothing in the code "
+                    "references it"))
+            if not v.description.strip():
+                findings.append(Finding(
+                    self.name, "missing-description", CATALOG_REL, line,
+                    f"{name} has no description (docs/ENV_VARS.md row "
+                    "would be empty)"))
+
+        doc_path = project.root / DOC_REL
+        want = cat.render_markdown()
+        if not doc_path.is_file():
+            findings.append(Finding(
+                self.name, "stale-docs", DOC_REL, 1,
+                f"{DOC_REL} missing — run `python scripts/gen_env_docs.py`"))
+        elif doc_path.read_text() != want:
+            findings.append(Finding(
+                self.name, "stale-docs", DOC_REL, 1,
+                f"{DOC_REL} is out of date with {CATALOG_REL} — run "
+                "`python scripts/gen_env_docs.py`"))
+        return findings
+
+    def _check_literal(self, sf: SourceFile, node: ast.Constant,
+                       entries, prefixes, referenced) -> List[Finding]:
+        out: List[Finding] = []
+        val = node.value
+        if not _NAME_RE.fullmatch(val):
+            # Inside f-strings the leading Constant part of a built name
+            # ends with '_' and fullmatches; prose strings never do.
+            return out
+        if val.endswith("_") or val == "HOROVOD_":
+            for p in prefixes:
+                if val == p:
+                    return out
+            if not sf.allowed("env", node.lineno):
+                out.append(Finding(
+                    self.name, "unknown-prefix", sf.rel, node.lineno,
+                    f"prefix literal {val!r} is not declared in "
+                    f"{CATALOG_REL} PREFIXES"))
+            return out
+        if val in entries:
+            referenced.add(val)
+        elif not sf.allowed("env", node.lineno):
+            out.append(Finding(
+                self.name, "unknown-env", sf.rel, node.lineno,
+                f"{val} is not declared in {CATALOG_REL}"))
+        return out
+
+    @staticmethod
+    def _entry_line(cat_sf: SourceFile, name: str) -> int:
+        for i, ln in enumerate(cat_sf.lines, 1):
+            if f'"{name}"' in ln:
+                return i
+        return 1

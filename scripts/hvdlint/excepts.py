@@ -1,0 +1,62 @@
+"""Exception-discipline analyzer.
+
+Two rules over the runtime package (``horovod_tpu/``; tests live
+outside it):
+
+* ``bare-assert`` — ``assert`` compiles away under ``python -O`` and
+  raises an ``AssertionError`` no caller classifies, so runtime
+  invariants must raise ``HorovodTpuError`` / ``HorovodInternalError``
+  instead.  Suppress with ``# lint: allow-assert(reason)``.
+
+* ``silent-swallow`` — ``except Exception:`` / ``except
+  BaseException:`` / bare ``except:`` whose body is only ``pass`` hides
+  real failures (a wedged native writer, a half-dead agent) with no
+  trace.  Re-raise, log, count it in metrics — or justify it with
+  ``# lint: allow-swallow(reason)`` on the ``except`` line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Analyzer, Finding, Project
+
+_BROAD = {"Exception", "BaseException"}
+
+
+class ExceptionDiscipline(Analyzer):
+    name = "exception-discipline"
+    description = ("bare asserts in runtime paths; silent "
+                   "`except Exception: pass` swallows")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in project.package_files():
+            tree = sf.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assert):
+                    if not sf.allowed("assert", node.lineno):
+                        findings.append(Finding(
+                            self.name, "bare-assert", sf.rel, node.lineno,
+                            "bare `assert` in runtime path (vanishes "
+                            "under -O, raises unclassified "
+                            "AssertionError); raise HorovodTpuError/"
+                            "HorovodInternalError instead"))
+                if isinstance(node, ast.ExceptHandler):
+                    broad = node.type is None or (
+                        isinstance(node.type, ast.Name)
+                        and node.type.id in _BROAD)
+                    silent = (len(node.body) == 1
+                              and isinstance(node.body[0], ast.Pass))
+                    if broad and silent \
+                            and not sf.allowed("swallow", node.lineno):
+                        findings.append(Finding(
+                            self.name, "silent-swallow", sf.rel,
+                            node.lineno,
+                            "`except Exception: pass` swallows failures "
+                            "silently; re-raise, log/count it, or add "
+                            "`# lint: allow-swallow(<reason>)`"))
+        return findings

@@ -1,0 +1,259 @@
+"""Lock-discipline analyzer.
+
+Two rules over `horovod_tpu/`:
+
+* ``unlocked-write`` — for any class owning a ``threading.Lock`` /
+  ``RLock`` / ``Condition`` attribute (directly or via a same-module
+  base class), an instance attribute written BOTH under ``with
+  self._lock:`` and outside it is flagged at every unguarded write.
+  ``__init__``/``__post_init__`` writes are exempt (construction
+  happens-before publication), and methods whose name ends in
+  ``_locked`` are treated as lock-held (the repo's caller-holds-the-lock
+  convention, e.g. ``Registration._blacklist_locked``).
+
+* ``order-inversion`` — a global lock-acquisition-order graph is built
+  from lexically nested ``with`` acquisitions (module locks and
+  ``self.<attr>`` locks); any cycle means two code paths can take the
+  same pair of locks in opposite orders and deadlock.
+
+Suppress with ``# lint: allow-unlocked(reason)`` on the write line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Analyzer, Finding, Project, SourceFile
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_CTOR_EXEMPT = {"__init__", "__post_init__", "__new__"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_CTORS:
+        return isinstance(f.value, ast.Name) and f.value.id == "threading"
+    return isinstance(f, ast.Name) and f.id in _LOCK_CTORS
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _assign_targets(stmt: ast.stmt) -> List[ast.expr]:
+    if isinstance(stmt, ast.Assign):
+        return list(stmt.targets)
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return [stmt.target]
+    return []
+
+
+class _ClassInfo:
+    def __init__(self, module: str, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.bases = [b.id for b in node.bases if isinstance(b, ast.Name)]
+        self.own_locks: Set[str] = set()
+        # attr -> [(under_lock, line, method)]
+        self.writes: Dict[str, List[Tuple[bool, int, str]]] = {}
+
+    def collect_locks(self) -> None:
+        for sub in ast.walk(self.node):
+            for tgt in _assign_targets(sub) if isinstance(sub, ast.stmt) \
+                    else []:
+                attr = _self_attr(tgt)
+                if attr and isinstance(sub, ast.Assign) \
+                        and _is_lock_ctor(sub.value):
+                    self.own_locks.add(attr)
+            # class-level `X = threading.Lock()` (shared instance lock)
+            if isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.own_locks.add(tgt.id)
+
+
+class LockDiscipline(Analyzer):
+    name = "lock-discipline"
+    description = ("guarded-vs-unguarded attribute writes in lock-owning "
+                   "classes; lock-acquisition-order inversions")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        # lock-order graph: edge (held -> acquired) -> first site
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for sf in project.package_files():
+            tree = sf.tree
+            if tree is None:
+                continue
+            findings.extend(self._scan_module(sf, tree, edges))
+        findings.extend(self._order_cycles(edges))
+        return findings
+
+    # -- per-module ------------------------------------------------------
+    def _scan_module(self, sf: SourceFile, tree: ast.AST,
+                     edges) -> List[Finding]:
+        findings: List[Finding] = []
+        classes: Dict[str, _ClassInfo] = {}
+        module_locks: Set[str] = set()
+        for stmt in tree.body:  # type: ignore[attr-defined]
+            if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        module_locks.add(tgt.id)
+            if isinstance(stmt, ast.ClassDef):
+                ci = _ClassInfo(sf.rel, stmt)
+                ci.collect_locks()
+                classes[stmt.name] = ci
+
+        def all_locks(ci: _ClassInfo, seen=()) -> Set[str]:
+            locks = set(ci.own_locks)
+            for b in ci.bases:
+                if b in classes and b not in seen:
+                    locks |= all_locks(classes[b], seen + (b,))
+            return locks
+
+        for cname, ci in classes.items():
+            locks = all_locks(ci)
+            if not locks:
+                continue
+            for meth in ci.node.body:
+                if not isinstance(
+                        meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                held_all = (meth.name in _CTOR_EXEMPT
+                            or meth.name.endswith("_locked"))
+                self._walk_method(ci, meth.name, meth.body, locks,
+                                  under=held_all,
+                                  ctor=meth.name in _CTOR_EXEMPT)
+            for attr, writes in sorted(ci.writes.items()):
+                if attr in locks:
+                    continue
+                guarded = [w for w in writes if w[0]]
+                bare = [w for w in writes if not w[0]]
+                if not guarded or not bare:
+                    continue
+                glocked = guarded[0][1]
+                for _, line, methname in bare:
+                    if sf.allowed("unlocked", line):
+                        continue
+                    findings.append(Finding(
+                        self.name, "unlocked-write", sf.rel, line,
+                        f"{cname}.{attr} is written without the lock in "
+                        f"{methname}() but lock-guarded elsewhere (e.g. "
+                        f"line {glocked}); guard it or pragma "
+                        f"allow-unlocked"))
+
+            # contribute to the global acquisition-order graph
+            self._order_edges(sf, ci.node, cname, locks, module_locks,
+                              edges)
+
+        # module-level functions also order module locks
+        holder = ast.Module(body=[s for s in tree.body
+                                  if not isinstance(s, ast.ClassDef)],
+                            type_ignores=[])
+        self._order_edges(sf, holder, None, set(), module_locks, edges)
+        return findings
+
+    def _walk_method(self, ci: _ClassInfo, methname: str,
+                     body: List[ast.stmt], locks: Set[str],
+                     under: bool, ctor: bool) -> None:
+        for stmt in body:
+            now_under = under
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in locks:
+                        now_under = True
+                self._walk_method(ci, methname, stmt.body, locks,
+                                  now_under, ctor)
+                continue
+            for tgt in _assign_targets(stmt):
+                attr = _self_attr(tgt)
+                if attr and not ctor:
+                    ci.writes.setdefault(attr, []).append(
+                        (under, stmt.lineno, methname))
+            for sub_body in self._sub_bodies(stmt):
+                self._walk_method(ci, methname, sub_body, locks, under,
+                                  ctor)
+
+    @staticmethod
+    def _sub_bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+        out = []
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, list) and sub \
+                    and isinstance(sub[0], ast.stmt):
+                out.append(sub)
+        for h in getattr(stmt, "handlers", []):
+            out.append(h.body)
+        return out
+
+    # -- acquisition order ----------------------------------------------
+    def _lock_id(self, sf: SourceFile, cname: Optional[str],
+                 expr: ast.expr, locks: Set[str],
+                 module_locks: Set[str]) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and attr in locks:
+            return f"{sf.rel}:{cname}.{attr}"
+        if isinstance(expr, ast.Name) and expr.id in module_locks:
+            return f"{sf.rel}:{expr.id}"
+        return None
+
+    def _order_edges(self, sf: SourceFile, scope: ast.AST,
+                     cname: Optional[str], locks: Set[str],
+                     module_locks: Set[str], edges) -> None:
+        def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in node.items:
+                    lid = self._lock_id(sf, cname, item.context_expr,
+                                        locks, module_locks)
+                    if lid is not None:
+                        for h in new_held:
+                            edges.setdefault(
+                                (h, lid), (sf.rel, node.lineno))
+                        new_held = new_held + (lid,)
+                for sub in node.body:
+                    visit(sub, new_held)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        visit(scope, ())
+
+    def _order_cycles(self, edges) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        findings: List[Finding] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: str, node: str, path: Tuple[str, ...]) -> None:
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    cyc = tuple(sorted(path))
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    sites = [edges.get((x, y)) for x, y in
+                             zip(path, path[1:] + (start,))]
+                    where, line = sites[0] or ("?", 1)
+                    findings.append(Finding(
+                        self.name, "order-inversion", where, line,
+                        "lock acquisition order inversion: "
+                        + " -> ".join(path + (start,))
+                        + " (cycle; two threads taking these locks in "
+                          "opposite orders can deadlock)"))
+                elif nxt not in path:
+                    dfs(start, nxt, path + (nxt,))
+
+        for n in sorted(graph):
+            dfs(n, n, (n,))
+        return findings

@@ -1,0 +1,185 @@
+"""jit-purity analyzer.
+
+A function that jax traces (``jax.jit`` / ``pmap`` / ``shard_map`` /
+``pl.pallas_call`` — as decorator, via ``partial(jax.jit, ...)``, or by
+being passed to one of those calls) executes its Python body ONCE at
+trace time; any side effect in it fires at compile, not per step, and
+breaks the bitwise-reproducibility the fused compute-collective path
+depends on (see ISSUE refs: Punniyamurthy et al., arXiv:2305.06942).
+
+Flagged inside traced bodies (rule ``impure-call``):
+
+* wall clocks: ``time.time/perf_counter/monotonic/process_time``,
+  ``time.sleep``, ``datetime.now/utcnow/today``
+* env reads: ``os.getenv``, ``os.environ`` in any form, and the repo's
+  ``util.getenv/env_bool/env_int/env_float`` helpers
+* host I/O: ``print``, ``open``, ``input``
+* stdlib ``random.*`` (trace-time nondeterminism; ``jax.random`` is the
+  pure API and is not flagged)
+* logging (``logging.*`` or any ``log``/``logger`` object's
+  debug/info/warning/error/exception/critical)
+* metrics recording: ``inc/dec/set/observe/labels`` reached through a
+  name containing ``met``/``metrics`` (the registry's hot-path API)
+
+and rule ``nonlocal-mutation`` for ``global``/``nonlocal`` declarations
+inside a traced body.  Suppress with ``# lint: allow-impure(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Analyzer, Finding, Project, SourceFile
+
+_TRACE_ATTRS = {"jit", "pmap", "pallas_call"}
+_TRACE_NAMES = {"jit", "pmap", "pallas_call", "shard_map"}
+
+_TIME_FNS = {"time", "perf_counter", "monotonic", "process_time",
+             "sleep", "clock"}
+_DATETIME_FNS = {"now", "utcnow", "today"}
+_ENV_HELPERS = {"getenv", "env_bool", "env_int", "env_float", "env_str"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+_METRIC_METHODS = {"inc", "dec", "set", "observe", "labels"}
+
+
+def _call_chain(func: ast.expr) -> List[str]:
+    """['_met', 'collective_calls', 'labels', 'inc'] style chain parts;
+    Call nodes inside the chain are traversed through."""
+    parts: List[str] = []
+    node = func
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return list(reversed(parts))
+        else:
+            return list(reversed(parts))
+
+
+def _is_trace_call(node: ast.expr) -> bool:
+    """True for jax.jit / jit / pl.pallas_call / shard_map /
+    partial(jax.jit, ...) expressions."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in _TRACE_ATTRS
+    if isinstance(node, ast.Name):
+        return node.id in _TRACE_NAMES
+    if isinstance(node, ast.Call):
+        # ONLY partial(jax.jit, ...) wrapping counts: a plain
+        # `jax.jit(f)(x)` outer call must not re-resolve `x` as traced.
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "partial" or \
+                isinstance(f, ast.Attribute) and f.attr == "partial":
+            return bool(node.args) and _is_trace_call(node.args[0])
+    return False
+
+
+class JitPurity(Analyzer):
+    name = "jit-purity"
+    description = ("side effects (clocks, env, logging, metrics, IO, "
+                   "nonlocal mutation) inside jax-traced bodies")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in project.package_files():
+            tree = sf.tree
+            if tree is None:
+                continue
+            findings.extend(self._scan_module(sf, tree))
+        return findings
+
+    def _scan_module(self, sf: SourceFile, tree: ast.AST) -> List[Finding]:
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        traced: List[Tuple[ast.AST, str]] = []  # (body node, why)
+        seen: Set[int] = set()
+
+        def mark(node: Optional[ast.AST], why: str) -> None:
+            if node is None or id(node) in seen:
+                return
+            seen.add(id(node))
+            traced.append((node, why))
+
+        def resolve_arg(arg: ast.expr, why: str) -> None:
+            if isinstance(arg, ast.Lambda):
+                mark(arg, why)
+            elif isinstance(arg, ast.Name):
+                for d in defs.get(arg.id, ()):
+                    mark(d, why)
+            elif isinstance(arg, ast.Call) and arg.args:
+                # shard_map(f, ...) nested inside jax.jit(...): the
+                # innermost callable is still traced.
+                resolve_arg(arg.args[0], why)
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_trace_call(dec):
+                        mark(node, "traced decorator")
+            if isinstance(node, ast.Call) and _is_trace_call(node.func) \
+                    and node.args:
+                resolve_arg(node.args[0], "passed to tracer")
+
+        findings: List[Finding] = []
+        for body, _why in traced:
+            findings.extend(self._check_body(sf, body))
+        return findings
+
+    # -- impurity checks inside one traced body --------------------------
+    def _check_body(self, sf: SourceFile, fn: ast.AST) -> List[Finding]:
+        findings: List[Finding] = []
+        name = getattr(fn, "name", "<lambda>")
+
+        def flag(node: ast.AST, what: str,
+                 rule: str = "impure-call") -> None:
+            if sf.allowed("impure", node.lineno):
+                return
+            findings.append(Finding(
+                self.name, rule, sf.rel, node.lineno,
+                f"{what} inside jax-traced `{name}` runs at TRACE time, "
+                f"not per step; hoist it out of the traced body"))
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                flag(node, f"`{type(node).__name__.lower()} "
+                     f"{', '.join(node.names)}` mutation",
+                     rule="nonlocal-mutation")
+            if isinstance(node, ast.Attribute) and node.attr == "environ":
+                if isinstance(node.value, ast.Name) \
+                        and node.value.id == "os":
+                    flag(node, "os.environ access")
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _call_chain(node.func)
+            if not chain:
+                continue
+            root, leaf = chain[0], chain[-1]
+            if root == "time" and leaf in _TIME_FNS and len(chain) == 2:
+                flag(node, f"wall-clock time.{leaf}()")
+            elif leaf in _DATETIME_FNS and root in ("datetime",):
+                flag(node, f"wall-clock datetime {leaf}()")
+            elif root == "os" and leaf == "getenv":
+                flag(node, "env read os.getenv()")
+            elif leaf in _ENV_HELPERS and root in ("util", "_util") \
+                    or (len(chain) == 1 and leaf in _ENV_HELPERS):
+                flag(node, f"env read {'.'.join(chain)}()")
+            elif len(chain) == 1 and leaf in ("print", "input", "open"):
+                flag(node, f"host I/O {leaf}()")
+            elif root == "random" and len(chain) == 2:
+                flag(node, f"stdlib random.{leaf}() "
+                     "(trace-time nondeterminism; use jax.random)")
+            elif leaf in _LOG_METHODS and (
+                    root == "logging" or "log" in root.lower()):
+                flag(node, f"logging call {'.'.join(chain)}()")
+            elif leaf in _METRIC_METHODS and any(
+                    "met" in p.lower() for p in chain[:-1]):
+                flag(node, f"metrics recording {'.'.join(chain)}()")
+        return findings

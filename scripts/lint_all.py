@@ -1,0 +1,68 @@
+#!/usr/bin/env python3
+"""Run the whole hvdlint static-analysis suite over the repo.
+
+Exit 0 when clean, 1 with one line per offense on drift.  Pure stdlib
+(no jax / no horovod_tpu import) so CI and pre-commit can run it bare.
+
+Usage:
+    python scripts/lint_all.py [root] [--format=text|github]
+                               [--only=name[,name...]] [--list]
+
+``--format=github`` emits GitHub Actions ``::error`` annotations;
+``--only`` restricts to named analyzers (see ``--list``).
+Docs: docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import hvdlint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", nargs="?",
+                    default=str(Path(__file__).resolve().parent.parent),
+                    help="repo root (default: this script's repo)")
+    ap.add_argument("--format", choices=("text", "github"),
+                    default="text", dest="fmt")
+    ap.add_argument("--only", default="",
+                    help="comma-separated analyzer names")
+    ap.add_argument("--list", action="store_true",
+                    help="list analyzers and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for a in hvdlint.ALL:
+            print(f"{a.name}: {a.description}")
+        return 0
+
+    only = [s for s in args.only.split(",") if s] or None
+    if only:
+        known = {a.name for a in hvdlint.ALL}
+        unknown = [s for s in only if s not in known]
+        if unknown:
+            print(f"unknown analyzer(s): {', '.join(unknown)} "
+                  f"(see --list)", file=sys.stderr)
+            return 2
+
+    project = hvdlint.Project(args.root)
+    findings = hvdlint.run_all(project, hvdlint.ALL, only=only)
+    for f in findings:
+        print(f.render(args.fmt))
+    if findings:
+        print(f"{len(findings)} finding(s).", file=sys.stderr)
+        return 1
+    ran = only or [a.name for a in hvdlint.ALL]
+    print(f"ok: {len(ran)} analyzer(s) clean "
+          f"({', '.join(ran)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,511 @@
+"""Tier-1 enforcement + unit tests for the hvdlint static-analysis suite
+(scripts/hvdlint/, docs/STATIC_ANALYSIS.md).
+
+The suite itself never imports jax or horovod_tpu; these tests drive it
+in-process against synthetic fixture projects (tmp_path trees) and run
+`scripts/lint_all.py` against the real repo as the drift gate.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import hvdlint  # noqa: E402
+from hvdlint import (  # noqa: E402
+    EnvVarRegistry,
+    ExceptionDiscipline,
+    JitPurity,
+    LockDiscipline,
+    Project,
+    run_all,
+)
+
+MINI_CATALOG = '''\
+from dataclasses import dataclass
+from typing import Optional
+
+@dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: str
+    component: str
+    description: str
+    doc: str = ""
+    dynamic_site: Optional[str] = None
+
+CATALOG = (
+    EnvVar("HOROVOD_KNOWN", "0", "test", "a known knob"),
+)
+PREFIXES = {"HOROVOD_": "forwarding filter"}
+
+def render_markdown():
+    return "# Environment variables\\n"
+'''
+
+
+def make_project(tmp_path, files, catalog=None, env_doc=None):
+    """Build a throwaway repo tree: {relpath: source} + optional env
+    catalog/doc, and return an hvdlint Project over it."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    if catalog is not None:
+        p = tmp_path / "horovod_tpu" / "common" / "env_catalog.py"
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(catalog)
+    if env_doc is not None:
+        d = tmp_path / "docs"
+        d.mkdir(exist_ok=True)
+        (d / "ENV_VARS.md").write_text(env_doc)
+    return Project(tmp_path)
+
+
+def rules(findings):
+    return sorted({(f.analyzer, f.rule) for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+def test_unlocked_write_flagged(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0
+
+            def inc(self):
+                with self._lock:
+                    self._value += 1
+
+            def set(self, v):
+                self._value = v
+    """})
+    fs = LockDiscipline().run(proj)
+    assert [(f.rule, f.line) for f in fs] == [("unlocked-write", 13)]
+    assert "Box._value" in fs[0].message
+
+
+def test_consistently_guarded_class_clean(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0
+
+            def inc(self):
+                with self._lock:
+                    self._value += 1
+
+            def _drain_locked(self):
+                self._value = 0  # caller-holds-the-lock convention
+    """})
+    assert LockDiscipline().run(proj) == []
+
+
+def test_unlocked_write_pragma(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0
+
+            def inc(self):
+                with self._lock:
+                    self._value += 1
+
+            def set(self, v):
+                # lint: allow-unlocked(single writer thread by contract)
+                self._value = v
+    """})
+    assert LockDiscipline().run(proj) == []
+
+
+def test_lock_order_inversion(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def fwd():
+            with _a:
+                with _b:
+                    pass
+
+        def rev():
+            with _b:
+                with _a:
+                    pass
+    """})
+    fs = LockDiscipline().run(proj)
+    assert [f.rule for f in fs] == ["order-inversion"]
+    assert "_a" in fs[0].message and "_b" in fs[0].message
+
+
+def test_lock_order_consistent_clean(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def f():
+            with _a:
+                with _b:
+                    pass
+
+        def g():
+            with _a:
+                with _b:
+                    pass
+    """})
+    assert LockDiscipline().run(proj) == []
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+def test_impure_traced_decorator(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            t0 = time.perf_counter()
+            return x + t0
+    """})
+    fs = JitPurity().run(proj)
+    assert [(f.rule, f.line) for f in fs] == [("impure-call", 6)]
+    assert "perf_counter" in fs[0].message
+
+
+def test_impure_fn_passed_to_tracer(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import os
+        import jax
+
+        def step(x):
+            if os.getenv("HOROVOD_DEBUG"):
+                print("tracing", x.shape)
+            return x
+
+        fast = jax.jit(step)
+    """})
+    fs = JitPurity().run(proj)
+    assert ("jit-purity", "impure-call") in rules(fs)
+    assert {f.line for f in fs} == {5, 6}  # os.getenv + print
+
+
+def test_partial_jit_and_shard_map_marked(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import logging
+        from functools import partial
+        import jax
+        from jax import shard_map
+
+        logger = logging.getLogger(__name__)
+
+        def inner(x):
+            logger.info("traced %s", x)
+            return x
+
+        fast = partial(jax.jit, donate_argnums=0)(inner)
+        sharded = jax.jit(shard_map(inner, mesh=None))
+    """})
+    fs = JitPurity().run(proj)
+    assert [f.rule for f in fs] == ["impure-call"]
+    assert "logging" in fs[0].message
+
+
+def test_untraced_fn_not_flagged(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import time
+
+        def host_loop(x):
+            return time.perf_counter() + x
+    """})
+    assert JitPurity().run(proj) == []
+
+
+def test_plain_outer_call_arg_not_traced(tmp_path):
+    # jax.jit(f)(x): `x` is a runtime argument, not a traced callable.
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import time
+        import jax
+
+        def pure(x):
+            return x * 2
+
+        def measure(x):
+            return time.monotonic()
+
+        y = jax.jit(pure)(measure(3))
+    """})
+    assert JitPurity().run(proj) == []
+
+
+def test_impure_pragma_and_jax_random_ok(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import random
+        import jax
+
+        @jax.jit
+        def step(key, x):
+            n = random.random()  # lint: allow-impure(trace-time seed ok)
+            return x + jax.random.uniform(key) + n
+    """})
+    assert JitPurity().run(proj) == []
+
+
+def test_nonlocal_mutation_flagged(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import jax
+
+        _count = 0
+
+        @jax.jit
+        def step(x):
+            global _count
+            _count += 1
+            return x
+    """})
+    fs = JitPurity().run(proj)
+    assert [f.rule for f in fs] == ["nonlocal-mutation"]
+
+
+def test_metrics_in_traced_body_flagged(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import jax
+        from .metrics import catalog as _met
+
+        @jax.jit
+        def step(x):
+            _met.collective_calls.labels("allreduce").inc()
+            return x
+    """})
+    fs = JitPurity().run(proj)
+    # both the .labels(...) and the .inc() stages of the chain count
+    assert {(f.rule, f.line) for f in fs} == {("impure-call", 6)}
+    assert any("metrics recording" in f.message for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# env-registry
+# ---------------------------------------------------------------------------
+
+def test_unknown_env_literal_and_helper(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import os
+        from .common import util
+
+        a = os.environ.get("HOROVOD_MYSTERY")
+        b = util.env_bool("ALSO_MYSTERY")
+        c = util.env_int("KNOWN", 3)
+    """}, catalog=MINI_CATALOG, env_doc="# Environment variables\n")
+    fs = EnvVarRegistry().run(proj)
+    unknown = sorted((f for f in fs if f.rule == "unknown-env"),
+                     key=lambda f: f.line)
+    assert [f.line for f in unknown] == [4, 5]
+    assert "HOROVOD_MYSTERY" in unknown[0].message
+    assert "HOROVOD_ALSO_MYSTERY" in unknown[1].message
+
+
+def test_dead_entry_and_stale_docs(tmp_path):
+    proj = make_project(
+        tmp_path, {"horovod_tpu/m.py": "x = 1\n"},
+        catalog=MINI_CATALOG, env_doc="out of date\n")
+    got = {f.rule for f in EnvVarRegistry().run(proj)}
+    assert got == {"dead-entry", "stale-docs"}
+
+
+def test_dynamic_env_requires_registration(tmp_path):
+    src = """\
+        from .common import util
+
+        def read(site):
+            return util.env_float(f"{site}_RETRY_JITTER", 0.1)
+    """
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": src},
+                        catalog=MINI_CATALOG,
+                        env_doc="# Environment variables\n")
+    fs = EnvVarRegistry().run(proj)
+    assert ("env-registry", "dynamic-env") in rules(fs)
+
+    cat = MINI_CATALOG.replace(
+        '"a known knob"),',
+        '"a known knob", "", "horovod_tpu/m.py"),')
+    src_ok = textwrap.dedent(src) + '\nx = util.getenv("KNOWN")\n'
+    proj2 = make_project(tmp_path / "ok", {"horovod_tpu/m.py": src_ok},
+                         catalog=cat, env_doc="# Environment variables\n")
+    assert [f.rule for f in EnvVarRegistry().run(proj2)] == []
+
+
+def test_unknown_prefix_literal(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        from .common import util
+
+        FWD = [k for k in ("a",) if k.startswith("HOROVOD_SECRET_")]
+        x = util.getenv("KNOWN")
+    """}, catalog=MINI_CATALOG, env_doc="# Environment variables\n")
+    fs = EnvVarRegistry().run(proj)
+    assert [f.rule for f in fs] == ["unknown-prefix"]
+
+
+def test_missing_catalog(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": "x = 1\n"})
+    fs = EnvVarRegistry().run(proj)
+    assert [f.rule for f in fs] == ["missing-catalog"]
+
+
+def test_repo_env_docs_fresh():
+    """docs/ENV_VARS.md must byte-match the catalog's renderer."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "gen_env_docs.py"),
+         REPO, "--check"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# exception-discipline
+# ---------------------------------------------------------------------------
+
+def test_bare_assert_flagged_and_pragma(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        def f(x):
+            assert x > 0
+            # lint: allow-assert(shape contract checked by caller)
+            assert x < 10
+            return x
+    """})
+    fs = ExceptionDiscipline().run(proj)
+    assert [(f.rule, f.line) for f in fs] == [("bare-assert", 2)]
+
+
+def test_silent_swallow_flagged(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+    """})
+    fs = ExceptionDiscipline().run(proj)
+    assert [(f.rule, f.line) for f in fs] == [("silent-swallow", 4)]
+
+
+def test_swallow_pragma_and_logged_handler_clean(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        import logging
+
+        def f():
+            try:
+                risky()
+            # lint: allow-swallow(best-effort cleanup at shutdown)
+            except Exception:
+                pass
+            try:
+                risky()
+            except Exception as e:
+                logging.debug("risky failed: %s", e)
+            try:
+                risky()
+            except ValueError:
+                pass
+    """})
+    assert ExceptionDiscipline().run(proj) == []
+
+
+def test_pragma_without_reason_is_a_finding(tmp_path):
+    proj = make_project(tmp_path, {"horovod_tpu/m.py": """\
+        def f():
+            try:
+                risky()
+            # lint: allow-swallow()
+            except Exception:
+                pass
+    """})
+    fs = run_all(Project(tmp_path), [ExceptionDiscipline()])
+    assert rules(fs) == [("exception-discipline", "silent-swallow"),
+                        ("pragma", "missing-reason")]
+
+
+def test_parse_error_reported_once(tmp_path):
+    proj = make_project(
+        tmp_path, {"horovod_tpu/m.py": "def broken(:\n    pass\n"})
+    fs = run_all(proj, [ExceptionDiscipline(), LockDiscipline()])
+    assert [(f.analyzer, f.rule) for f in fs] == [("core", "parse-error")]
+
+
+# ---------------------------------------------------------------------------
+# runner / CLI / shims against the real repo
+# ---------------------------------------------------------------------------
+
+def test_lint_all_repo_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint_all.py"),
+         REPO],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "analyzer(s) clean" in proc.stdout
+
+
+def test_lint_all_github_format(tmp_path):
+    make_project(tmp_path, {"horovod_tpu/m.py": """\
+        def f(x):
+            assert x
+    """})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint_all.py"),
+         str(tmp_path), "--format=github",
+         "--only=exception-discipline"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert proc.stdout.startswith(
+        "::error file=horovod_tpu/m.py,line=2,"
+        "title=exception-discipline/bare-assert::")
+
+
+def test_lint_all_unknown_analyzer():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint_all.py"),
+         REPO, "--only=nope"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "unknown analyzer" in proc.stderr
+
+
+def test_lint_all_list():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint_all.py"),
+         "--list"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    for a in hvdlint.ALL:
+        assert a.name in proc.stdout
+
+
+def test_no_jax_import_in_lint_machinery():
+    """The whole suite must run on a machine without jax."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, 'scripts'); "
+         "sys.modules['jax'] = None; "  # any `import jax` now explodes
+         "import lint_all; sys.exit(lint_all.main(['.']))"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
