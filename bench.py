@@ -255,8 +255,20 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
     # (one allreduce of the whole tree, then one global opt.update) for
     # before/after comparison.
     legacy = os.environ.get("HOROVOD_BENCH_LEGACY_PIPELINE") == "1"
-    pipeline = "legacy" if (legacy or not distributed) else "overlap"
-    if pipeline == "overlap":
+    sharded = os.environ.get("HOROVOD_SHARD_OPTIMIZER") == "1"
+    if legacy or not distributed:
+        pipeline = "legacy"
+    elif sharded:
+        pipeline = "sharded"
+    else:
+        pipeline = "overlap"
+    if pipeline == "sharded":
+        # ZeRO-1: reduce-scatter the bucketed grads, update the local
+        # optimizer-state shard, allgather params (docs/SHARDED_OPTIMIZER.md).
+        opt = hvd.DistributedOptimizer(base_opt, shard_optimizer_states=True)
+        step_fn = build_step(opt, v["config"], distributed=True,
+                             reduce_grads_in_step=False)
+    elif pipeline == "overlap":
         opt = hvd.DistributedOptimizer(base_opt, fused_apply=True)
         step_fn = build_step(opt, v["config"], distributed=True,
                              reduce_grads_in_step=False)
@@ -265,6 +277,9 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
         step_fn = build_step(opt, v["config"], distributed=distributed)
     state = {"params": v["params"], "batch_stats": v["batch_stats"]}
     opt_state = opt.init(state["params"])
+    # Per-chip resident inner optimizer-state bytes — the ZeRO-1
+    # denominator (shrinks ~n_devices-fold under the sharded pipeline).
+    opt_state_bytes = hvd.optimizer_state_bytes(opt_state)
     x = jax.random.normal(jax.random.PRNGKey(1), (batch, 32, 32, 3),
                           jnp.float32)
     y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 100)
@@ -277,15 +292,29 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
     t, _, _ = time_steps(step, state, opt_state, sb, warmup=2, iters=iters)
     print(json.dumps({"n": n_devices, "step_time_s": t,
                       "pipeline": pipeline,
+                      "opt_state_bytes": opt_state_bytes,
                       "per_chip_img_sec": batch / t / n_devices}))
 
 
-def _run_sim(n: int, distributed: bool, timeout: float,
-             legacy: bool = False):
+# Side channel: the full JSON record of the most recent sim child, so
+# callers that go through the `_run_sim` timing seam (the function the
+# stats tests monkeypatch) can still read non-timing fields like
+# opt_state_bytes.  None when the last probe failed or was stubbed out.
+_LAST_SIM_RECORD = None
+
+
+def _run_sim_record(n: int, distributed: bool, timeout: float,
+                    legacy: bool = False, sharded: bool = False):
+    """Run one sim child; return its full JSON record (or None)."""
+    global _LAST_SIM_RECORD
+    _LAST_SIM_RECORD = None
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    env.pop("HOROVOD_SHARD_OPTIMIZER", None)
     if legacy:
         env["HOROVOD_BENCH_LEGACY_PIPELINE"] = "1"
+    if sharded:
+        env["HOROVOD_SHARD_OPTIMIZER"] = "1"
     cmd = [sys.executable, os.path.abspath(__file__), "--sim-child", str(n)]
     if not distributed:
         cmd.append("--no-dist")
@@ -300,7 +329,16 @@ def _run_sim(n: int, distributed: bool, timeout: float,
         log(f"sim-scaling child n={n} rc={r.returncode} "
             f"stderr tail: {r.stderr[-500:]}")
         return None
-    return json.loads(r.stdout.strip().splitlines()[-1])["step_time_s"]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    _LAST_SIM_RECORD = rec
+    return rec
+
+
+def _run_sim(n: int, distributed: bool, timeout: float,
+             legacy: bool = False, sharded: bool = False):
+    rec = _run_sim_record(n, distributed, timeout, legacy=legacy,
+                          sharded=sharded)
+    return None if rec is None else rec["step_time_s"]
 
 
 def sim_scaling_efficiency(timeout: float = 600.0,
@@ -338,6 +376,7 @@ def sim_scaling_efficiency(timeout: float = 600.0,
     before/after comparison of how much per-step time the collectives
     cost under each.
     """
+    global _LAST_SIM_RECORD
     import numpy as _np
 
     if runs is None:
@@ -345,6 +384,7 @@ def sim_scaling_efficiency(timeout: float = 600.0,
     max_runs = max(runs,
                    int(os.environ.get("HOROVOD_BENCH_SIM_MAX_RUNS", "9")))
     effs, t1s, t8s = [], [], []
+    opt_bytes_repl = None
     rejected = 0
     attempts, max_attempts = 0, 2 * max_runs + 4
     while len(effs) < runs and attempts < max_attempts:
@@ -357,11 +397,15 @@ def sim_scaling_efficiency(timeout: float = 600.0,
             log(f"sim-scaling attempt {attempts}: n=1 child failed, "
                 f"retrying")
             continue
+        _LAST_SIM_RECORD = None
         t8 = _run_sim(8, True, timeout)
         if t8 is None:
             log(f"sim-scaling attempt {attempts}: n=8 child failed, "
                 f"retrying")
             continue
+        if _LAST_SIM_RECORD is not None:
+            opt_bytes_repl = _LAST_SIM_RECORD.get("opt_state_bytes",
+                                                  opt_bytes_repl)
         eff = 8.0 * t1 / t8
         if eff > 1.0:
             # Superlinear scaling cannot happen on a shared-core mesh:
@@ -411,6 +455,29 @@ def sim_scaling_efficiency(timeout: float = 600.0,
                 f"({100 * legacy_share:.1f}%)")
             extras["t8_legacy_ms"] = round(t8_legacy * 1e3, 1)
             extras["collective_share_legacy"] = round(legacy_share, 4)
+        # ZeRO-1 pipeline: n=8 step with sharded optimizer state
+        # (reduce-scatter + local shard update + param allgather), plus
+        # the replicated-vs-sharded per-chip state-bytes comparison the
+        # memory claim rests on (docs/SHARDED_OPTIMIZER.md).
+        _LAST_SIM_RECORD = None
+        t8_sharded = _run_sim(8, True, timeout, sharded=True)
+        rec_sharded = _LAST_SIM_RECORD
+        if t8_sharded is not None:
+            sharded_share = (t8_sharded - t8_nodist) / t8_sharded
+            log(f"sim-scaling n=8 sharded pipeline: {t8_sharded*1e3:.1f} "
+                f"ms/step -> collective share "
+                f"{(t8_sharded - t8_nodist)*1e3:.1f} ms/step "
+                f"({100 * sharded_share:.1f}%)")
+            extras["t8_sharded_ms"] = round(t8_sharded * 1e3, 1)
+            extras["collective_share_sharded"] = round(sharded_share, 4)
+            sb = (rec_sharded.get("opt_state_bytes")
+                  if rec_sharded is not None else None)
+            rb = opt_bytes_repl
+            if sb and rb:
+                log(f"sim-scaling opt-state bytes/chip: replicated {rb} "
+                    f"-> sharded {sb} ({rb / sb:.1f}x smaller)")
+                extras["opt_state_bytes_replicated"] = int(rb)
+                extras["opt_state_bytes_sharded"] = int(sb)
 
     def _trimmed_median(vals):
         s = _np.sort(_np.asarray(vals))

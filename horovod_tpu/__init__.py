@@ -128,6 +128,8 @@ from .ops.functions import (  # noqa: F401
 from .parallel.optimizer import (  # noqa: F401
     DistributedOptimizer,
     DistributedGradientTransformation,
+    optimizer_state_bytes,
+    sharded_state_specs,
 )
 
 from .parallel.data_parallel import (  # noqa: F401
@@ -167,8 +169,10 @@ def autotune_record_step(items: float = 1.0) -> None:
 
 from .parallel.hierarchical import (  # noqa: F401
     dcn_shard_size,
+    hierarchical_all_gather,
     hierarchical_allreduce,
     hierarchical_error_feedback_init,
+    hierarchical_reduce_scatter,
 )
 
 from . import callbacks  # noqa: F401

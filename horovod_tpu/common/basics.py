@@ -210,6 +210,17 @@ def init(
                 if process_id is not None
                 else util.env_int("PROCESS_ID", 0)
             )
+            # Cross-process computations on the CPU backend need an
+            # explicit collectives implementation (newer jaxlib builds
+            # default to none and raise "Multiprocess computations
+            # aren't implemented on the CPU backend").  Must land before
+            # the first backend client is created; harmless on TPU.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            # lint: allow-swallow(older jax: knob absent)
+            except Exception:  # noqa: BLE001
+                pass
             global _jax_distributed_active
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

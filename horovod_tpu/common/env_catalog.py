@@ -227,6 +227,9 @@ CATALOG: Tuple[EnvVar, ...] = (
     _v("HOROVOD_BUCKET_ORDER", "reverse", "autotune",
        "Gradient bucketing order: reverse (availability order), "
        "forward, or a comma permutation.", "AUTOTUNE.md"),
+    _v("HOROVOD_SHARD_AG_FUSION", "0", "autotune",
+       "1 fuses the sharded-optimizer param allgathers into one "
+       "collective (0 overlaps per-group gathers).", "AUTOTUNE.md"),
 
     # -- collectives / ops ----------------------------------------------
     _v("HOROVOD_HIERARCHICAL_ALLREDUCE", "0", "ops",
@@ -236,6 +239,14 @@ CATALOG: Tuple[EnvVar, ...] = (
     _v("HOROVOD_HIERARCHICAL_DCN_WIRE", "(exact)", "ops",
        "Wire format of the DCN leg of hierarchical allreduce: exact, "
        "fp16 or int8 (quantized-wire trade-off).", "PERF_NOTES.md"),
+    _v("HOROVOD_SHARD_OPTIMIZER", "0", "ops",
+       "1 enables the ZeRO-1 sharded-optimizer path: reduce-scatter "
+       "gradients, shard-local optax update, param allgather.",
+       "SHARDED_OPTIMIZER.md"),
+    _v("HOROVOD_SHARD_AG_WIRE", "(exact)", "ops",
+       "Low-precision wire of the sharded param allgather: exact, "
+       "bf16 or fp16 (fp32 masters stay exact on the owner).",
+       "SHARDED_OPTIMIZER.md"),
     _v("HOROVOD_COLLECTIVE_CONSISTENCY_CHECK", "0", "ops",
        "1 enables the cross-rank shape/dtype/generation consistency "
        "guard around collectives.", "FAULT_TOLERANCE.md"),

@@ -68,6 +68,19 @@ bucket_bytes = _REG.gauge(
 optimizer_syncs = _REG.counter(
     "hvd_optimizer_syncs_total",
     "DistributedOptimizer cross-rank gradient syncs executed eagerly.")
+opt_state_bytes = _REG.gauge(
+    "hvd_opt_state_bytes",
+    "Per-chip resident inner optimizer-state bytes (recorded at init; "
+    "sharded states count their 1/N shard — the ZeRO-1 denominator).")
+rs_bytes = _REG.gauge(
+    "hvd_rs_bytes",
+    "Static bytes entering the sharded-optimizer gradient reduce-"
+    "scatter per step, at wire width (trace time; multiply by "
+    "hvd_steps_total).")
+param_ag_bytes = _REG.gauge(
+    "hvd_param_ag_bytes",
+    "Static bytes entering the sharded-optimizer param allgather per "
+    "step, at wire width (trace time; multiply by hvd_steps_total).")
 
 # -- observability / control plane ------------------------------------------
 stall_warnings = _REG.counter(

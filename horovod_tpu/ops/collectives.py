@@ -1106,7 +1106,15 @@ def reducescatter(
 ):
     """Reduce across ranks, scatter result slices (reference: upstream
     reducescatter support; on TPU this is `lax.psum_scatter`).
-    Supports Sum and Average, as the reference does."""
+    Supports Sum and Average, as the reference does.
+
+    Eager dim0 need not be divisible by the set size: the input is
+    zero-padded to the next multiple in-graph and each rank receives its
+    `ceil(dim0/n)`-row slice with the padding removed, so trailing ranks
+    may receive fewer (possibly zero) rows — matching the reference
+    semantics where reducescatter distributes whatever rows exist.  The
+    in-jit path keeps the divisibility requirement because SPMD output
+    shapes must be uniform across ranks."""
     if op not in (Sum, Average):
         raise HorovodTpuError(
             f"reducescatter supports Sum and Average, got {op}"
@@ -1126,10 +1134,16 @@ def reducescatter(
     n = ps.size()
     contribs = _local_contributions(tensor, ps)
     d0 = contribs[0].shape[0]
-    if d0 % n != 0:
-        raise HorovodTpuError(
-            f"reducescatter requires dim0 ({d0}) divisible by set size ({n})"
-        )
+    chunk = -(-d0 // n) if d0 else 0
+    pad = n * chunk - d0
+    if pad:
+        contribs = [
+            jnp.concatenate(
+                [jnp.asarray(c),
+                 jnp.zeros((pad,) + tuple(jnp.shape(c)[1:]),
+                           jnp.result_type(c))])
+            for c in contribs
+        ]
     with _joinable("reducescatter", [contribs[0]], op=op, process_set=ps):
         xs, _ = _make_global(PerRank(contribs), ps)
         if _join.armed():
@@ -1177,13 +1191,141 @@ def reducescatter(
                 out = tr.track(program(xs))
     local = [r for r in basics.local_device_ranks() if r in ps.ranks]
     rows = _local_rows(out, ps, local)
+    if pad:
+        rows = [row[: max(0, min(d0 - ps.ranks.index(r) * chunk, chunk))]
+                for r, row in zip(local, rows)]
     if isinstance(tensor, PerRank):
         return PerRank(rows)
     return rows[0]
 
 
-def grouped_reducescatter(tensors, op: ReduceOp = Average, **kw):
-    return [reducescatter(t, op=op, **kw) for t in tensors]
+def grouped_reducescatter(
+    tensors,
+    op: ReduceOp = Average,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+    axis_name: Optional[str] = None,
+):
+    """Fused reduce-scatter of a tensor group: one collective per dtype
+    bucket instead of one dispatch per tensor (the fusion-buffer
+    pack/unpack mirrors `grouped_allreduce` — each tensor is reshaped to
+    (n, rows_per_rank * rest) and the buffers are concatenated along the
+    per-rank axis, so a single scatter delivers every tensor's slice).
+
+    Eager inputs follow `reducescatter`'s padding contract: dim0 is
+    zero-padded to the next multiple of the set size and each rank's
+    output is sliced back, so trailing ranks may receive fewer rows.
+    The in-jit path requires divisibility (uniform SPMD shapes)."""
+    if op not in (Sum, Average):
+        raise HorovodTpuError(
+            f"reducescatter supports Sum and Average, got {op}"
+        )
+    if not tensors:
+        return []
+
+    if any(_is_tracer(t) for t in tensors):
+        ax = axis_name or GLOBAL_AXIS
+        groups = _tracer_set_groups("reducescatter", process_set, ax)
+        n = (len(groups[0]) if groups is not None else lax.axis_size(ax))
+        out: List[Any] = [None] * len(tensors)
+        by_dtype: Dict[Any, List[int]] = {}
+        for i, t in enumerate(tensors):
+            shape = jnp.shape(t)
+            if not shape or shape[0] % n:
+                raise HorovodTpuError(
+                    f"in-jit grouped_reducescatter requires dim0 divisible "
+                    f"by set size ({n}); got shape {shape} (the eager path "
+                    "pads transparently)")
+            by_dtype.setdefault(jnp.result_type(t), []).append(i)
+        for dt, idxs in by_dtype.items():
+            shapes = [jnp.shape(tensors[i]) for i in idxs]
+            rests = [int(np.prod(s[1:])) if len(s) > 1 else 1
+                     for s in shapes]
+            widths = [(s[0] // n) * r for s, r in zip(shapes, rests)]
+            buf = jnp.concatenate(
+                [jnp.reshape(tensors[i].astype(dt), (n, w))
+                 for i, w in zip(idxs, widths)], axis=1)
+            red = lax.psum_scatter(jnp.ravel(buf), ax, tiled=True,
+                                   axis_index_groups=groups)
+            if op is Average:
+                red = (red / n).astype(dt)
+            offset = 0
+            for i, s, w in zip(idxs, shapes, widths):
+                out[i] = red[offset: offset + w].reshape(
+                    (s[0] // n,) + tuple(s[1:]))
+                offset += w
+        return out
+
+    ps = _resolve_set(process_set)
+    n = ps.size()
+    if _join.armed():
+        # The masked (join-aware) reduce stays per-tensor: reducescatter
+        # already builds the masked program, and fusing under join would
+        # nest _joinable brackets.
+        return [reducescatter(t, op=op, name=name, process_set=ps)
+                for t in tensors]
+    contribs = [_local_contributions(t, ps) for t in tensors]
+    n_local = len(contribs[0])
+    local = [r for r in basics.local_device_ranks() if r in ps.ranks]
+    by_dtype: Dict[Any, List[int]] = {}
+    for i, c in enumerate(contribs):
+        by_dtype.setdefault(jnp.result_type(c[0]), []).append(i)
+    out = [None] * len(tensors)
+    with _joinable("grouped_reducescatter", tensors, op=op, process_set=ps):
+        for dt, idxs in by_dtype.items():
+            shapes = [tuple(jnp.shape(contribs[i][0])) for i in idxs]
+            d0s = [s[0] for s in shapes]
+            chunks = [-(-d0 // n) if d0 else 0 for d0 in d0s]
+            rests = [int(np.prod(s[1:])) if len(s) > 1 else 1
+                     for s in shapes]
+            widths = [c * r for c, r in zip(chunks, rests)]
+
+            def _pack(x, d0, c, rest_shape):
+                x = jnp.asarray(x).astype(dt)
+                padr = n * c - d0
+                if padr:
+                    x = jnp.concatenate(
+                        [x, jnp.zeros((padr,) + tuple(rest_shape), dt)])
+                return x.reshape(n, -1)
+
+            fused_per_rank = [
+                jnp.concatenate(
+                    [_pack(contribs[i][r], d0s[j], chunks[j], shapes[j][1:])
+                     for j, i in enumerate(idxs)], axis=1)
+                for r in range(n_local)
+            ]
+            with _traced("REDUCESCATTER", name) as tr:
+                xs, _ = _make_global(PerRank(fused_per_rank), ps)
+                tr.stat(arr=xs, dtype=dt, process_set=ps)
+
+                def build():
+                    def fn(x):
+                        return (jnp.sum(x, axis=0) if op is Sum
+                                else jnp.mean(x, axis=0))
+
+                    return jax.jit(
+                        fn,
+                        in_shardings=(_rank_sharded(ps),),
+                        out_shardings=_rank_sharded(ps),
+                    )
+
+                program = _cached_program(
+                    ("grouped_reducescatter", ps.process_set_id, op.name),
+                    build)
+                res = tr.track(program(xs))
+            rows = _local_rows(res, ps, local)
+            for j, i in enumerate(idxs):
+                off = sum(widths[:j])
+                pieces = []
+                for r, row in zip(local, rows):
+                    pos = ps.ranks.index(r)
+                    keep = max(0, min(d0s[j] - pos * chunks[j], chunks[j]))
+                    piece = row[off: off + widths[j]].reshape(
+                        (chunks[j],) + tuple(shapes[j][1:]))[:keep]
+                    pieces.append(piece)
+                out[i] = (PerRank(pieces)
+                          if isinstance(tensors[i], PerRank) else pieces[0])
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -16,8 +16,10 @@ from .mesh import (  # noqa: F401
 )
 from .hierarchical import (  # noqa: F401
     dcn_shard_size,
+    hierarchical_all_gather,
     hierarchical_allreduce,
     hierarchical_error_feedback_init,
+    hierarchical_reduce_scatter,
 )
 from .sequence import (  # noqa: F401
     dense_attention_oracle,

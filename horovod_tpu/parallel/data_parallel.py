@@ -415,6 +415,8 @@ def data_parallel(
     batch_args: Sequence[int] = (2,),
     donate_args: Sequence[int] = (0, 1),
     static_args: Sequence[int] = (),
+    arg_specs: Optional[dict] = None,
+    out_specs: Any = None,
 ):
     """Compile a per-rank `step_fn(params, opt_state, batch, ...)` into one
     SPMD program over the mesh.
@@ -422,6 +424,14 @@ def data_parallel(
     - positional args in `batch_args` are sharded on dim 0 over `axis_name`
     - everything else is replicated
     - args in `donate_args` are donated (weights update in-place in HBM)
+    - `arg_specs` maps an arg position to an explicit PartitionSpec pytree
+      (structure matching that argument), overriding the batch/replicated
+      default — e.g. `{1: hvd.sharded_state_specs(opt_state)}` places a
+      ZeRO-1 optimizer state's (n_ranks, shard) rows on their owner
+      ranks instead of replicating them (docs/SHARDED_OPTIMIZER.md)
+    - `out_specs` is the shard_map out_specs pytree (default P(),
+      fully replicated outputs); pass the matching spec tree when the
+      step returns mesh-sharded state
 
     Inside `step_fn`, cross-rank reduction is explicit —
     `hvd.allreduce(grads)` / `DistributedOptimizer` — mirroring the
@@ -429,18 +439,22 @@ def data_parallel(
     overlaps it with backward compute.
     """
     mesh = mesh or basics.global_mesh()
+    arg_specs = dict(arg_specs or {})
+    out_spec = P() if out_specs is None else out_specs
+
+    def _spec_for(i):
+        if i in arg_specs:
+            return arg_specs[i]
+        return P(axis_name) if i in batch_args else P()
 
     if static_args:
         # Static args preclude per-arg in_shardings; legacy wrapper path.
         def wrapper(*args):
             n_args = len(args)
-            in_specs = tuple(
-                P(axis_name) if i in batch_args else P()
-                for i in range(n_args)
-            )
+            in_specs = tuple(_spec_for(i) for i in range(n_args))
             sm = shard_map(
                 step_fn, mesh=mesh, in_specs=in_specs,
-                out_specs=P(), check_vma=False,
+                out_specs=out_spec, check_vma=False,
             )
             return sm(*args)
 
@@ -500,17 +514,15 @@ def data_parallel(
         key = (n_args, _autotune_key())
         entry = compiled_cache.get(key)
         if entry is None:
-            in_specs = tuple(
-                P(axis_name) if i in batch_args else P()
-                for i in range(n_args)
-            )
+            in_specs = tuple(_spec_for(i) for i in range(n_args))
             sm = shard_map(
                 step_fn, mesh=mesh, in_specs=in_specs,
-                out_specs=P(), check_vma=False,
+                out_specs=out_spec, check_vma=False,
             )
             in_shardings = tuple(
-                NamedSharding(mesh, P(axis_name)) if i in batch_args
-                else NamedSharding(mesh, P())
+                jax.tree_util.tree_map(
+                    lambda p: NamedSharding(mesh, p), _spec_for(i),
+                    is_leaf=lambda x: isinstance(x, P))
                 for i in range(n_args)
             )
             fn = jax.jit(
@@ -527,7 +539,11 @@ def data_parallel(
             compiled_cache[key] = entry
         fn, in_shardings = entry
         args = tuple(
-            jax.tree_util.tree_map(lambda x, s=s: _coerce(x, s), a)
+            (jax.tree_util.tree_map(lambda x, s=s: _coerce(x, s), a)
+             if isinstance(s, NamedSharding)
+             # arg_specs entry: a sharding tree mirroring the arg's own
+             # structure, so pair the two trees leaf-by-leaf.
+             else jax.tree_util.tree_map(_coerce, a, s))
             for a, s in zip(args, in_shardings)
         )
         out = fn(*args)

@@ -102,6 +102,69 @@ def hierarchical_reduce_leaf(x, dcn_axis: str, ici_axis: str, average: bool,
     return out
 
 
+_CAST_WIRES = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
+
+
+def _cast_wire_dtype(wire: str):
+    """bf16/fp16 cast wires only: the scatter/gather pair reduces in the
+    wire dtype directly, so the 1-byte cooperative formats (int8/fp8 —
+    which need f32 accumulation per hop) cannot ride it."""
+    try:
+        return _CAST_WIRES[wire]
+    except KeyError:
+        raise HorovodTpuError(
+            f"unsupported scatter/gather wire {wire!r}: quantized wires "
+            "(int8/fp8) ride the ring allreduce, not the reduce-scatter/"
+            "allgather pair; use 'bf16' or 'fp16'") from None
+
+
+def hierarchical_reduce_scatter(flat, dcn_axis: str, ici_axis: str,
+                                dcn_wire: Optional[str] = None):
+    """Two-level reduce-scatter of a FLAT buffer (Sum semantics): ICI
+    psum-scatter first — the full payload rides the fast tier — then a
+    DCN psum-scatter of the 1/n_ici shard, optionally cast to a
+    low-precision wire ("bf16" | "fp16") for the slow hop only.  Each
+    element crosses DCN once, at 1/n_ici of the flat-ring volume and at
+    wire width when `dcn_wire` is set (the ICI legs stay exact).
+
+    Ownership is DCN-MAJOR: the rank at (dcn=d, ici=i) returns flat
+    segment `d*n_ici + i` — the same enumeration
+    `hierarchical_all_gather` (ICI gather then DCN gather) reassembles.
+    `flat.size` must be divisible by n_ici*n_dcn; callers pad."""
+    n_ici = lax.axis_size(ici_axis)
+    n_dcn = lax.axis_size(dcn_axis)
+    total = n_ici * n_dcn
+    if flat.ndim != 1 or flat.size % total:
+        raise HorovodTpuError(
+            f"hierarchical_reduce_scatter needs a flat buffer divisible "
+            f"by n_ici*n_dcn ({total}); got shape {jnp.shape(flat)}")
+    seg = flat.size // total
+    # Pre-permute so the ici-then-dcn scatter lands flat segment
+    # d*n_ici+i on rank (dcn=d, ici=i): the ICI scatter hands rank i the
+    # i-th (n_dcn*seg)-block, which must hold segments {d*n_ici+i}_d.
+    f2 = flat.reshape(n_dcn, n_ici, seg).swapaxes(0, 1).reshape(-1)
+    a = lax.psum_scatter(f2, ici_axis, tiled=True)
+    if dcn_wire:
+        wt = _cast_wire_dtype(dcn_wire)
+        a = lax.psum_scatter(a.astype(wt), dcn_axis,
+                             tiled=True).astype(flat.dtype)
+    else:
+        a = lax.psum_scatter(a, dcn_axis, tiled=True)
+    return a
+
+
+def hierarchical_all_gather(shard, dcn_axis: str, ici_axis: str):
+    """Inverse of `hierarchical_reduce_scatter`: gather within the slice
+    first (ICI, fast tier — reassembling the slice's contiguous flat
+    block under dcn-major ownership), then across slices over DCN.
+    Dtype is preserved; callers wanting a low-precision wire cast the
+    shard BEFORE gathering (a per-leg cast would hand each slice an
+    exact copy of its own block but wire-cast copies of the others,
+    silently de-replicating the result across slices)."""
+    g = lax.all_gather(shard, ici_axis, tiled=True)
+    return lax.all_gather(g, dcn_axis, tiled=True)
+
+
 def dcn_shard_size(size: int, n_ici: int) -> int:
     """Elements of one rank's DCN shard for a leaf of `size` elements —
     the shape of the `error_feedback` residual a caller must carry."""
@@ -274,8 +337,10 @@ def maybe_hierarchical(x, axes, op_name: str):
 __all__ = [
     "dcn_shard_size",
     "enabled",
+    "hierarchical_all_gather",
     "hierarchical_allreduce",
     "hierarchical_error_feedback_init",
     "hierarchical_reduce_leaf",
+    "hierarchical_reduce_scatter",
     "maybe_hierarchical",
 ]

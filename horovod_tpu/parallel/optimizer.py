@@ -21,19 +21,91 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
+from jax.sharding import PartitionSpec
 
-from ..common.basics import ProcessSet
+from ..common import basics, util
+from ..common.basics import GLOBAL_AXIS, ProcessSet
+from ..common.exceptions import HorovodTpuError
 from ..metrics import catalog as _met
 from ..ops import collectives as C
-from ..ops.compression import Compression
+from ..ops.compression import Compression, _CooperativeCompressor
+from . import hierarchical as _hier
 from .data_parallel import (allreduce_gradients, gradient_bucket_partition,
                             reduce_gradient_buckets)
 
+# Wire dtypes accepted on the sharded param allgather (cast wires only:
+# the 1-byte cooperative formats need f32 ring accumulation and have no
+# scatter/gather form).
+SHARD_WIRES = ("bf16", "fp16")
+
 
 class DistributedOptState(NamedTuple):
-    inner: Any          # inner optax state; per-bucket tuple when fused
+    inner: Any          # inner optax state; per-bucket/-shard tuple when
+    #                     fused_apply / shard_optimizer_states
     accum: Any          # local gradient accumulator
     counter: jnp.ndarray  # passes since last sync
+
+
+class _ShardSlot(NamedTuple):
+    """One shard group's optimizer state under shard_optimizer_states:
+    `state` holds the inner optax state with every array leaf stacked
+    (n_ranks, ...) over the rank axis (scalars become (n_ranks,)), and
+    `master` the fp32 master param rows (n_ranks, shard) — present only
+    with a low-precision `allgather_wire`, where the owner rank's exact
+    copy must survive the wire round-trip."""
+    state: Any
+    master: Any
+
+
+def _wire_name(compression) -> Optional[str]:
+    """Cast-compressor → scatter wire name ("fp16"/"bf16"); None for
+    Compression.none.  Cooperative compressors are rejected before this
+    is consulted."""
+    wd = getattr(compression, "wire_dtype", None)
+    if wd is jnp.float16:
+        return "fp16"
+    if wd is jnp.bfloat16:
+        return "bf16"
+    return None
+
+
+def optimizer_state_bytes(state) -> int:
+    """Per-chip resident bytes of the INNER optimizer state (the ZeRO-1
+    denominator; the gradient accumulator/counter are excluded).  For a
+    `shard_optimizer_states=True` state the stacked (n_ranks, shard)
+    leaves count at 1/n_ranks — each rank materializes only its own row
+    once placed with `sharded_state_specs`.  A plain (non-Distributed)
+    optax state counts all its leaves, so replicated-vs-sharded per-chip
+    footprints compare directly."""
+    inner = getattr(state, "inner", state)
+    slots = inner if isinstance(inner, tuple) else (inner,)
+    total = 0
+    for slot in slots:
+        sharded = isinstance(slot, _ShardSlot)
+        for leaf in jax.tree_util.tree_leaves(slot):
+            leaf = jnp.asarray(leaf)
+            nbytes = leaf.size * leaf.dtype.itemsize
+            if sharded:
+                lead = leaf.shape[0] if leaf.ndim else 1
+                nbytes //= max(1, lead)
+            total += nbytes
+    return int(total)
+
+
+def sharded_state_specs(state: DistributedOptState, axis_name=GLOBAL_AXIS):
+    """PartitionSpec pytree for a `shard_optimizer_states=True` state:
+    P(axis) on every stacked (n_ranks, ...) inner/master leaf, replicated
+    accumulator/counter.  Feed to `data_parallel(arg_specs={i: specs},
+    out_specs=(..., specs, ...))` so each rank materializes only its own
+    state row (true ZeRO-1 placement).  Without it the stacked state
+    stays replicated — numerics identical, HBM savings deferred."""
+    axis = tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
+        else axis_name
+    inner = jax.tree_util.tree_map(
+        lambda _: PartitionSpec(axis), state.inner)
+    accum = jax.tree_util.tree_map(lambda _: PartitionSpec(), state.accum)
+    return DistributedOptState(inner, accum, PartitionSpec())
 
 
 def DistributedGradientTransformation(
@@ -48,6 +120,8 @@ def DistributedGradientTransformation(
     bucket_order=None,
     fused_apply: bool = False,
     early_reduction: bool = False,
+    shard_optimizer_states: Optional[bool] = None,
+    allgather_wire: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """Wrap `optimizer` so updates are computed from cross-rank-reduced
     gradients.  See module docstring for the reference mapping.
@@ -71,7 +145,28 @@ def DistributedGradientTransformation(
     reduced values, applying without a further sync on the Nth pass.
     Numerically identical by linearity of the reduction (bitwise for
     exactly-representable addends); trades N-1 extra collectives for
-    overlap.  Incompatible with op=Adasum."""
+    overlap.  Incompatible with op=Adasum.
+
+    `shard_optimizer_states=True` (env: HOROVOD_SHARD_OPTIMIZER) is the
+    ZeRO-1 data path: an allreduce is algebraically a reduce-scatter +
+    allgather, so each bucket's gradients are reduce-SCATTERED (padded
+    flat buffer — dim0 divisibility never constrains layer shapes), the
+    optax update runs on this rank's 1/N shard only against per-shard
+    state initialized from the same `gradient_bucket_partition`, and the
+    updated params are allgathered back.  Optimizer-state HBM and update
+    FLOPs drop ~1/N per chip once the state is placed with
+    `sharded_state_specs` (see docs/SHARDED_OPTIMIZER.md).  In-jit only;
+    loud re-init on partition drift exactly like `fused_apply` (and
+    mutually exclusive with it); incompatible with op=Adasum.  With a
+    2-tuple `axis_name` ("dcn", ici) the reduce-scatter runs two-level
+    (ICI psum-scatter + DCN hop at the compression wire width).
+
+    `allgather_wire` ("bf16" | "fp16", env: HOROVOD_SHARD_AG_WIRE)
+    casts the param allgather to a low-precision wire while fp32 master
+    shards stay exact on their owner rank: the inner state and masters
+    live in f32, each step allgathers wire(new_master) and reconstructs
+    the update as wire(new_master) - param, so wire error never
+    accumulates (the master is the integration variable)."""
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
     if op is C.Adasum and (fused_apply or early_reduction):
@@ -79,6 +174,42 @@ def DistributedGradientTransformation(
             "fused_apply / early_reduction are incompatible with "
             "op=Adasum: Adasum combines post-update deltas, so there is "
             "no per-bucket reduction result to consume early")
+    if shard_optimizer_states is None:
+        shard_optimizer_states = util.env_bool("SHARD_OPTIMIZER", False)
+    if allgather_wire is None:
+        allgather_wire = util.getenv("SHARD_AG_WIRE") or None
+    if shard_optimizer_states:
+        if op not in (C.Average, C.Sum):
+            raise ValueError(
+                f"shard_optimizer_states supports op=Average/Sum, got "
+                f"{op}: Adasum combines post-update deltas, which have "
+                "no reduce-scatter form")
+        if fused_apply:
+            raise ValueError(
+                "shard_optimizer_states and fused_apply are mutually "
+                "exclusive: both partition the inner optimizer state "
+                "by bucket — the sharded path already applies per "
+                "shard group")
+        if isinstance(compression, type) and issubclass(
+                compression, _CooperativeCompressor):
+            raise ValueError(
+                f"Compression.{compression.wire} has no reduce-scatter "
+                "form (1-byte wires need f32 ring accumulation per "
+                "hop); use Compression.fp16/bf16 with "
+                "shard_optimizer_states")
+        if allgather_wire not in (None,) + SHARD_WIRES:
+            raise ValueError(
+                f"allgather_wire must be one of {SHARD_WIRES}, got "
+                f"{allgather_wire!r}")
+        if process_set is not None and process_set.process_set_id != 0:
+            raise ValueError(
+                "shard_optimizer_states requires the global process "
+                "set: subset reduce-scatter would need group-aware "
+                "shard ownership")
+    elif allgather_wire is not None:
+        raise ValueError(
+            "allgather_wire requires shard_optimizer_states=True (it "
+            "is the wire of the sharded param allgather)")
 
     def reduce_grads(grads):
         return allreduce_gradients(
@@ -94,16 +225,269 @@ def DistributedGradientTransformation(
             fusion_threshold_bytes=fusion_threshold_bytes,
             bucket_order=bucket_order)
 
+    def _shard_groups(leaves):
+        # The reduction buckets split further by dtype (a flat shard
+        # buffer cannot mix dtypes).  init and update must agree on this
+        # grouping bit-for-bit, so both call here.
+        groups = []
+        for idxs in _partition(leaves):
+            by_dt = {}
+            for i in idxs:
+                by_dt.setdefault(jnp.result_type(leaves[i]), []).append(i)
+            groups.extend(by_dt.values())
+        return groups
+
+    def _world():
+        return (process_set.size() if process_set is not None
+                else basics.size())
+
+    def _group_flat(leaves, idxs, dt):
+        if len(idxs) == 1:
+            return jnp.ravel(leaves[idxs[0]]).astype(dt)
+        return jnp.concatenate(
+            [jnp.ravel(leaves[i]).astype(dt) for i in idxs])
+
     def init_fn(params):
-        if fused_apply:
+        if shard_optimizer_states:
+            leaves, _ = jax.tree_util.tree_flatten(params)
+            n = _world()
+            slots = []
+            for idxs in _shard_groups(leaves):
+                dt = jnp.result_type(leaves[idxs[0]])
+                flat = _group_flat(leaves, idxs, dt)
+                padn = (-flat.size) % n
+                if padn:
+                    flat = jnp.concatenate(
+                        [flat, jnp.zeros((padn,), dt)])
+                rows = flat.reshape(n, flat.size // n)
+                # With a low-precision allgather wire the state and
+                # masters live in f32 (the ZeRO master copy); otherwise
+                # the state matches the param dtype and no master is
+                # carried.
+                master = rows.astype(jnp.float32) if allgather_wire \
+                    else None
+                # vmap over the rank axis: every rank's shard state,
+                # stacked on dim 0 (scalars like adam's count become
+                # (n,)).  update slices its own row — or receives just
+                # it when placed via sharded_state_specs.
+                st = jax.vmap(optimizer.init)(
+                    master if allgather_wire else rows)
+                slots.append(_ShardSlot(st, master))
+            inner = tuple(slots)
+        elif fused_apply:
             leaves, _ = jax.tree_util.tree_flatten(params)
             inner = tuple(
                 optimizer.init([leaves[i] for i in idxs])
                 for idxs in _partition(leaves))
         else:
             inner = optimizer.init(params)
+        if _met.enabled():
+            # Static byte count (per-chip resident once placed); safe at
+            # trace time — cf. hvd_grad_bytes_per_step.
+            _met.opt_state_bytes.set(optimizer_state_bytes(
+                DistributedOptState(inner, None, None)))
         accum = jax.tree_util.tree_map(jnp.zeros_like, params)
         return DistributedOptState(inner, accum, jnp.zeros((), jnp.int32))
+
+    def _sharded_update(grads, state, params, pre_reduced):
+        from ..utils.autotune import current_ag_fusion
+
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        p_leaves = (jax.tree_util.tree_flatten(params)[0]
+                    if params is not None else None)
+        if allgather_wire and p_leaves is None:
+            raise ValueError(
+                "allgather_wire needs params: the update is "
+                "reconstructed as wire(new_master) - param")
+        if not any(isinstance(l, jax.core.Tracer) for l in leaves):
+            raise HorovodTpuError(
+                "shard_optimizer_states runs in-jit only (inside "
+                "hvd.data_parallel / shard_map with the mesh axis in "
+                "scope): the reduce-scatter/allgather pair needs "
+                "axis_name semantics")
+        groups = _shard_groups(leaves)
+        if len(groups) != len(state.inner):
+            raise ValueError(
+                f"shard_optimizer_states partition changed since init "
+                f"({len(state.inner)} -> {len(groups)} shard groups): "
+                "the fusion threshold / bucket order moved under the "
+                "state (autotuner proposal?) — re-init the optimizer "
+                "state after tunables change")
+        ax = axis_name or GLOBAL_AXIS
+        hier = isinstance(ax, (tuple, list)) and len(ax) == 2
+        if hier:
+            dcn_ax, ici_ax = ax
+            n_ici = lax.axis_size(ici_ax)
+            n_now = lax.axis_size(dcn_ax) * n_ici
+            # dcn-major linear rank: matches both the scatter ownership
+            # of hierarchical_reduce_scatter and the stacking order of
+            # all_gather over the (dcn, ici) axis pair.
+            idx = lax.axis_index(dcn_ax) * n_ici + lax.axis_index(ici_ax)
+            gather_axes = (dcn_ax, ici_ax)
+        else:
+            n_now = lax.axis_size(ax)
+            idx = lax.axis_index(ax)
+            gather_axes = ax
+        rs_wire = _wire_name(compression)
+        ag_wt = _hier._CAST_WIRES[allgather_wire] if allgather_wire \
+            else None
+        fuse_ag = bool(current_ag_fusion())
+        out = [None] * len(leaves)
+        new_inner = [None] * len(groups)
+        rs_bytes = 0
+        ag_bytes = 0
+        pending = []  # deferred (send_shard, finish) under fused allgather
+
+        for gi, (idxs, slot) in enumerate(zip(groups, state.inner)):
+            if not isinstance(slot, _ShardSlot):
+                raise ValueError(
+                    "optimizer state was not built with "
+                    "shard_optimizer_states=True — re-init the "
+                    "optimizer state")
+            dt = jnp.result_type(leaves[idxs[0]])
+            shapes = [jnp.shape(leaves[i]) for i in idxs]
+            sizes = [leaves[i].size for i in idxs]
+            flat = _group_flat(leaves, idxs, dt)
+            padn = (-flat.size) % n_now
+            padded = flat.size + padn
+            shard_sz = padded // n_now
+            s_leaves = jax.tree_util.tree_leaves(slot)
+            lead = int(s_leaves[0].shape[0]) if s_leaves else 1
+            if lead not in (1, n_now):
+                raise ValueError(
+                    f"sharded optimizer state has leading dim {lead} "
+                    f"but the axis spans {n_now} ranks — world size "
+                    "changed since init; re-init the optimizer state")
+            for l in s_leaves:
+                if l.ndim >= 2 and l.shape[-1] != shard_sz:
+                    raise ValueError(
+                        f"sharded optimizer state shard size "
+                        f"{l.shape[-1]} != expected {shard_sz}: bucket "
+                        "contents moved under the state (autotuner "
+                        "proposal?) — re-init the optimizer state "
+                        "after tunables change")
+
+            def _row(t):
+                # lead==1: state arrived pre-placed (sharded_state_specs
+                # in_specs split the rank axis); lead==n: replicated
+                # compat mode, slice our row.
+                if lead == 1:
+                    return jax.tree_util.tree_map(lambda s: s[0], t)
+                return jax.tree_util.tree_map(
+                    lambda s: lax.dynamic_index_in_dim(
+                        s, idx, 0, keepdims=False), t)
+
+            def _restack(t):
+                if lead == 1:
+                    return jax.tree_util.tree_map(lambda s: s[None], t)
+                # Compat mode must hand back a rank-identical stacked
+                # state (out_specs P() asserts replication).
+                return jax.tree_util.tree_map(
+                    lambda s: lax.all_gather(s, gather_axes, tiled=False),
+                    t)
+
+            row_state = _row(slot.state)
+            if pre_reduced:
+                # early_reduction / megastep already allreduced: our
+                # shard is a plain slice, no collective here.
+                if padn:
+                    flat = jnp.concatenate([flat, jnp.zeros((padn,), dt)])
+                g_shard = lax.dynamic_slice(
+                    flat, (idx * shard_sz,), (shard_sz,))
+            elif hier:
+                if padn:
+                    flat = jnp.concatenate([flat, jnp.zeros((padn,), dt)])
+                g_shard = _hier.hierarchical_reduce_scatter(
+                    flat, dcn_ax, ici_ax, dcn_wire=rs_wire)
+                if op is C.Average:
+                    g_shard = (g_shard / n_now).astype(dt)
+                rs_bytes += padded * jnp.dtype(
+                    _hier._CAST_WIRES[rs_wire] if rs_wire else dt).itemsize
+            else:
+                c, ctx = compression.compress(flat)
+                if padn:
+                    c = jnp.concatenate([c, jnp.zeros((padn,), c.dtype)])
+                g_shard = lax.psum_scatter(c, ax, tiled=True)
+                if op is C.Average:
+                    # Divide in the wire dtype: elementwise identical to
+                    # the replicated path's lax.pmean on the same wire.
+                    g_shard = (g_shard / n_now).astype(g_shard.dtype)
+                g_shard = compression.decompress(g_shard, ctx)
+                rs_bytes += padded * jnp.dtype(c.dtype).itemsize
+
+            p_shard = None
+            if p_leaves is not None:
+                p_flat = _group_flat(p_leaves, idxs, dt)
+                if padn:
+                    p_flat = jnp.concatenate(
+                        [p_flat, jnp.zeros((padn,), dt)])
+                p_shard = lax.dynamic_slice(
+                    p_flat, (idx * shard_sz,), (shard_sz,))
+
+            if allgather_wire:
+                m_row = _row(slot.master)
+                u_shard, new_row_state = optimizer.update(
+                    g_shard.astype(jnp.float32), row_state, m_row)
+                new_m = m_row + u_shard  # exact f32 on the owner rank
+                send = new_m.astype(ag_wt)
+                new_master = _restack(new_m)
+
+                def _finish(full, idxs=idxs, sizes=sizes, shapes=shapes,
+                            dt=dt):
+                    off = 0
+                    for i, sz, shp in zip(idxs, sizes, shapes):
+                        seg = full[off: off + sz]
+                        off += sz
+                        out[i] = (seg.astype(dt).reshape(shp)
+                                  - p_leaves[i])
+            else:
+                u_shard, new_row_state = optimizer.update(
+                    g_shard, row_state, p_shard)
+                send = u_shard
+                new_master = None
+
+                def _finish(full, idxs=idxs, sizes=sizes, shapes=shapes):
+                    off = 0
+                    for i, sz, shp in zip(idxs, sizes, shapes):
+                        out[i] = full[off: off + sz].reshape(shp)
+                        off += sz
+
+            new_inner[gi] = _ShardSlot(_restack(new_row_state),
+                                       new_master)
+            ag_bytes += padded * jnp.dtype(send.dtype).itemsize
+            if fuse_ag:
+                pending.append((send, _finish))
+            elif hier:
+                _finish(_hier.hierarchical_all_gather(
+                    send, dcn_ax, ici_ax))
+            else:
+                _finish(lax.all_gather(send, ax, tiled=True))
+
+        if pending:
+            by_dt = {}
+            for send, fin in pending:
+                by_dt.setdefault(send.dtype, []).append((send, fin))
+            for _, items in by_dt.items():
+                cat = (jnp.concatenate([s for s, _ in items])
+                       if len(items) > 1 else items[0][0])
+                stacked = lax.all_gather(cat, gather_axes, tiled=False)
+                # stacked: (n_ranks, sum_of_shards); group g's full
+                # buffer is its column band flattened row-major.
+                off = 0
+                for send, fin in items:
+                    w = send.size
+                    fin(stacked[:, off: off + w].reshape(-1))
+                    off += w
+
+        if _met.enabled():
+            # Static wire sizes, recorded at trace time like
+            # hvd_grad_bytes_per_step (multiply by hvd_steps_total for
+            # cumulative traffic).
+            if not pre_reduced:
+                _met.rs_bytes.set(rs_bytes)
+            _met.param_ag_bytes.set(ag_bytes)
+        return (jax.tree_util.tree_unflatten(treedef, out),
+                tuple(new_inner))
 
     def _fused_update(grads, state, params, pre_reduced):
         leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -150,6 +534,9 @@ def DistributedGradientTransformation(
                                       process_set=process_set),
                 updates,
             )
+        elif shard_optimizer_states:
+            updates, inner = _sharded_update(grads, state, params,
+                                             pre_reduced)
         elif fused_apply:
             updates, inner = _fused_update(grads, state, params,
                                            pre_reduced)

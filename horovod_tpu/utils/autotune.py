@@ -332,6 +332,13 @@ def init_from_env() -> Optional[ParameterManager]:
                 initial=_BUCKET_ORDERS.index(_env_bucket_order()))
     pm.register("min_buckets", 1, 16, integer=True,
                 initial=util.env_int("MIN_BUCKETS", 1))
+    # Sharded-optimizer knob: fuse the per-shard-group param allgathers
+    # into one collective (1) or keep them per-group so each bucket's
+    # gather can overlap the next bucket's update (0, default).  Only
+    # consulted by shard_optimizer_states=True.
+    pm.register("ag_fusion", 0, 1, integer=True,
+                initial=1 if util.env_bool("SHARD_AG_FUSION", False)
+                else 0)
     _manager = pm
     logger.info("autotune enabled: %s", pm.values())
     return pm
@@ -382,6 +389,22 @@ def current_min_buckets() -> int:
     """The live minimum bucket count: HOROVOD_MIN_BUCKETS (1 = no
     floor), overridden by the autotuner when active."""
     return tuned_min_buckets(max(1, util.env_int("MIN_BUCKETS", 1)))
+
+
+def tuned_ag_fusion(default: bool) -> bool:
+    """Sharded-optimizer allgather fusion honoring the autotuner when
+    active (see DistributedGradientTransformation
+    shard_optimizer_states)."""
+    if _manager is not None and "ag_fusion" in _manager._tunables:
+        return bool(int(_manager.value("ag_fusion")))
+    return default
+
+
+def current_ag_fusion() -> bool:
+    """The live param-allgather fusion choice: HOROVOD_SHARD_AG_FUSION
+    (off by default — per-group gathers overlap better), overridden by
+    the autotuner when active."""
+    return tuned_ag_fusion(util.env_bool("SHARD_AG_FUSION", False))
 
 
 def tuned_fusion_threshold(default: int) -> int:

@@ -122,6 +122,15 @@ def early_reduction_body(grad_fn: Callable[[Any, Any], Any], k: int,
     integer-valued floats with k a power of two — tested), and holds to
     f32 tolerance otherwise.  Compose inside `hvd.data_parallel` /
     shard_map like the other megastep bodies.
+
+    Composes with the ZeRO-1 path (`DistributedOptimizer(...,
+    shard_optimizer_states=True, early_reduction=True)` or feeding this
+    body's output to such an optimizer as pre-reduced gradients): the
+    accumulator holds fully-reduced replicated values, so the sharded
+    update skips its reduce-scatter and each rank takes its shard as a
+    plain `dynamic_slice` — the slice of an allreduce equals the
+    reduce-scatter by the same linearity, preserving the bitwise
+    contract above (see docs/SHARDED_OPTIMIZER.md).
     """
     if not isinstance(k, int) or k < 1:
         raise HorovodTpuError(

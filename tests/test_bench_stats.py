@@ -14,16 +14,19 @@ import bench  # noqa: E402
 
 
 def _feed(monkeypatch, times):
-    """times: list of (t1, t8) per pair; the compute-only and legacy
-    pipeline probes of the extras block are fed the last pair's t8."""
+    """times: list of (t1, t8) per pair; the compute-only, legacy and
+    sharded pipeline probes of the extras block are fed the last pair's
+    t8."""
     seq = []
     for t1, t8 in times:
         seq += [t1, t8]
     seq.append(times[-1][1])     # the compute-only probe
     seq.append(times[-1][1])     # the legacy-pipeline probe
+    seq.append(times[-1][1])     # the sharded-pipeline probe
     it = iter(seq)
-    monkeypatch.setattr(bench, "_run_sim",
-                        lambda n, dist, timeout, legacy=False: next(it))
+    monkeypatch.setattr(
+        bench, "_run_sim",
+        lambda n, dist, timeout, legacy=False, sharded=False: next(it))
 
 
 class TestSimScalingStats:
@@ -42,6 +45,10 @@ class TestSimScalingStats:
         assert extras["t8_ms"] == pytest.approx(8800.0)
         assert extras["collective_share"] == pytest.approx(0.0)
         assert extras["collective_share_legacy"] == pytest.approx(0.0)
+        assert extras["collective_share_sharded"] == pytest.approx(0.0)
+        # Stubbed probes leave no child record, so the byte comparison
+        # is (correctly) absent rather than fabricated.
+        assert "opt_state_bytes_sharded" not in extras
 
     def test_pairs_above_one_rejected(self, monkeypatch):
         # Contention-inflated t1 pushes a pair above 1.0: superlinear
@@ -74,10 +81,11 @@ class TestSimScalingStats:
 
     def test_failed_pair_retried(self, monkeypatch):
         monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "3")
-        seq = [1.0, None, 1.0, 8.9, 1.0, 8.8, 1.0, 8.7, 8.5, 8.6]
+        seq = [1.0, None, 1.0, 8.9, 1.0, 8.8, 1.0, 8.7, 8.5, 8.6, 8.6]
         it = iter(seq)
-        monkeypatch.setattr(bench, "_run_sim",
-                            lambda n, dist, timeout, legacy=False: next(it))
+        monkeypatch.setattr(
+            bench, "_run_sim",
+            lambda n, dist, timeout, legacy=False, sharded=False: next(it))
         median, spread, effs, ci, rejected, extras = \
             bench.sim_scaling_efficiency(runs=3)
         assert len(effs) == 3   # the failed attempt was retried
@@ -101,6 +109,7 @@ class TestSimScalingStats:
         monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "3")
         seq = [1.5, 8.0] * 10 + [8.0]
         it = iter(seq)
-        monkeypatch.setattr(bench, "_run_sim",
-                            lambda n, dist, timeout, legacy=False: next(it))
+        monkeypatch.setattr(
+            bench, "_run_sim",
+            lambda n, dist, timeout, legacy=False, sharded=False: next(it))
         assert bench.sim_scaling_efficiency(runs=3) is None

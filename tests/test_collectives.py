@@ -645,3 +645,86 @@ def test_reducescatter_and_grouped_async():
     np.testing.assert_allclose(np.asarray(outs[0]), np.full((3,), float(N)))
     np.testing.assert_allclose(np.asarray(outs[1]),
                                np.full((2,), 2.0 * N))
+
+
+# ---------------------------------------------------------------------------
+# Reducescatter padding + fused grouped_reducescatter
+# ---------------------------------------------------------------------------
+
+
+def test_reducescatter_pads_non_divisible_eager():
+    """dim0=10 over 8 ranks: the eager path pads to 16, scatters 2 rows
+    per rank, and trims — ranks 0-4 get 2 rows, rank 5 gets 0-2, the
+    tail ranks get empty slices (ceil-chunk ownership)."""
+    vals = [np.full((10, 3), float(r + 1), np.float32) for r in range(N)]
+    out = hvd.reducescatter(PerRank(vals), op=hvd.Sum)
+    total = np.sum(np.stack(vals), 0)
+    chunk = 2  # ceil(10/8)
+    off = 0
+    for j, row in enumerate(out.values):
+        keep = max(0, min(10 - j * chunk, chunk))
+        assert np.asarray(row).shape == (keep, 3)
+        np.testing.assert_allclose(np.asarray(row),
+                                   total[off: off + keep], rtol=1e-5)
+        off += keep
+    assert off == 10
+
+
+def test_grouped_reducescatter_eager_fused():
+    """Mixed non-divisible shapes and mixed dtypes ride ONE compiled
+    program per call; results match per-tensor reducescatter."""
+    rng = np.random.RandomState(3)
+    f32 = [[rng.randn(10, 3).astype(np.float32) for _ in range(2)]
+           for _ in range(N)]
+    i32 = [[rng.randint(-9, 9, size=(5,)).astype(np.int32)]
+           for _ in range(N)]
+    tensors = [PerRank([f32[r][0] for r in range(N)]),
+               PerRank([i32[r][0] for r in range(N)]),
+               PerRank([f32[r][1] for r in range(N)])]
+    outs = hvd.grouped_reducescatter(tensors, op=hvd.Sum)
+    singles = [hvd.reducescatter(t, op=hvd.Sum) for t in tensors]
+    for got, ref in zip(outs, singles):
+        for a, b in zip(got.values, ref.values):
+            np.testing.assert_allclose(np.asarray(a, np.float64),
+                                       np.asarray(b, np.float64),
+                                       rtol=1e-5)
+
+
+def test_grouped_reducescatter_in_jit_matches_per_tensor(mesh):
+    vals_a = per_rank_data((N * 2, 3), np.float32, seed=5)
+    vals_b = per_rank_data((N,), np.float32, seed=6)
+
+    def grouped(a, b):
+        outs = hvd.grouped_reducescatter([a[0], b[0]], op=hvd.Average)
+        return outs[0], outs[1]
+
+    def single(a, b):
+        return (hvd.reducescatter(a[0], op=hvd.Average),
+                hvd.reducescatter(b[0], op=hvd.Average))
+
+    ga, gb = jax.jit(_shard_mapped_per_rank(grouped, mesh, n_in=2))(
+        jnp.stack(vals_a), jnp.stack(vals_b))
+    sa, sb = jax.jit(_shard_mapped_per_rank(single, mesh, n_in=2))(
+        jnp.stack(vals_a), jnp.stack(vals_b))
+    np.testing.assert_array_equal(np.asarray(ga), np.asarray(sa))
+    np.testing.assert_array_equal(np.asarray(gb), np.asarray(sb))
+
+
+def test_grouped_reducescatter_in_jit_rejects_non_divisible(mesh):
+    from horovod_tpu.common.exceptions import HorovodTpuError
+
+    vals = per_rank_data((10,), np.float32)
+
+    def f(x):
+        return hvd.grouped_reducescatter([x[0]], op=hvd.Sum)[0]
+
+    with pytest.raises(HorovodTpuError, match="divisible"):
+        jax.jit(_shard_mapped(f, mesh))(jnp.stack(vals))
+
+
+def test_grouped_reducescatter_rejects_minmax():
+    from horovod_tpu.common.exceptions import HorovodTpuError
+
+    with pytest.raises(HorovodTpuError):
+        hvd.grouped_reducescatter(
+            [np.ones((N * 2,), np.float32)], op=hvd.Max)

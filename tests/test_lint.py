@@ -509,3 +509,79 @@ def test_no_jax_import_in_lint_machinery():
          "import lint_all; sys.exit(lint_all.main(['.']))"],
         capture_output=True, text=True, timeout=300, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# sharded-optimizer catalog coverage (r7 gauges + ag_fusion knob)
+# ---------------------------------------------------------------------------
+
+from hvdlint.catalogs import (  # noqa: E402
+    MetricsCatalog,
+    _DOC_ROW_RE,
+    _KNOB_RE,
+    _REG_RE,
+)
+
+SHARDED_GAUGES = ("hvd_opt_state_bytes", "hvd_rs_bytes",
+                  "hvd_param_ag_bytes")
+
+
+def _repo_text(rel):
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def test_sharded_gauges_registered_and_documented():
+    """The three ZeRO-1 gauges must exist on BOTH sides the analyzer
+    diffs — registered in the catalog and rowed in docs/METRICS.md —
+    so deleting either side is a tier-1 failure, not silent drift."""
+    declared = set(_REG_RE.findall(
+        _repo_text("horovod_tpu/metrics/catalog.py")))
+    documented = set(_DOC_ROW_RE.findall(_repo_text("docs/METRICS.md")))
+    for gauge in SHARDED_GAUGES:
+        assert gauge in declared, gauge
+        assert gauge in documented, gauge
+
+
+def test_ag_fusion_knob_registered_and_documented():
+    knobs = set(_KNOB_RE.findall(
+        _repo_text("horovod_tpu/utils/autotune.py")))
+    assert "ag_fusion" in knobs
+    assert "`ag_fusion`" in _repo_text("docs/AUTOTUNE.md")
+
+
+def test_metrics_catalog_catches_sharded_gauge_doc_drift(tmp_path):
+    """Drop one sharded gauge's doc row from a copy of the REAL repo
+    files: the metrics-catalog analyzer must flag exactly that gauge."""
+    doc = "\n".join(
+        line for line in _repo_text("docs/METRICS.md").splitlines()
+        if "`hvd_rs_bytes`" not in line)
+    proj = make_project(tmp_path, {
+        "horovod_tpu/metrics/catalog.py":
+            _repo_text("horovod_tpu/metrics/catalog.py"),
+        "horovod_tpu/utils/autotune.py":
+            _repo_text("horovod_tpu/utils/autotune.py"),
+        "docs/METRICS.md": doc,
+        "docs/AUTOTUNE.md": _repo_text("docs/AUTOTUNE.md"),
+    })
+    findings = MetricsCatalog().run(proj)
+    assert [(f.rule, "hvd_rs_bytes" in f.message) for f in findings] == [
+        ("undocumented-metric", True)]
+
+
+def test_metrics_catalog_catches_ag_fusion_knob_drift(tmp_path):
+    """Strip the `ag_fusion` mention from a copy of docs/AUTOTUNE.md:
+    the analyzer must report the knob as undocumented."""
+    at_doc = _repo_text("docs/AUTOTUNE.md").replace("`ag_fusion`",
+                                                    "(redacted)")
+    proj = make_project(tmp_path, {
+        "horovod_tpu/metrics/catalog.py":
+            _repo_text("horovod_tpu/metrics/catalog.py"),
+        "horovod_tpu/utils/autotune.py":
+            _repo_text("horovod_tpu/utils/autotune.py"),
+        "docs/METRICS.md": _repo_text("docs/METRICS.md"),
+        "docs/AUTOTUNE.md": at_doc,
+    })
+    findings = MetricsCatalog().run(proj)
+    assert [(f.rule, "ag_fusion" in f.message) for f in findings] == [
+        ("undocumented-knob", True)]

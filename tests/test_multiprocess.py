@@ -168,6 +168,48 @@ class TestJoinMultiprocess:
         assert res[1]["a2av_splits"] == [0, 2]
 
 
+HIER_WORKER = os.path.join(REPO_ROOT, "tests", "data",
+                           "hierarchical_main.py")
+
+
+@pytest.mark.integration
+class TestHierarchicalCrossProcess:
+    """Two-tier mesh with the slow tier on a REAL process boundary:
+    np=2 processes x 4 virtual devices each fold into the 2x4
+    ("dcn", "hvd") hierarchical mesh, so the DCN legs (including the
+    int8 wire and the ZeRO-1 reduce-scatter/allgather pair) cross the
+    gloo transport instead of staying host-local like the
+    single-process suites."""
+
+    def test_two_tier_collectives_cross_process(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        env["HVD_TEST_OUT"] = str(tmp_path)
+        env["JAX_PLATFORMS"] = "cpu"
+        # The worker pins its own 4-device XLA_FLAGS before importing
+        # jax; drop the parent's count=8 flag anyway for hygiene.
+        env.pop("XLA_FLAGS", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             "python", HIER_WORKER],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO_ROOT)
+        assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+        for pidx in (0, 1):
+            path = tmp_path / f"rank{pidx}.json"
+            assert path.exists(), \
+                f"process {pidx} wrote no result:\n{r.stdout}\n{r.stderr}"
+            res = json.loads(path.read_text())
+            assert res["size"] == 8
+            # Exact two-level == flat, bit for bit (integer-valued f32).
+            assert res["hier_exact_bitwise"], res
+            # ZeRO-1 substrate: RS+AG reassembles the exact flat sum.
+            assert res["rs_ag_bitwise"], res
+            # int8 DCN wire engaged (error nonzero) and bounded.
+            assert 0.0 < res["int8_err"] < res["ref_scale"] / 25, res
+
+
 STALL_WORKER = os.path.join(REPO_ROOT, "tests", "data", "stall_main.py")
 
 

@@ -333,3 +333,294 @@ class TestEarlyReduction:
         u2, state = opt.update(g2, state, w)
         np.testing.assert_array_equal(np.asarray(u2),
                                       -np.asarray((g1 + g2) / 2))
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1: shard_optimizer_states reduce-scatter pipeline
+# ---------------------------------------------------------------------------
+
+
+def _dyadic_sgd():
+    """Dyadic lr/momentum: every intermediate of the momentum update is
+    exactly representable, so XLA's freedom to fuse `g + m*t` as FMA in
+    one program shape and mul+add in another cannot cost the bitwise
+    sharded-vs-replicated contract a ulp (it does with lr=0.1)."""
+    return optax.sgd(0.25, momentum=0.5)
+
+
+class TestShardedOptimizer:
+    SHAPES = [(5, 3), (7,), (2, 2, 2), (11,)]
+
+    def _make(self, **kw):
+        base = dict(fusion_threshold_bytes=64, axis_name=hvd.GLOBAL_AXIS)
+        base.update(kw)
+        return hvd.DistributedOptimizer(_dyadic_sgd(), **base)
+
+    @pytest.mark.parametrize("compression_name", ["none", "fp16"])
+    def test_bitwise_matches_fused_replicated(self, compression_name):
+        """allreduce == reduce-scatter + allgather: the sharded update
+        must reproduce the replicated fused-apply trajectory BIT FOR BIT
+        on exactly-representable inputs (integer-valued f32 grads, /8
+        average exact, dyadic hyperparameters) — exact and fp16 wires
+        both, since the sharded path divides in the wire dtype exactly
+        like the replicated pmean."""
+        comp = getattr(hvd.Compression, compression_name)
+        stacked = _stacked_grads(3, self.SHAPES, integral=True)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        fused = self._make(fused_apply=True, compression=comp)
+        sharded = self._make(shard_optimizer_states=True, compression=comp)
+        got_f = _per_rank_updates(fused, params, stacked)
+        got_s = _per_rank_updates(sharded, params, stacked)
+        for a, b in zip(got_f, got_s):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_random_grads_allclose(self):
+        stacked = _stacked_grads(4, self.SHAPES)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        got_f = _per_rank_updates(self._make(fused_apply=True), params,
+                                  stacked)
+        got_s = _per_rank_updates(
+            self._make(shard_optimizer_states=True), params, stacked)
+        for a, b in zip(got_f, got_s):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_fused_allgather_knob_bitwise(self, monkeypatch):
+        """HOROVOD_SHARD_AG_FUSION=1 fuses the per-group param
+        allgathers into one collective per send dtype — a pure layout
+        change, so the trajectory stays bitwise identical."""
+        stacked = _stacked_grads(5, self.SHAPES, integral=True)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        base = _per_rank_updates(
+            self._make(shard_optimizer_states=True), params, stacked)
+        monkeypatch.setenv("HOROVOD_SHARD_AG_FUSION", "1")
+        fused_ag = _per_rank_updates(
+            self._make(shard_optimizer_states=True), params, stacked)
+        for a, b in zip(base, fused_ag):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("wire", ["bf16", "fp16"])
+    def test_allgather_wire_close_and_keeps_masters(self, wire):
+        """Low-precision param allgather: close to the exact path, and
+        the fp32 master shards are carried in the state (the owner's
+        integration variable — wire error must not accumulate)."""
+        stacked = _stacked_grads(6, self.SHAPES, integral=True)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        exact = _per_rank_updates(
+            self._make(shard_optimizer_states=True), params, stacked)
+        opt = self._make(shard_optimizer_states=True, allgather_wire=wire)
+        got = _per_rank_updates(opt, params, stacked)
+        scale = max(float(np.abs(np.asarray(e)).max()) for e in exact)
+        tol = scale * (1e-2 if wire == "bf16" else 1e-3)
+        for a, b in zip(exact, got):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=tol)
+        state = opt.init(params)
+        assert all(slot.master is not None for slot in state.inner)
+
+    def test_hierarchical_axis_bitwise(self):
+        """2-tuple axis: two-level reduce-scatter (ICI psum-scatter +
+        DCN hop) and the (dcn, ici) allgather must land every segment on
+        its dcn-major owner — bitwise vs the flat replicated path on
+        exact inputs."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.parallel.mesh import create_hierarchical_mesh
+
+        hmesh = create_hierarchical_mesh(2, 4, devices=jax.devices()[:N])
+        axes = ("dcn", hvd.GLOBAL_AXIS)
+        stacked = _stacked_grads(7, self.SHAPES, integral=True)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+
+        def run(opt):
+            def body(*xs):
+                grads = [x[0] for x in xs]
+                p = list(params)
+                state = opt.init(p)
+                for _ in range(3):
+                    u, state = opt.update(grads, state, p)
+                    p = [pi + ui for pi, ui in zip(p, u)]
+                return p
+
+            sm = shard_map(
+                body, mesh=hmesh,
+                in_specs=tuple(P(axes) for _ in stacked),
+                out_specs=P(), check_vma=False)
+            return jax.jit(sm)(*stacked)
+
+        ref = run(self._make(fused_apply=True, axis_name=axes))
+        got = run(self._make(shard_optimizer_states=True, axis_name=axes))
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_true_sharded_placement_data_parallel(self):
+        """End-to-end ZeRO-1 placement: sharded_state_specs feeds
+        data_parallel's arg_specs/out_specs so each rank materializes
+        only its state row — sharding spec P(axis) on the inner leaves,
+        per-chip state bytes ~1/N, trajectory bitwise equal to the
+        replicated reference."""
+        from jax.sharding import PartitionSpec as P
+
+        rng = np.random.RandomState(8)
+        shapes = [(6, 4), (10,)]
+        params = [jnp.asarray(np.round(rng.randn(*s) * 4), jnp.float32)
+                  for s in shapes]
+        xs = jnp.asarray(np.round(rng.randn(N * 2, 4) * 2), jnp.float32)
+
+        def make_step(o):
+            def step(p, opt_state, x):
+                s = jnp.sum(x)
+                g = [jnp.full(pi.shape, s, pi.dtype) for pi in p]
+                u, opt_state = o.update(g, opt_state, p)
+                return [pi + ui for pi, ui in zip(p, u)], opt_state
+            return step
+
+        sopt = self._make(shard_optimizer_states=True)
+        st0 = sopt.init(params)
+        specs = hvd.sharded_state_specs(st0)
+        compiled = hvd.data_parallel(
+            make_step(sopt), batch_args=(2,), donate_args=(),
+            arg_specs={1: specs}, out_specs=(P(), specs))
+        batch = hvd.shard_batch(xs)
+        p, st = params, st0
+        for _ in range(3):
+            p, st = compiled(p, st, batch)
+
+        ropt = self._make()
+        rst0 = ropt.init(params)
+        rcompiled = hvd.data_parallel(
+            make_step(ropt), batch_args=(2,), donate_args=())
+        rp, rst = params, rst0
+        for _ in range(3):
+            rp, rst = rcompiled(rp, rst, batch)
+
+        for a, b in zip(p, rp):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        leaf = jax.tree_util.tree_leaves(st.inner[0].state)[0]
+        assert leaf.sharding.spec == P(hvd.GLOBAL_AXIS)
+        # Placed state is ~1/N of the replicated momentum footprint.
+        total = sum(int(np.prod(s)) for s in shapes) * 4
+        assert hvd.optimizer_state_bytes(st) <= total // N + 4 * N
+
+    def test_early_reduction_composes_bitwise(self):
+        """early_reduction feeds the sharded update pre-reduced grads:
+        the shard is then a plain slice of the allreduced accumulator,
+        which equals the reduce-scatter by linearity — bitwise on exact
+        inputs (k=4 power of two)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        k = 4
+        shapes = [(6,), (3, 2)]
+        mesh = hvd.global_mesh()
+        rng = np.random.RandomState(9)
+        stacked = [jnp.asarray(np.round(rng.randn(N, k, *s) * 8),
+                               jnp.float32) for s in shapes]
+        params = [jnp.zeros(s, jnp.float32) for s in shapes]
+
+        def run(early):
+            opt = hvd.DistributedOptimizer(
+                _dyadic_sgd(), backward_passes_per_step=k,
+                early_reduction=early, shard_optimizer_states=True,
+                fusion_threshold_bytes=64, axis_name=hvd.GLOBAL_AXIS)
+
+            def body(*xs):
+                state = opt.init(list(params))
+                p = list(params)
+                for j in range(k):
+                    g = [x[0, j] for x in xs]
+                    u, state = opt.update(g, state, p)
+                    p = [pi + ui for pi, ui in zip(p, u)]
+                return p
+
+            sm = shard_map(
+                body, mesh=mesh,
+                in_specs=tuple(P(hvd.GLOBAL_AXIS) for _ in shapes),
+                out_specs=P(), check_vma=False)
+            return jax.jit(sm)(*stacked)
+
+        late, early = run(False), run(True)
+        for a, b in zip(late, early):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_partition_drift_raises(self, monkeypatch):
+        """Same loud-failure contract as fused_apply: the autotuner
+        moving the fusion threshold between init and update must raise,
+        not silently mis-slice the shard state."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = hvd.global_mesh()
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        stacked = _stacked_grads(10, self.SHAPES)
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 26))
+        opt = hvd.DistributedOptimizer(_dyadic_sgd(),
+                                       shard_optimizer_states=True,
+                                       axis_name=hvd.GLOBAL_AXIS)
+        state = opt.init(params)
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "16")
+
+        def body(*xs):
+            u, _ = opt.update([x[0] for x in xs], state, list(params))
+            return u
+
+        sm = shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P(hvd.GLOBAL_AXIS) for _ in self.SHAPES),
+            out_specs=P(), check_vma=False)
+        with pytest.raises(ValueError, match="re-init"):
+            jax.jit(sm)(*stacked)
+
+    def test_eager_update_raises(self):
+        from horovod_tpu.common.exceptions import HorovodTpuError
+
+        opt = self._make(shard_optimizer_states=True)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        grads = [jnp.ones(s, jnp.float32) for s in self.SHAPES]
+        state = opt.init(params)
+        with pytest.raises(HorovodTpuError, match="in-jit only"):
+            opt.update(grads, state, params)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="Adasum"):
+            self._make(shard_optimizer_states=True, op=hvd.Adasum)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            self._make(shard_optimizer_states=True, fused_apply=True)
+        with pytest.raises(ValueError, match="reduce-scatter"):
+            self._make(shard_optimizer_states=True,
+                       compression=hvd.Compression.int8)
+        with pytest.raises(ValueError, match="allgather_wire"):
+            self._make(shard_optimizer_states=True, allgather_wire="int8")
+        with pytest.raises(ValueError, match="shard_optimizer_states"):
+            self._make(allgather_wire="bf16")
+        ps = hvd.add_process_set([0, 2])
+        try:
+            with pytest.raises(ValueError, match="global process"):
+                self._make(shard_optimizer_states=True, process_set=ps)
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_env_opt_in(self, monkeypatch):
+        """HOROVOD_SHARD_OPTIMIZER=1 flips the default on: init builds
+        _ShardSlot groups without any code change at the call site."""
+        from horovod_tpu.parallel.optimizer import _ShardSlot
+
+        monkeypatch.setenv("HOROVOD_SHARD_OPTIMIZER", "1")
+        opt = hvd.DistributedOptimizer(_dyadic_sgd(),
+                                       fusion_threshold_bytes=64)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        state = opt.init(params)
+        assert isinstance(state.inner, tuple)
+        assert all(isinstance(s, _ShardSlot) for s in state.inner)
+
+    def test_opt_state_bytes_accounting(self):
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        repl = hvd.DistributedOptimizer(_dyadic_sgd())
+        shard = self._make(shard_optimizer_states=True)
+        rb = hvd.optimizer_state_bytes(repl.init(params))
+        sb = hvd.optimizer_state_bytes(shard.init(params))
+        total = sum(int(np.prod(s)) for s in self.SHAPES) * 4
+        assert rb == total          # momentum trace, replicated
+        # Sharded: 1/N per group plus at most one pad row per group.
+        assert sb <= total // N + 4 * len(shard.init(params).inner) * 2
+        assert sb < rb / 4
