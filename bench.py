@@ -256,10 +256,15 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
     # before/after comparison.
     legacy = os.environ.get("HOROVOD_BENCH_LEGACY_PIPELINE") == "1"
     sharded = os.environ.get("HOROVOD_SHARD_OPTIMIZER") == "1"
+    quant = bool(os.environ.get("HOROVOD_WIRE_POLICY"))
     if legacy or not distributed:
         pipeline = "legacy"
     elif sharded:
         pipeline = "sharded"
+    elif quant:
+        # Overlap pipeline + per-bucket wire policy (docs/WIRE.md): big
+        # buckets ride the quantized ring, small stay exact.
+        pipeline = "quant"
     else:
         pipeline = "overlap"
     if pipeline == "sharded":
@@ -268,7 +273,7 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
         opt = hvd.DistributedOptimizer(base_opt, shard_optimizer_states=True)
         step_fn = build_step(opt, v["config"], distributed=True,
                              reduce_grads_in_step=False)
-    elif pipeline == "overlap":
+    elif pipeline in ("overlap", "quant"):
         opt = hvd.DistributedOptimizer(base_opt, fused_apply=True)
         step_fn = build_step(opt, v["config"], distributed=True,
                              reduce_grads_in_step=False)
@@ -290,10 +295,20 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
     # ratio's run-to-run noise on the shared core.
     iters = 12 if n_devices == 1 else 6
     t, _, _ = time_steps(step, state, opt_state, sb, warmup=2, iters=iters)
-    print(json.dumps({"n": n_devices, "step_time_s": t,
-                      "pipeline": pipeline,
-                      "opt_state_bytes": opt_state_bytes,
-                      "per_chip_img_sec": batch / t / n_devices}))
+    record = {"n": n_devices, "step_time_s": t,
+              "pipeline": pipeline,
+              "opt_state_bytes": opt_state_bytes,
+              "per_chip_img_sec": batch / t / n_devices}
+    if pipeline == "quant":
+        # Static per-step wire-byte accounting of the active policy over
+        # the gradient leaves (same bookkeeping hvd_wire_bytes_saved
+        # reports; grads share the param tree's shapes).
+        plan = hvd.wire_policy_plan(
+            jax.tree_util.tree_leaves(state["params"]))
+        record["wire_bytes_saved"] = sum(
+            raw - wb for _, _, raw, wb in plan)
+        record["wire_bytes_raw"] = sum(raw for _, _, raw, _ in plan)
+    print(json.dumps(record))
 
 
 # Side channel: the full JSON record of the most recent sim child, so
@@ -304,17 +319,21 @@ _LAST_SIM_RECORD = None
 
 
 def _run_sim_record(n: int, distributed: bool, timeout: float,
-                    legacy: bool = False, sharded: bool = False):
+                    legacy: bool = False, sharded: bool = False,
+                    quant: bool = False):
     """Run one sim child; return its full JSON record (or None)."""
     global _LAST_SIM_RECORD
     _LAST_SIM_RECORD = None
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env.pop("HOROVOD_SHARD_OPTIMIZER", None)
+    env.pop("HOROVOD_WIRE_POLICY", None)
     if legacy:
         env["HOROVOD_BENCH_LEGACY_PIPELINE"] = "1"
     if sharded:
         env["HOROVOD_SHARD_OPTIMIZER"] = "1"
+    if quant:
+        env["HOROVOD_WIRE_POLICY"] = "auto"
     cmd = [sys.executable, os.path.abspath(__file__), "--sim-child", str(n)]
     if not distributed:
         cmd.append("--no-dist")
@@ -335,9 +354,10 @@ def _run_sim_record(n: int, distributed: bool, timeout: float,
 
 
 def _run_sim(n: int, distributed: bool, timeout: float,
-             legacy: bool = False, sharded: bool = False):
+             legacy: bool = False, sharded: bool = False,
+             quant: bool = False):
     rec = _run_sim_record(n, distributed, timeout, legacy=legacy,
-                          sharded=sharded)
+                          sharded=sharded, quant=quant)
     return None if rec is None else rec["step_time_s"]
 
 
@@ -478,6 +498,29 @@ def sim_scaling_efficiency(timeout: float = 600.0,
                     f"-> sharded {sb} ({rb / sb:.1f}x smaller)")
                 extras["opt_state_bytes_replicated"] = int(rb)
                 extras["opt_state_bytes_sharded"] = int(sb)
+        # Quantized-wire pipeline: n=8 step with HOROVOD_WIRE_POLICY=auto
+        # (big gradient buckets ride the int8 ring, small stay exact —
+        # docs/WIRE.md), plus the static wire-byte savings of the policy.
+        t8_quant = _run_sim(8, True, timeout, quant=True)
+        rec_quant = _LAST_SIM_RECORD
+        if t8_quant is not None:
+            quant_share = (t8_quant - t8_nodist) / t8_quant
+            log(f"sim-scaling n=8 quant pipeline: {t8_quant*1e3:.1f} "
+                f"ms/step -> collective share "
+                f"{(t8_quant - t8_nodist)*1e3:.1f} ms/step "
+                f"({100 * quant_share:.1f}%)")
+            extras["t8_quant_ms"] = round(t8_quant * 1e3, 1)
+            extras["collective_share_quant"] = round(quant_share, 4)
+            saved = (rec_quant.get("wire_bytes_saved")
+                     if rec_quant is not None else None)
+            raw = (rec_quant.get("wire_bytes_raw")
+                   if rec_quant is not None else None)
+            if saved and raw:
+                log(f"sim-scaling wire bytes/step: raw {raw} -> saved "
+                    f"{saved} ({raw / (raw - saved):.1f}x less on the "
+                    "wire)")
+                extras["wire_bytes_saved"] = int(saved)
+                extras["wire_bytes_raw"] = int(raw)
 
     def _trimmed_median(vals):
         s = _np.sort(_np.asarray(vals))

@@ -118,6 +118,14 @@ from .ops.collectives import (  # noqa: F401
 
 from .ops.compression import Compression  # noqa: F401
 
+from .ops.wire import (  # noqa: F401
+    WireCodec,
+    WirePolicy,
+    get_codec,
+    parse_wire_policy,
+    wire_names,
+)
+
 from .ops.functions import (  # noqa: F401
     broadcast_parameters,
     broadcast_optimizer_state,
@@ -140,6 +148,7 @@ from .parallel.data_parallel import (  # noqa: F401
     error_feedback_init,
     gradient_bucket_partition,
     shard_batch,
+    wire_policy_plan,
 )
 
 from .utils.timeline import (  # noqa: F401

@@ -230,6 +230,9 @@ CATALOG: Tuple[EnvVar, ...] = (
     _v("HOROVOD_SHARD_AG_FUSION", "0", "autotune",
        "1 fuses the sharded-optimizer param allgathers into one "
        "collective (0 overlaps per-group gathers).", "AUTOTUNE.md"),
+    _v("HOROVOD_WIRE_THRESHOLD", "1048576", "autotune",
+       "Byte threshold above which the wire policy routes a bucket to "
+       "its big (quantized) codec; autotunable.", "WIRE.md"),
 
     # -- collectives / ops ----------------------------------------------
     _v("HOROVOD_HIERARCHICAL_ALLREDUCE", "0", "ops",
@@ -237,15 +240,19 @@ CATALOG: Tuple[EnvVar, ...] = (
        "DCN allreduce -> ICI all-gather (reference knob name).",
        "PERF_NOTES.md"),
     _v("HOROVOD_HIERARCHICAL_DCN_WIRE", "(exact)", "ops",
-       "Wire format of the DCN leg of hierarchical allreduce: exact, "
-       "fp16 or int8 (quantized-wire trade-off).", "PERF_NOTES.md"),
+       "Wire format of the DCN leg of hierarchical allreduce: any "
+       "registered codec (none/fp16/bf16/int8/int4/fp8_*).", "WIRE.md"),
+    _v("HOROVOD_WIRE_POLICY", "(unset)", "ops",
+       "Per-bucket wire-format policy for gradient reductions: auto, "
+       "exact, or big=<codec>,small=<codec>[,threshold=<bytes>].",
+       "WIRE.md"),
     _v("HOROVOD_SHARD_OPTIMIZER", "0", "ops",
        "1 enables the ZeRO-1 sharded-optimizer path: reduce-scatter "
        "gradients, shard-local optax update, param allgather.",
        "SHARDED_OPTIMIZER.md"),
     _v("HOROVOD_SHARD_AG_WIRE", "(exact)", "ops",
-       "Low-precision wire of the sharded param allgather: exact, "
-       "bf16 or fp16 (fp32 masters stay exact on the owner).",
+       "Low-precision wire of the sharded param allgather: any "
+       "registered codec (fp32 masters stay exact on the owner).",
        "SHARDED_OPTIMIZER.md"),
     _v("HOROVOD_COLLECTIVE_CONSISTENCY_CHECK", "0", "ops",
        "1 enables the cross-rank shape/dtype/generation consistency "

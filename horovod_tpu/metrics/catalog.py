@@ -72,6 +72,22 @@ opt_state_bytes = _REG.gauge(
     "hvd_opt_state_bytes",
     "Per-chip resident inner optimizer-state bytes (recorded at init; "
     "sharded states count their 1/N shard — the ZeRO-1 denominator).")
+wire_bytes_saved = _REG.counter(
+    "hvd_wire_bytes_saved",
+    "Gradient bytes the per-bucket wire policy kept off the wire on "
+    "eager reductions (raw bytes minus block-scaled wire bytes, "
+    "HOROVOD_WIRE_POLICY; see docs/WIRE.md).")
+wire_bytes_saved_per_step = _REG.gauge(
+    "hvd_wire_bytes_saved_per_step",
+    "Static gradient bytes per compiled step the per-bucket wire policy "
+    "keeps off the wire (recorded at trace time; multiply by "
+    "hvd_steps_total for in-jit savings).")
+wire_format_bytes = _REG.gauge(
+    "hvd_wire_format_bytes",
+    "Static wire bytes shipped per compiled step by wire format "
+    "(payload plus block scales, recorded at trace time alongside "
+    "hvd_wire_bytes_saved_per_step).",
+    ("format",))
 rs_bytes = _REG.gauge(
     "hvd_rs_bytes",
     "Static bytes entering the sharded-optimizer gradient reduce-"

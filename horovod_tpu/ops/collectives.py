@@ -57,6 +57,7 @@ from ..utils import consistency as _cc
 from ..utils import stall_inspector as _stall
 from ..utils import timeline as _tl
 from . import join as _join
+from . import wire as _wire_registry
 
 # Join-mode signature publishing must happen once per OUTERMOST eager
 # collective (grouped_allreduce/barrier/allgather fan out into inner
@@ -863,11 +864,51 @@ def grouped_allgather(
     name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
     axis_name: Optional[str] = None,
+    wire: Optional[str] = None,
 ) -> List[Any]:
-    return [
-        allgather(t, process_set=process_set, axis_name=axis_name)
-        for t in tensors
-    ]
+    """Allgather a tensor group; `wire` (a codec name from ops/wire.py)
+    ships each gather at wire width on the in-jit path: cast wires ride
+    `lax.all_gather` in the wire dtype, cooperative wires (int8 / int4 /
+    fp8) ride the block-scaled payload gather
+    (`quantized_allgather_shard` — one lossy encode per element, nothing
+    accumulates through the wire).  Integer tensors always stay exact."""
+    codec = _wire_registry.get_codec(wire)
+    if codec.exact:
+        return [
+            allgather(t, process_set=process_set, axis_name=axis_name)
+            for t in tensors
+        ]
+    if not all(_is_tracer(t) for t in tensors):
+        raise HorovodTpuError(
+            "grouped_allgather(wire=...) is in-jit only; the eager path "
+            "gathers exactly")
+    ax = axis_name or GLOBAL_AXIS
+    groups = _tracer_set_groups("allgather", process_set, ax)
+    out: List[Any] = []
+    for t in tensors:
+        if not jnp.issubdtype(jnp.result_type(t), jnp.floating):
+            out.append(lax.all_gather(t, ax, tiled=True,
+                                      axis_index_groups=groups))
+        elif codec.cast_dtype is not None:
+            g = lax.all_gather(t.astype(codec.cast_dtype), ax, tiled=True,
+                               axis_index_groups=groups)
+            out.append(g.astype(jnp.result_type(t)))
+        else:
+            if groups is not None:
+                raise HorovodTpuError(
+                    f"wire={codec.name!r} rides the ring collective, "
+                    "which spans the whole axis — process sets are not "
+                    "supported; use a cast wire or the exact path")
+            from .quantized import quantized_allgather_shard
+
+            shape = jnp.shape(t)
+            d0 = shape[0] if shape else 1
+            n = lax.axis_size(ax)
+            flat = quantized_allgather_shard(
+                jnp.ravel(t).astype(jnp.float32), ax, wire=codec.name)
+            out.append(flat.reshape((n * d0,) + tuple(shape[1:]))
+                       .astype(jnp.result_type(t)))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1205,12 +1246,19 @@ def grouped_reducescatter(
     name: Optional[str] = None,
     process_set: Optional[ProcessSet] = None,
     axis_name: Optional[str] = None,
+    wire: Optional[str] = None,
 ):
     """Fused reduce-scatter of a tensor group: one collective per dtype
     bucket instead of one dispatch per tensor (the fusion-buffer
     pack/unpack mirrors `grouped_allreduce` — each tensor is reshaped to
     (n, rows_per_rank * rest) and the buffers are concatenated along the
     per-rank axis, so a single scatter delivers every tensor's slice).
+
+    `wire` (a codec name from ops/wire.py; in-jit only) ships the
+    scatter at wire width: cast wires ride `lax.psum_scatter` in the
+    wire dtype, cooperative wires (int8 / int4 / fp8) ride the
+    block-scaled ring (`quantized_reducescatter_shard`, f32
+    accumulation per hop).  Integer dtype buckets always stay exact.
 
     Eager inputs follow `reducescatter`'s padding contract: dim0 is
     zero-padded to the next multiple of the set size and each rank's
@@ -1222,10 +1270,16 @@ def grouped_reducescatter(
         )
     if not tensors:
         return []
+    codec = _wire_registry.get_codec(wire)
 
     if any(_is_tracer(t) for t in tensors):
         ax = axis_name or GLOBAL_AXIS
         groups = _tracer_set_groups("reducescatter", process_set, ax)
+        if codec.cooperative and groups is not None:
+            raise HorovodTpuError(
+                f"wire={codec.name!r} rides the ring collective, which "
+                "spans the whole axis — process sets are not supported; "
+                "use a cast wire or the exact path")
         n = (len(groups[0]) if groups is not None else lax.axis_size(ax))
         out: List[Any] = [None] * len(tensors)
         by_dtype: Dict[Any, List[int]] = {}
@@ -1245,16 +1299,35 @@ def grouped_reducescatter(
             buf = jnp.concatenate(
                 [jnp.reshape(tensors[i].astype(dt), (n, w))
                  for i, w in zip(idxs, widths)], axis=1)
-            red = lax.psum_scatter(jnp.ravel(buf), ax, tiled=True,
-                                   axis_index_groups=groups)
-            if op is Average:
-                red = (red / n).astype(dt)
+            wired = (not codec.exact
+                     and jnp.issubdtype(dt, jnp.floating))
+            if wired and codec.cooperative:
+                from .quantized import quantized_reducescatter_shard
+
+                red = quantized_reducescatter_shard(
+                    jnp.ravel(buf).astype(jnp.float32), ax,
+                    average=(op is Average), wire=codec.name).astype(dt)
+            elif wired:
+                red = lax.psum_scatter(
+                    jnp.ravel(buf).astype(codec.cast_dtype), ax,
+                    tiled=True, axis_index_groups=groups).astype(dt)
+                if op is Average:
+                    red = (red / n).astype(dt)
+            else:
+                red = lax.psum_scatter(jnp.ravel(buf), ax, tiled=True,
+                                       axis_index_groups=groups)
+                if op is Average:
+                    red = (red / n).astype(dt)
             offset = 0
             for i, s, w in zip(idxs, shapes, widths):
                 out[i] = red[offset: offset + w].reshape(
                     (s[0] // n,) + tuple(s[1:]))
                 offset += w
         return out
+    if not codec.exact:
+        raise HorovodTpuError(
+            "grouped_reducescatter(wire=...) is in-jit only; the eager "
+            "path reduces exactly")
 
     ps = _resolve_set(process_set)
     n = ps.size()

@@ -6,14 +6,25 @@ compression.py — `Compression.none` / `Compression.fp16` with
 
 TPU note: bf16 is the native low-precision dtype (first-class on the MXU
 and halves ICI bytes), so `Compression.bf16` is provided alongside fp16.
+
+Every compressor carries a `wire` name resolving to a codec in the
+unified registry (ops/wire.py, docs/WIRE.md); cast-wire dtypes derive
+from the registry rather than being restated here.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
+from . import wire as _wire
+
 
 class Compressor:
+    #: Registry name of the wire format this compressor speaks
+    #: (ops/wire.py); consumers resolve behavior via
+    #: `wire.get_codec(compressor.wire)` rather than isinstance checks.
+    wire: str = "none"
+
     @staticmethod
     def compress(tensor):
         """Returns (compressed_tensor, context_for_decompress)."""
@@ -25,6 +36,8 @@ class Compressor:
 
 
 class NoneCompressor(Compressor):
+    wire = "none"
+
     @staticmethod
     def compress(tensor):
         return tensor, None
@@ -51,23 +64,23 @@ class _CastCompressor(Compressor):
 
 
 class FP16Compressor(_CastCompressor):
-    wire_dtype = jnp.float16
+    wire = "fp16"
+    wire_dtype = _wire.get_codec("fp16").cast_dtype
 
 
 class BF16Compressor(_CastCompressor):
-    wire_dtype = jnp.bfloat16
+    wire = "bf16"
+    wire_dtype = _wire.get_codec("bf16").cast_dtype
 
 
 class _CooperativeCompressor(Compressor):
-    """Base for 1-byte wire formats that cannot be a pre-collective
+    """Base for low-bit wire formats that cannot be a pre-collective
     cast: the reduction would accumulate in the wire dtype (e4m3
     saturates at ±448 → NaN; int8 scales don't sum), so the quantized
     ring collective (ops/quantized.py) implements the whole op with f32
     accumulation per hop.  `allreduce_gradients` routes these BEFORE
     compress() is reached; any other path raises instead of silently
     mis-summing."""
-
-    wire: str = None
 
     @classmethod
     def compress(cls, tensor):
@@ -101,6 +114,13 @@ class Int8Compressor(_CooperativeCompressor):
     wire = "int8"
 
 
+class Int4Compressor(_CooperativeCompressor):
+    """Half-byte int4 ring wire: ±7 levels per blockwise max-abs scale,
+    two values nibble-packed per byte (ops/wire.py) — 8× fewer payload
+    bytes than f32.  Coarse; pair with error feedback
+    (`error_feedback=` on the gradient path) for multi-step training."""
+
+    wire = "int4"
 
 
 class Compression:
@@ -110,5 +130,6 @@ class Compression:
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+    int4 = Int4Compressor
     fp8_e4m3 = FP8E4M3Compressor
     fp8_e5m2 = FP8E5M2Compressor

@@ -10,11 +10,12 @@ therefore implements the collective itself: a **ring reduce-scatter →
 allgather** over `ppermute` where every hop transmits a 1-byte payload
 (wire ≈ 1/4 of f32) and the ACCUMULATION always happens in f32.
 
-Wire codecs (both ship f32 blockwise scales per 128 elements — fp8
-needs the normalization too or later hops' partial sums overflow):
-  - "int8": blockwise max-abs scaled int8 (relative step ~1/127);
-  - "fp8_e4m3"/"fp8_e5m2": blockwise-normalized fp8 payload
-    (relative step ~1/16 / ~1/8).
+Wire codecs come from the unified registry (ops/wire.py, docs/WIRE.md):
+the cooperative formats ("int8", nibble-packed "int4", "fp8_e4m3",
+"fp8_e5m2") all ship f32 blockwise scales per 128 elements — fp8 needs
+the normalization too or later hops' partial sums overflow — and the
+cast wires ("fp16"/"bf16") ride the same ring with encode=cast, which
+is what makes HOROVOD_HIERARCHICAL_DCN_WIRE=fp16 work on the DCN leg.
 
 Precision: each of the n-1 reduce hops re-encodes the f32 partial sum,
 so worst-case error grows ~linearly in ring size — fine for gradient
@@ -38,57 +39,19 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-_BLOCK = 128  # quantization block (elements); lane-width aligned
-
-
-def _quant(v: jax.Array):
-    """v: (L,) f32 with L % _BLOCK == 0 → (q int8 (L,), scales f32
-    (L/_BLOCK,))."""
-    blocks = v.reshape(-1, _BLOCK)
-    scale = jnp.max(jnp.abs(blocks), axis=1) / 127.0
-    scale = jnp.where(scale > 0, scale, 1.0)
-    q = jnp.clip(jnp.round(blocks / scale[:, None]), -127, 127)
-    return q.astype(jnp.int8).reshape(-1), scale
-
-
-def _dequant(q: jax.Array, scale: jax.Array):
-    blocks = q.astype(jnp.float32).reshape(-1, _BLOCK)
-    return (blocks * scale[:, None]).reshape(-1)
-
-
-def _fp8_encode(v: jax.Array, dt):
-    """Blockwise-normalized fp8: scale each block by its max-abs so the
-    payload sits in [-1, 1] — partial sums on later ring hops would
-    otherwise exceed e4m3's ±448 finite range and NaN.  Decoding is
-    `_dequant` (payload * blockwise scale), shared with int8."""
-    blocks = v.reshape(-1, _BLOCK)
-    scale = jnp.max(jnp.abs(blocks), axis=1)
-    scale = jnp.where(scale > 0, scale, 1.0)
-    q = (blocks / scale[:, None]).astype(dt)
-    return q.reshape(-1), scale
+# The codec primitives live in the unified registry; _quant/_dequant
+# are re-exported here because tests and older call sites import them
+# from this module.
+from .wire import _BLOCK, _dequant, _quant, get_codec, local_roundtrip
 
 
 def _codec(wire: str):
-    """(encode: f32 vec -> tuple of wire arrays, decode: tuple -> f32)."""
-    if wire == "int8":
-        return (lambda v: _quant(v)), (lambda p: _dequant(*p))
-    if wire in ("fp8_e4m3", "fp8_e5m2"):
-        dt = (jnp.float8_e4m3fn if wire == "fp8_e4m3"
-              else jnp.float8_e5m2)
-        return (lambda v: _fp8_encode(v, dt)), (lambda p: _dequant(*p))
-    raise ValueError(f"unknown wire codec {wire!r}")
-
-
-def local_roundtrip(v: jax.Array, wire: str = "int8") -> jax.Array:
-    """encode→decode through the local codec (same blockwise scales the
-    ring's first hop uses) — the compression operator C whose error
-    error-feedback carries to the next step (parallel/data_parallel.py
-    `error_feedback_state`)."""
-    encode, decode = _codec(wire)
-    flat = v.astype(jnp.float32).reshape(-1)
-    pad = (-flat.size) % _BLOCK
-    padded = jnp.pad(flat, (0, pad))
-    return decode(encode(padded))[: flat.size].reshape(v.shape)
+    """(encode: f32 vec -> tuple of wire arrays, decode: tuple -> f32),
+    resolved through the ops/wire.py registry — every format registered
+    there (including the cast wires and nibble-packed int4) rides the
+    ring; unknown names raise HorovodTpuError naming the valid set."""
+    codec = get_codec(wire)
+    return codec.encode, codec.decode
 
 
 def quantized_allreduce_shard(x: jax.Array, axis: str,
@@ -184,23 +147,136 @@ def quantized_allreduce_shard(x: jax.Array, axis: str,
     return out
 
 
+def quantized_reducescatter_shard(x: jax.Array, axis: str,
+                                  average: bool = False,
+                                  wire: str = "int8",
+                                  error_feedback: jax.Array = None):
+    """Ring reduce-scatter with low-bit transport and f32 accumulation —
+    the reduce half of `quantized_allreduce_shard`, with `psum_scatter(
+    tiled=True)` ownership: `x` is a flat f32-compatible vector whose
+    size divides by the axis size n, and rank i returns the summed (or
+    averaged) segment i of length size/n.
+
+    Each rank's own segment is accumulated locally and never encoded, so
+    a ring of n ranks makes n-1 lossy hops per segment (one fewer than
+    the allreduce, which also wire-broadcasts the result).
+
+    `error_feedback` (optional, f32, x's shape): sender-side residuals
+    exactly as in `quantized_allreduce_shard` — returns
+    `(shard, new_residual)`; the rows this rank never encodes stay zero.
+    """
+    encode, decode = _codec(wire)
+    n = lax.psum(1, axis)
+    ef = error_feedback
+    if x.ndim != 1 or x.size % n:
+        raise ValueError(
+            f"quantized_reducescatter_shard needs a flat buffer "
+            f"divisible by the axis size ({n}); got shape {x.shape}")
+    seg = x.size // n
+    if n == 1:
+        out = x.astype(jnp.float32)
+        if ef is not None:
+            out = out + ef.astype(jnp.float32)
+            return out.astype(x.dtype), jnp.zeros(x.shape, jnp.float32)
+        return out.astype(x.dtype)
+    idx = lax.axis_index(axis)
+    dtype = x.dtype
+    # Pad each of the n segments to a whole number of blocks.
+    chunk = -(-seg // _BLOCK) * _BLOCK
+    acc = x.astype(jnp.float32).reshape(n, seg)
+    if ef is not None:
+        acc = acc + ef.astype(jnp.float32).reshape(n, seg)
+    acc = jnp.pad(acc, ((0, 0), (0, chunk - seg)))
+    resid = jnp.zeros((n, chunk), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Offset -1 vs the allreduce ring so rank i ends owning chunk i
+    # (psum_scatter semantics) instead of (i + 1) % n.
+    def body(s, carry):
+        acc, resid = carry
+        send_idx = (idx - s - 1) % n
+        v = lax.dynamic_slice(acc, (send_idx, 0), (1, chunk))[0]
+        enc = encode(v)
+        if ef is not None:
+            resid = lax.dynamic_update_slice(
+                resid, (v - decode(enc))[None], (send_idx, 0))
+        payload = tuple(lax.ppermute(p, axis, perm) for p in enc)
+        recv_idx = (idx - s - 2) % n
+        mine = lax.dynamic_slice(acc, (recv_idx, 0), (1, chunk))[0]
+        upd = mine + decode(payload)
+        return (lax.dynamic_update_slice(acc, upd[None],
+                                         (recv_idx, 0)), resid)
+
+    acc, resid = lax.fori_loop(0, n - 1, body, (acc, resid))
+    own = lax.dynamic_slice(acc, (idx, 0), (1, chunk))[0][:seg]
+    if average:
+        own = own / n
+    own = own.astype(dtype)
+    if ef is not None:
+        return own, resid[:, :seg].reshape(-1).astype(jnp.float32)
+    return own
+
+
+def quantized_allgather_shard(x: jax.Array, axis: str,
+                              wire: str = "int8") -> jax.Array:
+    """All-gather a flat local shard at wire width: encode once, gather
+    the payload (+scales), decode every row in f32 — `lax.all_gather(
+    tiled=True)` layout, so rank i's shard lands at segment i.  One
+    lossy encode per element regardless of ring size (nothing
+    accumulates through the wire), which is why the ZeRO-1 param
+    allgather can ride 1-byte formats safely: masters stay f32 on the
+    owner."""
+    codec = get_codec(wire)
+    if codec.exact:
+        return lax.all_gather(x, axis, tiled=True)
+    if x.ndim != 1:
+        raise ValueError(
+            f"quantized_allgather_shard needs a flat shard; got shape "
+            f"{x.shape}")
+    dtype = x.dtype
+    flat = x.astype(jnp.float32)
+    pad = (-flat.size) % _BLOCK
+    padded = jnp.pad(flat, (0, pad))
+    payload = codec.encode(padded)
+    gathered = tuple(lax.all_gather(p, axis) for p in payload)
+    rows = jax.vmap(lambda *p: codec.decode(p))(*gathered)
+    return rows[:, : flat.size].reshape(-1).astype(dtype)
+
+
 def quantized_allreduce(stacked: jax.Array, mesh: Mesh, axis: str = None,
-                        average: bool = False,
-                        wire: str = "int8") -> jax.Array:
+                        average: bool = False, wire: str = "int8",
+                        error_feedback: jax.Array = None):
     """Mesh-level wrapper over per-rank contributions: `stacked` has
     shape (n, *shape) with row r being rank r's tensor (the PerRank
     convention of the eager collectives); returns (n, *shape) with
-    every row the quantized-ring sum/average."""
+    every row the quantized-ring sum/average.
+
+    `error_feedback` (optional, f32, stacked's shape): row r is rank
+    r's sender-side residual, threaded through
+    `quantized_allreduce_shard` — returns `(result, new_residuals)`,
+    both (n, *shape), so the out-of-jit entry point supports the same
+    EF contract as the in-jit one."""
     axis = axis or mesh.axis_names[0]
 
-    def _fn(x):
-        return quantized_allreduce_shard(x[0], axis, average=average,
-                                         wire=wire)[None]
+    if error_feedback is None:
+        def _fn(x):
+            return quantized_allreduce_shard(x[0], axis, average=average,
+                                             wire=wire)[None]
 
-    fn = shard_map(_fn, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
-                   check_vma=False)
-    return fn(stacked)
+        fn = shard_map(_fn, mesh=mesh, in_specs=P(axis),
+                       out_specs=P(axis), check_vma=False)
+        return fn(stacked)
+
+    def _fn_ef(x, e):
+        out, r = quantized_allreduce_shard(x[0], axis, average=average,
+                                           wire=wire, error_feedback=e[0])
+        return out[None], r[None]
+
+    fn = shard_map(_fn_ef, mesh=mesh, in_specs=(P(axis), P(axis)),
+                   out_specs=(P(axis), P(axis)), check_vma=False)
+    return fn(stacked, error_feedback.astype(jnp.float32))
 
 
 __all__ = ["quantized_allreduce", "quantized_allreduce_shard",
+           "quantized_allgather_shard", "quantized_reducescatter_shard",
            "local_roundtrip"]

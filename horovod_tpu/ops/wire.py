@@ -1,0 +1,352 @@
+"""Unified block-scaled wire codec registry (EQuARX-style, PAPERS.md:
+"EQuARX: Efficient Quantized AllReduce in XLA").
+
+Every low-precision wire the framework speaks — the ring allreduce
+(ops/quantized.py), the cast-wire compressors (ops/compression.py), the
+grouped reduce-scatter/allgather (ops/collectives.py), the hierarchical
+DCN hop (parallel/hierarchical.py), and the ZeRO-1 param allgather
+(parallel/optimizer.py) — resolves its wire-format string HERE, so a
+format exists exactly once and an unknown name fails loudly everywhere.
+
+Codec families:
+
+* ``none`` — identity; the exact f32/native wire.
+* cast wires (``fp16``/``bf16``) — ``cast_dtype`` is set; a psum /
+  psum_scatter / all_gather can ride the wire dtype directly because
+  the dtype can absorb the summation.
+* cooperative wires (``int8``/``int4``/``fp8_e4m3``/``fp8_e5m2``) —
+  1-byte-or-less payloads that CANNOT be a pre-collective cast (int8
+  payloads under different scales don't sum; fp8 e4m3 saturates at
+  ±448), so collectives compose with ``encode``/``decode`` around f32
+  accumulation (the quantized ring in ops/quantized.py).
+
+All cooperative codecs are block-scaled: f32 max-abs scales per
+``_BLOCK`` = 128 elements, shipped alongside the payload.  ``int4`` is
+nibble-packed — two 4-bit two's-complement values per int8 byte, 0.5
+bytes/element on the wire.
+
+Error feedback: ``encode``→``decode`` is deterministic, so a sender can
+keep ``v - decode(encode(v))`` as a residual and add it to the next
+step's input; the quantized ring (quantized_allreduce_shard) does this
+per hop and the conservation identity is tested exactly.
+
+The per-bucket wire POLICY lives here too: ``WirePolicy`` maps a
+gradient bucket's (byte size, dtype class) to a codec name, parsed from
+``HOROVOD_WIRE_POLICY`` ("auto", "exact", or explicit
+``big=int4,small=none,threshold=1048576``), with the size threshold
+autotunable (``wire_threshold`` knob).  See docs/WIRE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..common import util
+from ..common.exceptions import HorovodTpuError
+
+#: Quantization block (elements) for the block-scaled codecs;
+#: lane-width aligned.  One f32 scale ships per block.
+_BLOCK = 128
+
+
+# ---------------------------------------------------------------------------
+# Codec primitives (moved from ops/quantized.py; quantized.py re-exports
+# _quant/_dequant for compatibility)
+# ---------------------------------------------------------------------------
+
+def _quant(v: jax.Array):
+    """v: (L,) f32 with L % _BLOCK == 0 → (q int8 (L,), scales f32
+    (L/_BLOCK,))."""
+    blocks = v.reshape(-1, _BLOCK)
+    scale = jnp.max(jnp.abs(blocks), axis=1) / 127.0
+    scale = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale[:, None]), -127, 127)
+    return q.astype(jnp.int8).reshape(-1), scale
+
+
+def _dequant(q: jax.Array, scale: jax.Array):
+    blocks = q.astype(jnp.float32).reshape(-1, _BLOCK)
+    return (blocks * scale[:, None]).reshape(-1)
+
+
+def _int4_encode(v: jax.Array):
+    """Nibble-packed int4: blockwise max-abs scales over ±7 levels, then
+    two 4-bit two's-complement values per uint8 byte (element 2k in the
+    low nibble, 2k+1 in the high) — 0.5 payload bytes per element.
+    _BLOCK is even, so a whole number of bytes per block."""
+    blocks = v.reshape(-1, _BLOCK)
+    scale = jnp.max(jnp.abs(blocks), axis=1) / 7.0
+    scale = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale[:, None]), -7, 7)
+    q = q.astype(jnp.int8).reshape(-1)
+    u = q.astype(jnp.uint8) & 0xF          # two's-complement nibble
+    packed = u[0::2] | (u[1::2] << 4)
+    return packed, scale
+
+
+def _int4_decode(packed: jax.Array, scale: jax.Array):
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8)
+    # Sign-extend the 4-bit two's-complement nibbles.
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    q = jnp.stack([lo, hi], axis=1).reshape(-1)
+    return _dequant(q, scale)
+
+
+def _fp8_encode(v: jax.Array, dt):
+    """Blockwise-normalized fp8: scale each block by its max-abs so the
+    payload sits in [-1, 1] — partial sums on later ring hops would
+    otherwise exceed e4m3's ±448 finite range and NaN.  Decoding is
+    `_dequant` (payload * blockwise scale), shared with int8."""
+    blocks = v.reshape(-1, _BLOCK)
+    scale = jnp.max(jnp.abs(blocks), axis=1)
+    scale = jnp.where(scale > 0, scale, 1.0)
+    q = (blocks / scale[:, None]).astype(dt)
+    return q.reshape(-1), scale
+
+
+# ---------------------------------------------------------------------------
+# The registry
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class WireCodec:
+    """One wire format: `encode` maps a flat f32 vector (length a
+    multiple of _BLOCK) to a tuple of wire arrays (payload first, then
+    any scales); `decode` inverts it back to f32.  `payload_bits` is
+    wire bits per element EXCLUDING the per-block scale overhead
+    (`wire_nbytes` accounts for both).  `cast_dtype` is non-None for
+    cast wires only — the formats a psum/psum_scatter/all_gather can
+    ride directly."""
+
+    name: str
+    payload_bits: int
+    encode: Callable[[jax.Array], Tuple[jax.Array, ...]]
+    decode: Callable[[Tuple[jax.Array, ...]], jax.Array]
+    cast_dtype: Optional[jnp.dtype] = None
+
+    @property
+    def exact(self) -> bool:
+        return self.name == "none"
+
+    @property
+    def cooperative(self) -> bool:
+        """True for formats needing f32 accumulation around the wire
+        (the ring collective); False for none and the cast wires."""
+        return self.cast_dtype is None and not self.exact
+
+    def scale_bytes(self, n_elements: int) -> int:
+        """Per-block f32 scale overhead for an n-element payload."""
+        if not self.cooperative:
+            return 0
+        return 4 * (-(-n_elements // _BLOCK))
+
+    def wire_nbytes(self, n_elements: int) -> int:
+        """Total wire bytes for n elements: payload + scales."""
+        return (n_elements * self.payload_bits + 7) // 8 \
+            + self.scale_bytes(n_elements)
+
+
+_REGISTRY: Dict[str, WireCodec] = {}
+
+
+def _register(codec: WireCodec) -> WireCodec:
+    _REGISTRY[codec.name] = codec
+    return codec
+
+
+def _cast_codec(name: str, dt) -> WireCodec:
+    return WireCodec(
+        name=name, payload_bits=16, cast_dtype=dt,
+        encode=lambda v, _dt=dt: (v.astype(_dt),),
+        decode=lambda p: p[0].astype(jnp.float32))
+
+
+NONE = _register(WireCodec(
+    name="none", payload_bits=32,
+    encode=lambda v: (v,), decode=lambda p: p[0]))
+FP16 = _register(_cast_codec("fp16", jnp.float16))
+BF16 = _register(_cast_codec("bf16", jnp.bfloat16))
+INT8 = _register(WireCodec(
+    name="int8", payload_bits=8,
+    encode=_quant, decode=lambda p: _dequant(*p)))
+INT4 = _register(WireCodec(
+    name="int4", payload_bits=4,
+    encode=_int4_encode, decode=lambda p: _int4_decode(*p)))
+FP8_E4M3 = _register(WireCodec(
+    name="fp8_e4m3", payload_bits=8,
+    encode=lambda v: _fp8_encode(v, jnp.float8_e4m3fn),
+    decode=lambda p: _dequant(*p)))
+FP8_E5M2 = _register(WireCodec(
+    name="fp8_e5m2", payload_bits=8,
+    encode=lambda v: _fp8_encode(v, jnp.float8_e5m2),
+    decode=lambda p: _dequant(*p)))
+
+
+def wire_names() -> Tuple[str, ...]:
+    """Every registered codec name, sorted."""
+    return tuple(sorted(_REGISTRY))
+
+
+def cast_wire_names() -> Tuple[str, ...]:
+    """The cast-wire subset — formats a psum/psum_scatter pair can
+    reduce in directly (parallel/hierarchical.py scatter legs, the
+    fused ZeRO-1 allgather)."""
+    return tuple(sorted(n for n, c in _REGISTRY.items()
+                        if c.cast_dtype is not None))
+
+
+def get_codec(wire: Optional[str]) -> WireCodec:
+    """Resolve a wire-format string; `None` (and "none") is the exact
+    codec.  Raises `HorovodTpuError` naming the valid formats on an
+    unknown string — the ONE failure path every consumer shares."""
+    if wire is None:
+        return NONE
+    codec = _REGISTRY.get(wire)
+    if codec is None:
+        raise HorovodTpuError(
+            f"unknown wire format {wire!r}: valid formats are "
+            f"{', '.join(wire_names())} (see docs/WIRE.md)")
+    return codec
+
+
+def compressor_wire(compression) -> str:
+    """The wire name a Compressor class speaks: its `wire` attribute
+    (every compressor in ops/compression.py carries one), validated
+    against the registry."""
+    name = getattr(compression, "wire", None)
+    if name is None:
+        # Third-party Compressor subclass without a wire name: treat as
+        # an opaque exact-path transform.
+        return "none"
+    return get_codec(name).name
+
+
+def local_roundtrip(v: jax.Array, wire: str = "int8") -> jax.Array:
+    """encode→decode through the local codec (same blockwise scales the
+    ring's first hop uses) — the compression operator C whose error
+    error-feedback carries to the next step."""
+    codec = get_codec(wire)
+    flat = v.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % _BLOCK
+    padded = jnp.pad(flat, (0, pad))
+    out = codec.decode(codec.encode(padded))[: flat.size]
+    return out.reshape(v.shape).astype(v.dtype) if codec.cast_dtype \
+        else out.reshape(v.shape)
+
+
+# ---------------------------------------------------------------------------
+# Per-bucket wire policy
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class WirePolicy:
+    """Maps a gradient bucket to a codec name by byte size and dtype
+    class: all-float buckets of >= `threshold_bytes` raw bytes ride
+    `big`, smaller ones ride `small`; buckets containing any integer
+    leaf stay exact regardless (counters must sum exactly).
+    `threshold_bytes=None` defers to the live autotuner/env value
+    (`current_wire_threshold`) at classification time, so the tuned
+    knob takes effect on the next retrace."""
+
+    big: str = "none"
+    small: str = "none"
+    threshold_bytes: Optional[int] = None
+
+    @property
+    def exact(self) -> bool:
+        return self.big == "none" and self.small == "none"
+
+    def _threshold(self) -> int:
+        if self.threshold_bytes is not None:
+            return self.threshold_bytes
+        from ..utils.autotune import current_wire_threshold
+        return current_wire_threshold()
+
+    def codec_for(self, nbytes: int, all_float: bool) -> str:
+        if not all_float:
+            return "none"
+        return self.big if nbytes >= self._threshold() else self.small
+
+
+#: What "auto" means: large (fc/embedding-class) buckets ride the int8
+#: ring with blockwise scales — 4x fewer wire bytes with the most
+#: magnitude-robust 1-byte format — while small norm/bias buckets stay
+#: exact.  int4 is opt-in via the explicit grammar (big=int4,...).
+_AUTO_BIG = "int8"
+
+
+def parse_wire_policy(spec: str) -> WirePolicy:
+    """Parse a HOROVOD_WIRE_POLICY spec:
+
+    * ``"exact"`` — every bucket exact (bitwise-equal to the unwired
+      pipeline);
+    * ``"auto"`` — big buckets ride int8, small stay exact, with the
+      threshold from the autotuner/env (`wire_threshold` knob);
+    * explicit ``key=value`` pairs: ``big=<codec>``, ``small=<codec>``,
+      ``threshold=<bytes>`` (e.g. ``big=int4,small=none,
+      threshold=1048576``); omitted keys default to big=int8,
+      small=none, threshold=autotuned.
+
+    Unknown codec names and malformed pairs raise `HorovodTpuError`.
+    """
+    spec = spec.strip()
+    if spec == "exact":
+        return WirePolicy()
+    if spec == "auto":
+        return WirePolicy(big=_AUTO_BIG, small="none")
+    big, small, threshold = _AUTO_BIG, "none", None
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise HorovodTpuError(
+                f"bad HOROVOD_WIRE_POLICY entry {part!r}: expected "
+                "'exact', 'auto', or comma-separated key=value pairs "
+                "(big=, small=, threshold=; see docs/WIRE.md)")
+        key, _, val = part.partition("=")
+        key, val = key.strip(), val.strip()
+        if key == "big":
+            big = get_codec(val).name
+        elif key == "small":
+            small = get_codec(val).name
+        elif key == "threshold":
+            try:
+                threshold = int(val)
+            except ValueError:
+                raise HorovodTpuError(
+                    f"bad HOROVOD_WIRE_POLICY threshold {val!r}: "
+                    "expected an integer byte count") from None
+        else:
+            raise HorovodTpuError(
+                f"unknown HOROVOD_WIRE_POLICY key {key!r}: valid keys "
+                "are big, small, threshold (see docs/WIRE.md)")
+    return WirePolicy(big=big, small=small, threshold_bytes=threshold)
+
+
+def policy_from_env() -> Optional[WirePolicy]:
+    """The active per-bucket policy, or None when HOROVOD_WIRE_POLICY
+    is unset (the `compression=` argument alone governs the wire)."""
+    spec = util.getenv("WIRE_POLICY")
+    if not spec:
+        return None
+    return parse_wire_policy(spec)
+
+
+__all__ = [
+    "WireCodec",
+    "WirePolicy",
+    "cast_wire_names",
+    "compressor_wire",
+    "get_codec",
+    "local_roundtrip",
+    "parse_wire_policy",
+    "policy_from_env",
+    "wire_names",
+]

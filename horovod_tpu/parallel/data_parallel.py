@@ -26,11 +26,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from ..common import basics
+from ..common import basics, util
 from ..common.basics import GLOBAL_AXIS, ProcessSet
 from ..metrics import catalog as _met
 from ..ops import collectives as C
-from ..ops.compression import Compression
+from ..ops import wire as _wire
+from ..ops.compression import Compression, NoneCompressor
+from ..utils import timeline as _tl
 
 
 def shard_batch(batch: Any, mesh: Optional[Mesh] = None) -> Any:
@@ -144,6 +146,63 @@ def gradient_bucket_partition(
             _buckets_by_nbytes(nbytes, _cap(nbytes), bucket_order) if b]
 
 
+def active_wire_policy(compression=Compression.none,
+                       process_set: Optional[ProcessSet] = None):
+    """The per-bucket wire policy the gradient reduction will apply, or
+    None: HOROVOD_WIRE_POLICY engages only on the uncompressed global
+    reduction (an explicit `compression=` always wins, and the
+    cooperative ring spans the whole axis so process-set subsets stay
+    exact), and "exact" deactivates it entirely — that path must stay
+    bitwise-identical to the unwired pipeline."""
+    if process_set is not None:
+        return None
+    if not (isinstance(compression, type)
+            and issubclass(compression, NoneCompressor)):
+        return None
+    policy = _wire.policy_from_env()
+    if policy is None or policy.exact:
+        return None
+    return policy
+
+
+def wire_policy_plan(
+    leaves: Sequence[Any],
+    policy: Optional[_wire.WirePolicy] = None,
+    fusion_threshold_bytes: Optional[int] = None,
+    bucket_order=None,
+) -> list:
+    """The per-bucket wire assignment the policy produces for `leaves`:
+    a list of `(indices, wire_name, raw_bytes, wire_bytes)` tuples over
+    the same partition `reduce_gradient_buckets` uses (compression=none
+    — the policy path).  `policy=None` reads HOROVOD_WIRE_POLICY; an
+    inactive policy plans every bucket exact.  Pure bookkeeping (shapes
+    and dtypes only) — usable from bench/tests without a mesh."""
+    if policy is None:
+        policy = _wire.policy_from_env() or _wire.WirePolicy()
+    parts = gradient_bucket_partition(
+        leaves, compression=Compression.none,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        bucket_order=bucket_order)
+    plan = []
+    for idxs in parts:
+        all_float = all(jnp.issubdtype(leaves[i].dtype, jnp.floating)
+                        for i in idxs)
+        raw = sum(leaves[i].size * leaves[i].dtype.itemsize for i in idxs)
+        name = policy.codec_for(raw, all_float)
+        codec = _wire.get_codec(name)
+        if codec.exact:
+            wire_bytes = raw
+        elif codec.cast_dtype is not None:
+            wire_bytes = sum(
+                leaves[i].size * jnp.dtype(codec.cast_dtype).itemsize
+                for i in idxs)
+        else:
+            wire_bytes = codec.wire_nbytes(
+                sum(leaves[i].size for i in idxs))
+        plan.append((idxs, codec.name, raw, wire_bytes))
+    return plan
+
+
 def reduce_gradient_buckets(
     leaves: Sequence[Any],
     op: C.ReduceOp = C.Average,
@@ -166,15 +225,27 @@ def reduce_gradient_buckets(
     (which reassembles the full tree) and the per-bucket fused optimizer
     apply (parallel/optimizer.py, which consumes each bucket the moment
     its reduction exists instead of barriering on all of them).
+
+    When HOROVOD_WIRE_POLICY is set (and `compression` is none), each
+    bucket rides the codec the policy picks for its byte size and dtype
+    class — large all-float buckets at int8/int4 with optional error
+    feedback, integer or small buckets exact (see docs/WIRE.md and
+    `active_wire_policy`).
     """
     from ..ops.compression import _CooperativeCompressor
     _cooperative = (isinstance(compression, type) and
                     issubclass(compression, _CooperativeCompressor))
-    if error_feedback_leaves is not None and not _cooperative:
+    # Per-bucket wire policy: in-jit only (the cooperative ring needs
+    # the mesh axis in scope; the eager path always reduces exactly).
+    policy = (active_wire_policy(compression, process_set)
+              if axis_name is not None else None)
+    if error_feedback_leaves is not None and not (_cooperative
+                                                  or policy is not None):
         raise ValueError(
             "error_feedback_state only applies to the quantized wire "
-            "formats (Compression.int8 / fp8_*) — exact and fp16/bf16 "
-            "wires have no compression error to feed back")
+            "formats (Compression.int8 / int4 / fp8_*, or a quantizing "
+            "HOROVOD_WIRE_POLICY) — exact and fp16/bf16 wires have no "
+            "compression error to feed back")
     parts = gradient_bucket_partition(
         leaves, compression=compression,
         fusion_threshold_bytes=fusion_threshold_bytes,
@@ -258,6 +329,101 @@ def reduce_gradient_buckets(
             results.append((idxs, outs))
         return results, (new_ef if error_feedback_leaves is not None
                          else None)
+    if policy is not None:
+        if op not in (C.Average, C.Sum):
+            raise ValueError(
+                f"HOROVOD_WIRE_POLICY supports op=Average or Sum, got "
+                f"{op}; unset the policy for other reductions")
+        from ..ops.quantized import quantized_allreduce_shard
+
+        float_ord = {}
+        for i, t in enumerate(leaves):
+            if jnp.issubdtype(t.dtype, jnp.floating):
+                float_ord[i] = len(float_ord)
+        if error_feedback_leaves is not None and \
+                len(error_feedback_leaves) != len(float_ord):
+            raise ValueError(
+                f"error_feedback_state has {len(error_feedback_leaves)} "
+                f"leaves; expected one per float gradient leaf "
+                f"({len(float_ord)}) — build it with "
+                f"error_feedback_init(grads)")
+        # Exact/cast buckets drop nothing — their residuals pass through
+        # unchanged (zeros stay zeros); cooperative buckets overwrite
+        # their entries below.
+        new_ef = (list(error_feedback_leaves)
+                  if error_feedback_leaves is not None else None)
+        tl = _tl.get_timeline()
+        traced = any(isinstance(l, jax.core.Tracer) for l in leaves)
+        results = []
+        raw_total = wire_total = 0
+        fmt_bytes: dict = {}
+        for k, idxs in enumerate(parts):
+            all_float = all(i in float_ord for i in idxs)
+            raw = sum(leaves[i].size * leaves[i].dtype.itemsize
+                      for i in idxs)
+            codec = _wire.get_codec(policy.codec_for(raw, all_float))
+            nelem = sum(leaves[i].size for i in idxs)
+            if codec.exact:
+                wbytes = raw
+                outs = list(C.grouped_allreduce(
+                    [leaves[i] for i in idxs], op=op,
+                    axis_name=axis_name))
+            elif codec.cast_dtype is not None:
+                wbytes = nelem * jnp.dtype(codec.cast_dtype).itemsize
+                reduced = C.grouped_allreduce(
+                    [leaves[i].astype(codec.cast_dtype) for i in idxs],
+                    op=op, axis_name=axis_name)
+                outs = [r.astype(leaves[i].dtype)
+                        for i, r in zip(idxs, reduced)]
+            else:
+                wbytes = codec.wire_nbytes(nelem)
+                flat = jnp.concatenate(
+                    [leaves[i].astype(jnp.float32).reshape(-1)
+                     for i in idxs])
+                if error_feedback_leaves is not None:
+                    ef_flat = jnp.concatenate(
+                        [error_feedback_leaves[float_ord[i]].reshape(-1)
+                         for i in idxs])
+                    reduced, err = quantized_allreduce_shard(
+                        flat, axis_name, average=(op is C.Average),
+                        wire=codec.name, error_feedback=ef_flat)
+                else:
+                    reduced = quantized_allreduce_shard(
+                        flat, axis_name, average=(op is C.Average),
+                        wire=codec.name)
+                outs = []
+                offset = 0
+                for i in idxs:
+                    n = leaves[i].size
+                    outs.append(reduced[offset:offset + n]
+                                .reshape(leaves[i].shape)
+                                .astype(leaves[i].dtype))
+                    if error_feedback_leaves is not None:
+                        new_ef[float_ord[i]] = err[offset:offset + n] \
+                            .reshape(leaves[i].shape)
+                    offset += n
+            raw_total += raw
+            wire_total += wbytes
+            fmt_bytes[codec.name] = fmt_bytes.get(codec.name, 0) + wbytes
+            if tl is not None:
+                # Host-side per-bucket wire label — once per compile for
+                # traced steps, matching the trace-time gauge idiom.
+                tl.instant(f"wire_bucket_{k}", category="wire",
+                           args={"format": codec.name,
+                                 "leaves": len(idxs), "raw_bytes": raw,
+                                 "wire_bytes": wbytes})
+            results.append((idxs, outs))
+        if _met.enabled():
+            if traced:
+                # Static per-step savings, recorded at trace time like
+                # hvd_grad_bytes_per_step (counting here per call would
+                # count compiles, not steps).
+                _met.wire_bytes_saved_per_step.set(raw_total - wire_total)
+                for fmt, b in fmt_bytes.items():
+                    _met.wire_format_bytes.labels(fmt).set(b)
+            else:
+                _met.wire_bytes_saved.inc(raw_total - wire_total)
+        return results, new_ef
     compressed, ctxs = [], []
     for leaf in leaves:
         c, ctx = compression.compress(leaf)
@@ -478,13 +644,17 @@ def data_parallel(
 
     def _autotune_key():
         from ..utils import autotune as _at
+        # The wire policy is read from the environment at trace time, so
+        # a spec change (tests/operators flipping HOROVOD_WIRE_POLICY
+        # between steps) must retrace just like a knob proposal.
+        wire_spec = util.getenv("WIRE_POLICY")
         pm = _at.get_manager()
         if pm is None:
-            return None
+            return (wire_spec,) if wire_spec else None
         # ALL live knob values (fusion threshold, bucket order, min
         # buckets, ...): any proposal the tuner applies must force a
         # retrace, or the step keeps running the old bucketing.
-        return tuple(pm.values().items())
+        return (wire_spec, tuple(pm.values().items()))
 
     def _autotune_record(args):
         from ..utils import autotune as _at

@@ -27,18 +27,25 @@ from jax import lax
 
 from ..common import util
 from ..common.exceptions import HorovodTpuError
+from ..ops import wire as _wire
 
 
 def _env_dcn_wire(dtype, average: bool):
     """Env-driven wire for a leaf: only float dtypes (integers must sum
     exactly) and only averaging semantics (quantized transport is
     documented as not-for-exact-sums; explicit hierarchical_allreduce
-    calls can still pass dcn_wire= deliberately)."""
+    calls can still pass dcn_wire= deliberately).  The name is resolved
+    through the ops/wire.py registry so a typo'd
+    HOROVOD_HIERARCHICAL_DCN_WIRE fails loudly, naming valid formats."""
     if not average:
         return None
     if not jnp.issubdtype(dtype, jnp.floating):
         return None
-    return util.getenv("HIERARCHICAL_DCN_WIRE") or None
+    spec = util.getenv("HIERARCHICAL_DCN_WIRE") or None
+    if spec is None:
+        return None
+    codec = _wire.get_codec(spec)
+    return None if codec.exact else codec.name
 
 
 def enabled() -> bool:
@@ -102,35 +109,24 @@ def hierarchical_reduce_leaf(x, dcn_axis: str, ici_axis: str, average: bool,
     return out
 
 
-_CAST_WIRES = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
-
-
-def _cast_wire_dtype(wire: str):
-    """bf16/fp16 cast wires only: the scatter/gather pair reduces in the
-    wire dtype directly, so the 1-byte cooperative formats (int8/fp8 —
-    which need f32 accumulation per hop) cannot ride it."""
-    try:
-        return _CAST_WIRES[wire]
-    except KeyError:
-        raise HorovodTpuError(
-            f"unsupported scatter/gather wire {wire!r}: quantized wires "
-            "(int8/fp8) ride the ring allreduce, not the reduce-scatter/"
-            "allgather pair; use 'bf16' or 'fp16'") from None
-
-
 def hierarchical_reduce_scatter(flat, dcn_axis: str, ici_axis: str,
                                 dcn_wire: Optional[str] = None):
     """Two-level reduce-scatter of a FLAT buffer (Sum semantics): ICI
     psum-scatter first — the full payload rides the fast tier — then a
-    DCN psum-scatter of the 1/n_ici shard, optionally cast to a
-    low-precision wire ("bf16" | "fp16") for the slow hop only.  Each
-    element crosses DCN once, at 1/n_ici of the flat-ring volume and at
-    wire width when `dcn_wire` is set (the ICI legs stay exact).
+    DCN psum-scatter of the 1/n_ici shard, optionally at a
+    low-precision wire for the slow hop only.  `dcn_wire` names any
+    codec in the ops/wire.py registry: cast wires ("bf16"/"fp16")
+    reduce in the wire dtype directly; cooperative wires (int8 / int4 /
+    fp8) ride the block-scaled ring with f32 accumulation
+    (quantized_reducescatter_shard).  Each element crosses DCN once, at
+    1/n_ici of the flat-ring volume and at wire width (the ICI legs
+    stay exact).
 
     Ownership is DCN-MAJOR: the rank at (dcn=d, ici=i) returns flat
     segment `d*n_ici + i` — the same enumeration
     `hierarchical_all_gather` (ICI gather then DCN gather) reassembles.
     `flat.size` must be divisible by n_ici*n_dcn; callers pad."""
+    codec = _wire.get_codec(dcn_wire)
     n_ici = lax.axis_size(ici_axis)
     n_dcn = lax.axis_size(dcn_axis)
     total = n_ici * n_dcn
@@ -144,9 +140,14 @@ def hierarchical_reduce_scatter(flat, dcn_axis: str, ici_axis: str,
     # i-th (n_dcn*seg)-block, which must hold segments {d*n_ici+i}_d.
     f2 = flat.reshape(n_dcn, n_ici, seg).swapaxes(0, 1).reshape(-1)
     a = lax.psum_scatter(f2, ici_axis, tiled=True)
-    if dcn_wire:
-        wt = _cast_wire_dtype(dcn_wire)
-        a = lax.psum_scatter(a.astype(wt), dcn_axis,
+    if codec.cooperative:
+        from ..ops.quantized import quantized_reducescatter_shard
+
+        a = quantized_reducescatter_shard(
+            a.astype(jnp.float32), dcn_axis,
+            wire=codec.name).astype(flat.dtype)
+    elif not codec.exact:
+        a = lax.psum_scatter(a.astype(codec.cast_dtype), dcn_axis,
                              tiled=True).astype(flat.dtype)
     else:
         a = lax.psum_scatter(a, dcn_axis, tiled=True)

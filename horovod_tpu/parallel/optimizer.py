@@ -29,15 +29,20 @@ from ..common.basics import GLOBAL_AXIS, ProcessSet
 from ..common.exceptions import HorovodTpuError
 from ..metrics import catalog as _met
 from ..ops import collectives as C
+from ..ops import wire as _wire
 from ..ops.compression import Compression, _CooperativeCompressor
+from ..ops.quantized import quantized_allgather_shard
 from . import hierarchical as _hier
 from .data_parallel import (allreduce_gradients, gradient_bucket_partition,
                             reduce_gradient_buckets)
 
-# Wire dtypes accepted on the sharded param allgather (cast wires only:
-# the 1-byte cooperative formats need f32 ring accumulation and have no
-# scatter/gather form).
-SHARD_WIRES = ("bf16", "fp16")
+# Wire formats whose scatter/gather collectives reduce in the wire dtype
+# directly — derived from the ops/wire.py registry, not restated here.
+# Cooperative formats (int8/int4/fp8) are ALSO accepted for
+# `allgather_wire` on a flat axis: the param allgather accumulates
+# nothing through the wire, so the block-scaled payload gather is safe
+# (masters stay exact f32 on their owner).
+SHARD_WIRES = _wire.cast_wire_names()
 
 
 class DistributedOptState(NamedTuple):
@@ -56,18 +61,6 @@ class _ShardSlot(NamedTuple):
     copy must survive the wire round-trip."""
     state: Any
     master: Any
-
-
-def _wire_name(compression) -> Optional[str]:
-    """Cast-compressor → scatter wire name ("fp16"/"bf16"); None for
-    Compression.none.  Cooperative compressors are rejected before this
-    is consulted."""
-    wd = getattr(compression, "wire_dtype", None)
-    if wd is jnp.float16:
-        return "fp16"
-    if wd is jnp.bfloat16:
-        return "bf16"
-    return None
 
 
 def optimizer_state_bytes(state) -> int:
@@ -161,12 +154,17 @@ def DistributedGradientTransformation(
     2-tuple `axis_name` ("dcn", ici) the reduce-scatter runs two-level
     (ICI psum-scatter + DCN hop at the compression wire width).
 
-    `allgather_wire` ("bf16" | "fp16", env: HOROVOD_SHARD_AG_WIRE)
-    casts the param allgather to a low-precision wire while fp32 master
-    shards stay exact on their owner rank: the inner state and masters
-    live in f32, each step allgathers wire(new_master) and reconstructs
-    the update as wire(new_master) - param, so wire error never
-    accumulates (the master is the integration variable)."""
+    `allgather_wire` (any codec in the ops/wire.py registry, env:
+    HOROVOD_SHARD_AG_WIRE) ships the param allgather at a low-precision
+    wire while fp32 master shards stay exact on their owner rank: the
+    inner state and masters live in f32, each step allgathers
+    wire(new_master) and reconstructs the update as wire(new_master) -
+    param, so wire error never accumulates (the master is the
+    integration variable).  Cast wires ("bf16"/"fp16") ride
+    `lax.all_gather` in the wire dtype; cooperative wires (int8 / int4 /
+    fp8_*) ride the block-scaled payload gather — flat axis only (the
+    ring spans one named axis, so a 2-tuple hierarchical axis needs a
+    cast wire)."""
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
     if op is C.Adasum and (fused_apply or early_reduction):
@@ -178,6 +176,10 @@ def DistributedGradientTransformation(
         shard_optimizer_states = util.env_bool("SHARD_OPTIMIZER", False)
     if allgather_wire is None:
         allgather_wire = util.getenv("SHARD_AG_WIRE") or None
+    # Resolve through the unified registry: unknown names raise
+    # HorovodTpuError listing the valid formats, and "none" means unset.
+    _ag_codec = _wire.get_codec(allgather_wire)
+    allgather_wire = None if _ag_codec.exact else _ag_codec.name
     if shard_optimizer_states:
         if op not in (C.Average, C.Sum):
             raise ValueError(
@@ -194,13 +196,18 @@ def DistributedGradientTransformation(
                 compression, _CooperativeCompressor):
             raise ValueError(
                 f"Compression.{compression.wire} has no reduce-scatter "
-                "form (1-byte wires need f32 ring accumulation per "
-                "hop); use Compression.fp16/bf16 with "
+                "form here (the sharded path carries no error-feedback "
+                "residual, and the lossy ring error would bias every "
+                "step); use Compression.fp16/bf16 with "
                 "shard_optimizer_states")
-        if allgather_wire not in (None,) + SHARD_WIRES:
+        if (_ag_codec.cooperative
+                and isinstance(axis_name, (tuple, list))
+                and len(axis_name) == 2):
             raise ValueError(
-                f"allgather_wire must be one of {SHARD_WIRES}, got "
-                f"{allgather_wire!r}")
+                f"allgather_wire={_ag_codec.name!r} rides the ring "
+                "payload gather, which spans ONE named axis — with a "
+                "hierarchical 2-tuple axis_name use a cast wire "
+                f"({', '.join(SHARD_WIRES)}) instead")
         if process_set is not None and process_set.process_set_id != 0:
             raise ValueError(
                 "shard_optimizer_states requires the global process "
@@ -328,9 +335,10 @@ def DistributedGradientTransformation(
             n_now = lax.axis_size(ax)
             idx = lax.axis_index(ax)
             gather_axes = ax
-        rs_wire = _wire_name(compression)
-        ag_wt = _hier._CAST_WIRES[allgather_wire] if allgather_wire \
-            else None
+        rs_codec = _wire.get_codec(_wire.compressor_wire(compression))
+        rs_wire = None if rs_codec.exact else rs_codec.name
+        ag_codec = _wire.get_codec(allgather_wire)
+        ag_wt = ag_codec.cast_dtype
         fuse_ag = bool(current_ag_fusion())
         out = [None] * len(leaves)
         new_inner = [None] * len(groups)
@@ -402,7 +410,7 @@ def DistributedGradientTransformation(
                 if op is C.Average:
                     g_shard = (g_shard / n_now).astype(dt)
                 rs_bytes += padded * jnp.dtype(
-                    _hier._CAST_WIRES[rs_wire] if rs_wire else dt).itemsize
+                    rs_codec.cast_dtype or dt).itemsize
             else:
                 c, ctx = compression.compress(flat)
                 if padn:
@@ -429,7 +437,9 @@ def DistributedGradientTransformation(
                 u_shard, new_row_state = optimizer.update(
                     g_shard.astype(jnp.float32), row_state, m_row)
                 new_m = m_row + u_shard  # exact f32 on the owner rank
-                send = new_m.astype(ag_wt)
+                # Cast wires ship the cast; cooperative wires encode at
+                # gather time (block-scaled payload), so send stays f32.
+                send = new_m.astype(ag_wt) if ag_wt is not None else new_m
                 new_master = _restack(new_m)
 
                 def _finish(full, idxs=idxs, sizes=sizes, shapes=shapes,
@@ -454,12 +464,17 @@ def DistributedGradientTransformation(
 
             new_inner[gi] = _ShardSlot(_restack(new_row_state),
                                        new_master)
-            ag_bytes += padded * jnp.dtype(send.dtype).itemsize
+            ag_bytes += (n_now * ag_codec.wire_nbytes(shard_sz)
+                         if ag_codec.cooperative
+                         else padded * jnp.dtype(send.dtype).itemsize)
             if fuse_ag:
                 pending.append((send, _finish))
             elif hier:
                 _finish(_hier.hierarchical_all_gather(
                     send, dcn_ax, ici_ax))
+            elif ag_codec.cooperative:
+                _finish(quantized_allgather_shard(
+                    send, ax, wire=ag_codec.name))
             else:
                 _finish(lax.all_gather(send, ax, tiled=True))
 
@@ -470,7 +485,15 @@ def DistributedGradientTransformation(
             for _, items in by_dt.items():
                 cat = (jnp.concatenate([s for s, _ in items])
                        if len(items) > 1 else items[0][0])
-                stacked = lax.all_gather(cat, gather_axes, tiled=False)
+                if ag_codec.cooperative:
+                    # Non-hier guaranteed (validated at construction):
+                    # one block-scaled payload gather for the whole
+                    # fused buffer, reshaped to the (n, W) band layout.
+                    stacked = quantized_allgather_shard(
+                        cat, ax, wire=ag_codec.name).reshape(n_now, -1)
+                else:
+                    stacked = lax.all_gather(cat, gather_axes,
+                                             tiled=False)
                 # stacked: (n_ranks, sum_of_shards); group g's full
                 # buffer is its column band flattened row-major.
                 off = 0

@@ -339,6 +339,14 @@ def init_from_env() -> Optional[ParameterManager]:
     pm.register("ag_fusion", 0, 1, integer=True,
                 initial=1 if util.env_bool("SHARD_AG_FUSION", False)
                 else 0)
+    # Wire-policy knob: the byte threshold above which a bucket rides the
+    # policy's "big" (quantized) wire format.  Only consulted when
+    # HOROVOD_WIRE_POLICY is set without an explicit threshold=, so the
+    # tuner can trade wire compression against quantization error
+    # per-bucket-class (see docs/WIRE.md).
+    pm.register("wire_threshold", 64 << 10, 64 << 20, log_scale=True,
+                integer=True,
+                initial=util.env_int("WIRE_THRESHOLD", 1 << 20))
     _manager = pm
     logger.info("autotune enabled: %s", pm.values())
     return pm
@@ -422,3 +430,19 @@ def current_fusion_threshold() -> int:
     torch hook buckets)."""
     return tuned_fusion_threshold(
         util.env_int("FUSION_THRESHOLD", 64 * 1024 * 1024))
+
+
+def tuned_wire_threshold(default: int) -> int:
+    """Wire-policy big/small byte threshold honoring the autotuner when
+    active (used by WirePolicy.codec_for)."""
+    if _manager is not None and "wire_threshold" in _manager._tunables:
+        return int(_manager.value("wire_threshold"))
+    return default
+
+
+def current_wire_threshold() -> int:
+    """The live wire-policy threshold: HOROVOD_WIRE_THRESHOLD (1 MB
+    default — buckets at or above it take the policy's "big" codec),
+    overridden by the autotuner when active.  Only consulted when the
+    HOROVOD_WIRE_POLICY spec omits an explicit threshold=."""
+    return tuned_wire_threshold(util.env_int("WIRE_THRESHOLD", 1 << 20))

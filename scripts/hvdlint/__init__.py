@@ -12,6 +12,7 @@ from .envvars import EnvVarRegistry
 from .excepts import ExceptionDiscipline
 from .locks import LockDiscipline
 from .purity import JitPurity
+from .wires import WireRegistry
 
 #: The suite, in the order lint_all runs it.  Adding an analyzer =
 #: append an instance here (see docs/STATIC_ANALYSIS.md).
@@ -22,8 +23,10 @@ ALL = [
     ExceptionDiscipline(),
     MetricsCatalog(),
     FaultPoints(),
+    WireRegistry(),
 ]
 
 __all__ = ["Analyzer", "Finding", "Project", "run_all", "ALL",
            "LockDiscipline", "JitPurity", "EnvVarRegistry",
-           "ExceptionDiscipline", "MetricsCatalog", "FaultPoints"]
+           "ExceptionDiscipline", "MetricsCatalog", "FaultPoints",
+           "WireRegistry"]

@@ -1,0 +1,93 @@
+"""Wire-codec registry drift analyzer.
+
+Every wire-format string the runtime accepts — ``wire=`` / ``dcn_wire=``
+/ ``allgather_wire=`` kwargs and defaults, compressor ``wire`` class
+attributes, ``get_codec("...")`` calls — must name a codec registered in
+``horovod_tpu/ops/wire.py``, and the codec table in ``docs/WIRE.md``
+must agree with the registry in both directions.  Pure text parsing
+(same CI-safe discipline as catalogs.py): no horovod_tpu import, works
+on partial trees.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from .core import Analyzer, Finding, Project
+
+WIRE_MODULE = "horovod_tpu/ops/wire.py"
+WIRE_DOC = "docs/WIRE.md"
+WIRE_PKG = "horovod_tpu"
+
+# Registration forms in wire.py: WireCodec(name="...") and the
+# positional-name _cast_codec("...") helper.
+_NAMED_RE = re.compile(r"WireCodec\(\s*\n?\s*name=\"([a-z0-9_]+)\"")
+_CAST_RE = re.compile(r"_cast_codec\(\"([a-z0-9_]+)\"")
+
+# Consumption forms anywhere in the package: wire-string kwargs/attrs
+# (with or without a type annotation) and direct registry lookups.
+_KWARG_RE = re.compile(
+    r"\b(?:wire|dcn_wire|allgather_wire)\s*"
+    r"(?::\s*[A-Za-z_\[\]\. ]+?)?=\s*\"([a-z0-9_]+)\"")
+_LOOKUP_RE = re.compile(r"get_codec\(\s*\"([a-z0-9_]+)\"")
+
+# docs/WIRE.md codec-table rows: | `name` | ...
+_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`", re.MULTILINE)
+
+
+class WireRegistry(Analyzer):
+    name = "wire-registry"
+    description = ("every wire-format string literal names a codec "
+                   "registered in ops/wire.py; docs/WIRE.md codec table "
+                   "matches the registry")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        root = project.root
+        mod_path = root / WIRE_MODULE
+        if not mod_path.is_file():
+            return [Finding(self.name, "error", WIRE_MODULE, 1,
+                            f"error: {WIRE_MODULE} missing")]
+        src = mod_path.read_text()
+        registered = set(_NAMED_RE.findall(src))
+        registered.update(_CAST_RE.findall(src))
+        if not registered:
+            return [Finding(self.name, "error", WIRE_MODULE, 1,
+                            f"error: no WireCodec registrations found in "
+                            f"{WIRE_MODULE} (parser out of date?)")]
+
+        pkg = root / WIRE_PKG
+        for path in sorted(pkg.rglob("*.py")) if pkg.is_dir() else []:
+            text = path.read_text()
+            rel = path.relative_to(root).as_posix()
+            for lineno, line in enumerate(text.splitlines(), 1):
+                for pat in (_KWARG_RE, _LOOKUP_RE):
+                    for name in pat.findall(line):
+                        if name not in registered:
+                            findings.append(Finding(
+                                self.name, "unknown-wire", rel, lineno,
+                                f"unknown wire format: {name!r} ({rel}:"
+                                f"{lineno}) is not registered in "
+                                f"{WIRE_MODULE} — valid: "
+                                f"{', '.join(sorted(registered))}"))
+
+        doc_path = root / WIRE_DOC
+        if not doc_path.is_file():
+            findings.append(Finding(
+                self.name, "error", WIRE_DOC, 1,
+                f"error: {WIRE_DOC} missing — every codec registered in "
+                f"{WIRE_MODULE} must be documented there"))
+            return findings
+        documented = set(_DOC_ROW_RE.findall(doc_path.read_text()))
+        for name in sorted(registered - documented):
+            findings.append(Finding(
+                self.name, "undocumented-codec", WIRE_MODULE, 1,
+                f"undocumented codec: {name} (registered in "
+                f"{WIRE_MODULE}, no table row in {WIRE_DOC})"))
+        for name in sorted(documented - registered):
+            findings.append(Finding(
+                self.name, "stale-doc-entry", WIRE_DOC, 1,
+                f"stale doc entry: {name} (listed in {WIRE_DOC}, not "
+                f"registered in {WIRE_MODULE})"))
+        return findings

@@ -14,19 +14,21 @@ import bench  # noqa: E402
 
 
 def _feed(monkeypatch, times):
-    """times: list of (t1, t8) per pair; the compute-only, legacy and
-    sharded pipeline probes of the extras block are fed the last pair's
-    t8."""
+    """times: list of (t1, t8) per pair; the compute-only, legacy,
+    sharded and quantized pipeline probes of the extras block are fed
+    the last pair's t8."""
     seq = []
     for t1, t8 in times:
         seq += [t1, t8]
     seq.append(times[-1][1])     # the compute-only probe
     seq.append(times[-1][1])     # the legacy-pipeline probe
     seq.append(times[-1][1])     # the sharded-pipeline probe
+    seq.append(times[-1][1])     # the quantized-wire probe
     it = iter(seq)
     monkeypatch.setattr(
         bench, "_run_sim",
-        lambda n, dist, timeout, legacy=False, sharded=False: next(it))
+        lambda n, dist, timeout, legacy=False, sharded=False,
+        quant=False: next(it))
 
 
 class TestSimScalingStats:
@@ -81,11 +83,13 @@ class TestSimScalingStats:
 
     def test_failed_pair_retried(self, monkeypatch):
         monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "3")
-        seq = [1.0, None, 1.0, 8.9, 1.0, 8.8, 1.0, 8.7, 8.5, 8.6, 8.6]
+        seq = [1.0, None, 1.0, 8.9, 1.0, 8.8, 1.0, 8.7,
+               8.5, 8.6, 8.6, 8.6]
         it = iter(seq)
         monkeypatch.setattr(
             bench, "_run_sim",
-            lambda n, dist, timeout, legacy=False, sharded=False: next(it))
+            lambda n, dist, timeout, legacy=False, sharded=False,
+            quant=False: next(it))
         median, spread, effs, ci, rejected, extras = \
             bench.sim_scaling_efficiency(runs=3)
         assert len(effs) == 3   # the failed attempt was retried
@@ -111,5 +115,6 @@ class TestSimScalingStats:
         it = iter(seq)
         monkeypatch.setattr(
             bench, "_run_sim",
-            lambda n, dist, timeout, legacy=False, sharded=False: next(it))
+            lambda n, dist, timeout, legacy=False, sharded=False,
+            quant=False: next(it))
         assert bench.sim_scaling_efficiency(runs=3) is None

@@ -728,3 +728,162 @@ def test_grouped_reducescatter_rejects_minmax():
     with pytest.raises(HorovodTpuError):
         hvd.grouped_reducescatter(
             [np.ones((N * 2,), np.float32)], op=hvd.Max)
+
+
+# ---------------------------------------------------------------------------
+# Wire-format kwargs on the grouped collectives (r6, ops/wire.py)
+# ---------------------------------------------------------------------------
+
+
+def test_grouped_reducescatter_wire_int8_close_to_exact(mesh):
+    vals = per_rank_data((N * 32,), np.float32, seed=11)
+
+    def wired(a):
+        return hvd.grouped_reducescatter(
+            [a[0]], op=hvd.Average, wire="int8")[0]
+
+    def exact(a):
+        return hvd.grouped_reducescatter([a[0]], op=hvd.Average)[0]
+
+    got = np.asarray(jax.jit(_shard_mapped_per_rank(wired, mesh))(
+        jnp.stack(vals)))
+    ref = np.asarray(jax.jit(_shard_mapped_per_rank(exact, mesh))(
+        jnp.stack(vals)))
+    assert got.shape == ref.shape
+    assert np.abs(got - ref).max() < np.abs(np.stack(vals)).max() / 10
+
+
+def test_grouped_reducescatter_wire_bf16_cast(mesh):
+    vals = per_rank_data((N * 4, 3), np.float32, seed=12)
+
+    def wired(a):
+        return hvd.grouped_reducescatter(
+            [a[0]], op=hvd.Average, wire="bf16")[0]
+
+    got = np.asarray(jax.jit(_shard_mapped_per_rank(wired, mesh))(
+        jnp.stack(vals)))
+    ref = np.mean(np.stack(vals), 0)
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+    assert got.dtype == np.float32
+
+
+def test_grouped_allgather_wire_int8(mesh):
+    vals = per_rank_data((4, 3), np.float32, seed=13)
+
+    def wired(a):
+        return hvd.grouped_allgather([a[0]], wire="int8")[0]
+
+    got = np.asarray(jax.jit(_shard_mapped(wired, mesh))(
+        jnp.stack(vals)))
+    exact = np.concatenate(vals, axis=0)
+    assert got.shape == exact.shape
+    # one encode per shard, no accumulation: tight blockwise bound
+    assert np.abs(got - exact).max() < np.abs(exact).max() / 100
+
+
+def test_grouped_allgather_wire_int_dtype_stays_exact(mesh):
+    vals = per_rank_data((4,), np.int32, seed=14)
+
+    def wired(a):
+        return hvd.grouped_allgather([a[0]], wire="int8")[0]
+
+    got = np.asarray(jax.jit(_shard_mapped(wired, mesh))(
+        jnp.stack(vals)))
+    np.testing.assert_array_equal(got, np.concatenate(vals))
+
+
+def test_grouped_wire_eager_raises():
+    from horovod_tpu.common.exceptions import HorovodTpuError
+
+    with pytest.raises(HorovodTpuError, match="in-jit only"):
+        hvd.grouped_reducescatter(
+            [np.ones((N * 2,), np.float32)], op=hvd.Sum, wire="int8")
+    with pytest.raises(HorovodTpuError, match="in-jit only"):
+        hvd.grouped_allgather([np.ones((4,), np.float32)], wire="int8")
+
+
+def test_grouped_wire_unknown_raises():
+    from horovod_tpu.common.exceptions import HorovodTpuError
+
+    with pytest.raises(HorovodTpuError, match="unknown wire format"):
+        hvd.grouped_reducescatter(
+            [np.ones((N * 2,), np.float32)], op=hvd.Sum, wire="int9")
+
+
+# ---------------------------------------------------------------------------
+# Bucket-order permutation invariance (r6 wire policy)
+# ---------------------------------------------------------------------------
+
+
+def _bucketed_reduce(mesh, leaves, order, compression=None, policy=None,
+                     monkeypatch=None):
+    import os
+    if policy is not None:
+        os.environ["HOROVOD_WIRE_POLICY"] = policy
+    else:
+        os.environ.pop("HOROVOD_WIRE_POLICY", None)
+    kw = {}
+    if compression is not None:
+        kw["compression"] = compression
+
+    def f(*xs):
+        outs = hvd.allreduce_gradients(
+            [x[0] for x in xs], axis_name=hvd.GLOBAL_AXIS,
+            fusion_threshold_bytes=512, bucket_order=order, **kw)
+        return tuple(outs)
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    sm = jax.jit(shard_map(
+        f, mesh=mesh, in_specs=(P(hvd.GLOBAL_AXIS),) * len(leaves),
+        out_specs=tuple(P() for _ in leaves), check_vma=False))
+    try:
+        return [np.asarray(o) for o in sm(*leaves)]
+    finally:
+        os.environ.pop("HOROVOD_WIRE_POLICY", None)
+
+
+def _order_test_leaves():
+    rng = np.random.RandomState(15)
+    return [jnp.asarray(rng.randn(N, n).astype(np.float32))
+            for n in (256, 64, 192, 32)]
+
+
+def test_bucket_order_bitwise_invariant_exact_wire(mesh):
+    leaves = _order_test_leaves()
+    fwd = _bucketed_reduce(mesh, leaves, "forward")
+    rev = _bucketed_reduce(mesh, leaves, "reverse")
+    for a, b in zip(fwd, rev):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_bucket_order_agrees_to_wire_tolerance_quantized(mesh):
+    # Different orders shift the block-scale boundaries inside the
+    # fused flat buffers, so int8/int4 results differ — but only
+    # within the quantization tolerance of the wire.
+    leaves = _order_test_leaves()
+    exact = [np.mean(np.asarray(l), axis=0) for l in leaves]
+    for comp, tol_div in ((hvd.Compression.int8, 50),
+                          (hvd.Compression.int4, 3)):
+        fwd = _bucketed_reduce(mesh, leaves, "forward",
+                               compression=comp)
+        rev = _bucketed_reduce(mesh, leaves, "reverse",
+                               compression=comp)
+        scale = max(np.abs(e).max() for e in exact)
+        for a, b, e in zip(fwd, rev, exact):
+            tol = N * scale / tol_div
+            assert np.abs(a - e).max() < tol
+            assert np.abs(b - e).max() < tol
+
+
+def test_bucket_order_agrees_under_wire_policy(mesh):
+    leaves = _order_test_leaves()
+    exact = [np.mean(np.asarray(l), axis=0) for l in leaves]
+    fwd = _bucketed_reduce(mesh, leaves, "forward",
+                           policy="big=int8,small=none,threshold=512")
+    rev = _bucketed_reduce(mesh, leaves, "reverse",
+                           policy="big=int8,small=none,threshold=512")
+    scale = max(np.abs(e).max() for e in exact)
+    for a, b, e in zip(fwd, rev, exact):
+        assert np.abs(a - e).max() < N * scale / 50
+        assert np.abs(b - e).max() < N * scale / 50

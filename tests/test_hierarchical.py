@@ -390,12 +390,24 @@ def test_reduce_scatter_dcn_wire_close(hmesh):
     np.testing.assert_allclose(out16[0], exact, rtol=5e-3, atol=5e-3)
 
 
-def test_reduce_scatter_rejects_cooperative_wire(hmesh):
+def test_reduce_scatter_cooperative_dcn_wire_close(hmesh):
+    """r6: cooperative wires ride the DCN scatter leg through the
+    quantized ring (wire registry) instead of being rejected."""
+    rng = np.random.RandomState(23)
+    vals = [rng.randn(DCN * ICI * 8).astype(np.float32)
+            for _ in range(N)]
+    exact = np.sum(np.stack(vals), axis=0)
+    out = np.asarray(_run_rs_ag(hmesh, vals, dcn_wire="int8"))
+    err = np.abs(out[0] - exact).max()
+    assert 0 < err < np.abs(exact).max() / 10
+
+
+def test_reduce_scatter_unknown_wire_rejected(hmesh):
     from horovod_tpu.common.exceptions import HorovodTpuError
 
     vals = [np.zeros((DCN * ICI,), np.float32)] * N
-    with pytest.raises(HorovodTpuError, match="bf16"):
-        _run_rs_ag(hmesh, vals, dcn_wire="int8")
+    with pytest.raises(HorovodTpuError, match="unknown wire format"):
+        _run_rs_ag(hmesh, vals, dcn_wire="int9")
 
 
 def test_reduce_scatter_rejects_non_divisible(hmesh):

@@ -585,3 +585,98 @@ def test_metrics_catalog_catches_ag_fusion_knob_drift(tmp_path):
     findings = MetricsCatalog().run(proj)
     assert [(f.rule, "ag_fusion" in f.message) for f in findings] == [
         ("undocumented-knob", True)]
+
+
+# ---------------------------------------------------------------------------
+# wire-registry (r6, scripts/hvdlint/wires.py)
+# ---------------------------------------------------------------------------
+
+from hvdlint import WireRegistry  # noqa: E402
+
+WIRE_METRICS = ("hvd_wire_bytes_saved", "hvd_wire_bytes_saved_per_step",
+                "hvd_wire_format_bytes")
+
+
+def test_wire_metrics_registered_and_documented():
+    declared = set(_REG_RE.findall(
+        _repo_text("horovod_tpu/metrics/catalog.py")))
+    documented = set(_DOC_ROW_RE.findall(_repo_text("docs/METRICS.md")))
+    for metric in WIRE_METRICS:
+        assert metric in declared, metric
+        assert metric in documented, metric
+
+
+def test_wire_threshold_knob_registered_and_documented():
+    knobs = set(_KNOB_RE.findall(
+        _repo_text("horovod_tpu/utils/autotune.py")))
+    assert "wire_threshold" in knobs
+    assert "`wire_threshold`" in _repo_text("docs/AUTOTUNE.md")
+
+
+def _wire_project(tmp_path, overrides=None):
+    """Copy the real wire module + doc into a fixture tree, with
+    optional per-file overrides."""
+    files = {
+        "horovod_tpu/ops/wire.py": _repo_text("horovod_tpu/ops/wire.py"),
+        "docs/WIRE.md": _repo_text("docs/WIRE.md"),
+    }
+    files.update(overrides or {})
+    return make_project(tmp_path, files)
+
+
+def test_wire_registry_repo_clean():
+    assert WireRegistry().run(Project(REPO)) == []
+
+
+def test_unknown_wire_literal_flagged(tmp_path):
+    proj = _wire_project(tmp_path, {
+        "horovod_tpu/parallel/bad.py": '''\
+            def f(x):
+                return reduce(x, wire="int9")
+            ''',
+    })
+    findings = WireRegistry().run(proj)
+    assert [(f.rule, "int9" in f.message) for f in findings] == [
+        ("unknown-wire", True)]
+
+
+def test_known_wire_forms_clean(tmp_path):
+    proj = _wire_project(tmp_path, {
+        "horovod_tpu/parallel/ok.py": '''\
+            class C:
+                wire = "fp16"
+
+            def f(x, dcn_wire="int4", allgather_wire: str = "bf16"):
+                codec = get_codec("fp8_e4m3")
+                return reduce(x, wire="int8")
+            ''',
+    })
+    assert WireRegistry().run(proj) == []
+
+
+def test_wire_doc_drift_both_directions(tmp_path):
+    # Drop a codec's doc row -> undocumented-codec.
+    doc = "\n".join(
+        line for line in _repo_text("docs/WIRE.md").splitlines()
+        if not line.startswith("| `int4`"))
+    proj = _wire_project(tmp_path, {"docs/WIRE.md": doc})
+    findings = WireRegistry().run(proj)
+    assert [(f.rule, "int4" in f.message) for f in findings] == [
+        ("undocumented-codec", True)]
+    # Remove the registration but keep the row -> stale-doc-entry.
+    src = _repo_text("horovod_tpu/ops/wire.py").replace(
+        'name="int4"', 'name="int8"')
+    proj2 = _wire_project(tmp_path, {"horovod_tpu/ops/wire.py": src})
+    findings2 = WireRegistry().run(proj2)
+    assert ("stale-doc-entry", True) in [
+        (f.rule, "int4" in f.message) for f in findings2]
+
+
+def test_wire_registry_missing_doc_is_error(tmp_path):
+    files = {
+        "horovod_tpu/ops/wire.py": _repo_text("horovod_tpu/ops/wire.py"),
+    }
+    proj = make_project(tmp_path, files)
+    findings = WireRegistry().run(proj)
+    assert [f.rule for f in findings] == ["error"]
+    assert "docs/WIRE.md" in findings[0].message

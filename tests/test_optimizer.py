@@ -418,6 +418,27 @@ class TestShardedOptimizer:
         state = opt.init(params)
         assert all(slot.master is not None for slot in state.inner)
 
+    @pytest.mark.parametrize("wire", ["int8", "int4"])
+    def test_cooperative_allgather_wire(self, wire):
+        """r6: cooperative wires on the param allgather — the ring
+        payload gather replaces the cast. Owner-side fp32 masters keep
+        the integration exact, so the (larger) quantization error stays
+        a per-step display error and never accumulates into state."""
+        stacked = _stacked_grads(8, self.SHAPES, integral=True)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        exact = _per_rank_updates(
+            self._make(shard_optimizer_states=True), params, stacked)
+        opt = self._make(shard_optimizer_states=True,
+                         allgather_wire=wire)
+        got = _per_rank_updates(opt, params, stacked)
+        scale = max(float(np.abs(np.asarray(e)).max()) for e in exact)
+        tol = scale * (2e-2 if wire == "int8" else 2e-1)
+        for a, b in zip(exact, got):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=tol)
+        state = opt.init(params)
+        assert all(slot.master is not None for slot in state.inner)
+
     def test_hierarchical_axis_bitwise(self):
         """2-tuple axis: two-level reduce-scatter (ICI psum-scatter +
         DCN hop) and the (dcn, ici) allgather must land every segment on
@@ -589,8 +610,12 @@ class TestShardedOptimizer:
         with pytest.raises(ValueError, match="reduce-scatter"):
             self._make(shard_optimizer_states=True,
                        compression=hvd.Compression.int8)
-        with pytest.raises(ValueError, match="allgather_wire"):
-            self._make(shard_optimizer_states=True, allgather_wire="int8")
+        from horovod_tpu.common.exceptions import HorovodTpuError
+        with pytest.raises(HorovodTpuError, match="unknown wire format"):
+            self._make(shard_optimizer_states=True, allgather_wire="int9")
+        with pytest.raises(ValueError, match="cast wire"):
+            self._make(shard_optimizer_states=True, allgather_wire="int8",
+                       axis_name=("dcn", "hvd"))
         with pytest.raises(ValueError, match="shard_optimizer_states"):
             self._make(allgather_wire="bf16")
         ps = hvd.add_process_set([0, 2])

@@ -308,3 +308,176 @@ class TestErrorFeedback:
         out, e2 = sm(x, [e])
         np.testing.assert_allclose(np.asarray(out[0]), 1.25)
         np.testing.assert_allclose(np.asarray(e2[0]), 0.0)
+
+
+class TestInt4Ring:
+    def test_int4_ring_close_to_exact(self, mesh8):
+        rng = np.random.default_rng(21)
+        contribs = rng.normal(size=(8, 1024)).astype(np.float32)
+        out = np.asarray(quantized_allreduce(
+            jnp.asarray(contribs), mesh8, wire="int4"))
+        exact = contribs.sum(0)
+        for r in range(1, 8):
+            np.testing.assert_array_equal(out[r], out[0])
+        # n-1 requantization hops at ±7 levels: error ~ n * blockmax/14
+        bound = 8 * np.abs(contribs).max() / 7
+        err = np.abs(out[0] - exact).max()
+        assert 0 < err < bound
+
+    def test_int4_ef_telescopes(self, mesh8):
+        rng = np.random.default_rng(22)
+        grads = [rng.normal(size=(512,)).astype(np.float32) * 3
+                 for _ in range(8)]
+        exact = np.mean(np.stack(grads), axis=0)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        hvd.init()
+        stacked = jnp.stack([jnp.asarray(g) for g in grads])
+
+        def one(x, e):
+            out, e2 = hvd.allreduce_gradients(
+                [x[0]], compression=hvd.Compression.int4,
+                axis_name="r", error_feedback_state=e)
+            return out[0][None], [a[None] for a in e2]
+
+        sm = jax.jit(shard_map(
+            one, mesh=mesh8, in_specs=(P("r"), [P("r")]),
+            out_specs=(P("r"), [P("r")]), check_vma=False))
+        e = [jnp.zeros_like(stacked)]
+        outs = []
+        for _ in range(10):
+            o, e = sm(stacked, e)
+            outs.append(np.asarray(o[0]))
+        single_err = np.abs(outs[0] - exact).mean()
+        mean_err = np.abs(np.mean(outs, axis=0) - exact).mean()
+        assert mean_err < single_err * 0.2, (mean_err, single_err)
+
+
+class TestMeshLevelErrorFeedback:
+    """r6 satellite: the mesh-level quantized_allreduce accepts
+    error_feedback like the shard-level primitive."""
+
+    def test_conservation_identity(self, mesh8):
+        rng = np.random.default_rng(23)
+        contribs = jnp.asarray(
+            rng.normal(size=(8, 256)).astype(np.float32))
+        ef = jnp.zeros_like(contribs)
+        S = np.sum(np.asarray(contribs), axis=0)
+        for _ in range(3):
+            e_before = np.sum(np.asarray(ef), axis=0)
+            out, ef = quantized_allreduce(
+                contribs, mesh8, error_feedback=ef)
+            e_after = np.sum(np.asarray(ef), axis=0)
+            np.testing.assert_allclose(
+                np.asarray(out[0]), S + e_before - e_after,
+                atol=2e-3, rtol=1e-5)
+
+    def test_ef_improves_time_average(self, mesh8):
+        rng = np.random.default_rng(24)
+        contribs = jnp.asarray(
+            rng.normal(size=(8, 512)).astype(np.float32) * 3)
+        exact = np.mean(np.asarray(contribs), axis=0)
+        no_ef = np.asarray(quantized_allreduce(
+            contribs, mesh8, average=True, wire="int4"))[0]
+        ef = jnp.zeros_like(contribs)
+        outs = []
+        for _ in range(10):
+            out, ef = quantized_allreduce(
+                contribs, mesh8, average=True, wire="int4",
+                error_feedback=ef)
+            outs.append(np.asarray(out)[0])
+        single_err = np.abs(no_ef - exact).mean()
+        mean_err = np.abs(np.mean(outs, axis=0) - exact).mean()
+        assert mean_err < single_err * 0.2, (mean_err, single_err)
+
+
+class TestQuantizedReduceScatterAllgather:
+    """r6: the ring reduce-scatter / allgather shard primitives."""
+
+    def _sm(self, mesh, fn, n_in=1):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        return jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(P("r"),) * n_in,
+            out_specs=P("r"), check_vma=False))
+
+    @pytest.mark.parametrize("wire", ["int8", "int4", "fp8_e4m3"])
+    def test_rs_matches_psum_scatter_ownership(self, mesh8, wire):
+        from horovod_tpu.ops.quantized import (
+            quantized_reducescatter_shard,
+        )
+        rng = np.random.default_rng(25)
+        stacked = jnp.asarray(
+            rng.normal(size=(8, 1024)).astype(np.float32))
+
+        def rs(x):
+            return quantized_reducescatter_shard(
+                x[0], "r", wire=wire)[None]
+
+        def exact_rs(x):
+            import jax.lax as lax
+            return lax.psum_scatter(x[0], "r", tiled=True)[None]
+
+        out = np.asarray(self._sm(mesh8, rs)(stacked))
+        ref = np.asarray(self._sm(mesh8, exact_rs)(stacked))
+        assert out.shape == ref.shape == (8, 128)
+        # same chunk ownership as psum_scatter, error within the
+        # (n-1)-hop requantization bound
+        bound = 8 * np.abs(np.asarray(stacked)).max() / \
+            (100 if wire == "int8" else 6)
+        assert np.abs(out - ref).max() < bound
+
+    def test_rs_average_and_ef(self, mesh8):
+        from horovod_tpu.ops.quantized import (
+            quantized_reducescatter_shard,
+        )
+        rng = np.random.default_rng(26)
+        stacked = jnp.asarray(
+            rng.normal(size=(8, 1024)).astype(np.float32))
+
+        def rs(x, e):
+            own, e2 = quantized_reducescatter_shard(
+                x[0], "r", average=True, wire="int8",
+                error_feedback=e[0])
+            return own[None], e2[None]
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        sm = jax.jit(shard_map(
+            rs, mesh=mesh8, in_specs=(P("r"), P("r")),
+            out_specs=(P("r"), P("r")), check_vma=False))
+        own, resid = sm(stacked, jnp.zeros_like(stacked))
+        exact = np.asarray(stacked).mean(0).reshape(8, 128)
+        assert np.abs(np.asarray(own) - exact).max() < \
+            np.abs(np.asarray(stacked)).max() / 10
+        # every send's encode error lands in some residual
+        assert np.abs(np.asarray(resid)).max() > 0
+
+    def test_ag_matches_all_gather(self, mesh8):
+        from horovod_tpu.ops.quantized import quantized_allgather_shard
+        rng = np.random.default_rng(27)
+        shards = jnp.asarray(
+            rng.normal(size=(8, 128)).astype(np.float32))
+
+        def ag(x):
+            return quantized_allgather_shard(x[0], "r", wire="int8")[None]
+
+        out = np.asarray(self._sm(mesh8, ag)(shards))
+        exact = np.asarray(shards).reshape(-1)
+        # every rank sees the same gathered vector, one encode of error
+        for r in range(8):
+            blocks = exact.reshape(-1, 128)
+            step = np.repeat(np.abs(blocks).max(axis=1), 128) / 254
+            assert np.all(np.abs(out[r] - exact) <= step + 1e-6)
+
+    def test_ag_exact_wire_is_allgather(self, mesh8):
+        from horovod_tpu.ops.quantized import quantized_allgather_shard
+        shards = jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16)
+
+        def ag(x):
+            return quantized_allgather_shard(x[0], "r", wire="none")[None]
+
+        out = np.asarray(self._sm(mesh8, ag)(shards))
+        np.testing.assert_array_equal(
+            out[0], np.arange(128, dtype=np.float32))

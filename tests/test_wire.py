@@ -1,0 +1,321 @@
+"""Wire-codec registry tests — ops/wire.py: per-codec round-trip error
+bounds (int4 nibble packing included), registry failure mode, wire-byte
+accounting, the per-bucket policy grammar/classification, and the
+policy's end-to-end behavior inside reduce_gradient_buckets."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.ops import wire
+from horovod_tpu.parallel import data_parallel as dp
+
+
+@pytest.fixture()
+def mesh8():
+    devs = np.array(jax.devices()[:8])
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return Mesh(devs, ("r",))
+
+
+def _randn(n, seed=0, scale=10.0):
+    return jnp.asarray(np.random.default_rng(seed).normal(
+        size=(n,)).astype(np.float32)) * scale
+
+
+class TestRegistry:
+    def test_every_codec_registered(self):
+        assert wire.wire_names() == (
+            "bf16", "fp16", "fp8_e4m3", "fp8_e5m2", "int4", "int8",
+            "none")
+        assert wire.cast_wire_names() == ("bf16", "fp16")
+
+    def test_none_and_None_resolve_exact(self):
+        assert wire.get_codec(None).exact
+        assert wire.get_codec("none").exact
+        assert not wire.get_codec("none").cooperative
+
+    def test_unknown_wire_names_valid_formats(self):
+        with pytest.raises(HorovodTpuError, match="unknown wire format"):
+            wire.get_codec("int9")
+        with pytest.raises(HorovodTpuError, match="int4, int8"):
+            wire.get_codec("q8")
+
+    def test_compressor_wire_resolution(self):
+        from horovod_tpu.ops.compression import Compression
+        assert wire.compressor_wire(Compression.none) == "none"
+        assert wire.compressor_wire(Compression.fp16) == "fp16"
+        assert wire.compressor_wire(Compression.int4) == "int4"
+
+        class Opaque:  # third-party compressor without a wire name
+            pass
+        assert wire.compressor_wire(Opaque) == "none"
+
+    def test_families(self):
+        for name in wire.wire_names():
+            c = wire.get_codec(name)
+            assert c.exact + c.cooperative + (
+                c.cast_dtype is not None) == 1
+
+
+# Half-quantization-step bounds per cooperative codec, as a multiple of
+# the blockwise max-abs (int8: 1/254; int4: 1/14; fp8 mantissa ulp).
+_COOP_BOUNDS = {
+    "int8": 1 / 254,
+    "int4": 1 / 14,
+    "fp8_e4m3": 1 / 16,   # 3 mantissa bits on [-1, 1] blocks
+    "fp8_e5m2": 1 / 4,    # 2 mantissa bits
+}
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("name", sorted(_COOP_BOUNDS))
+    def test_cooperative_error_bounded_blockwise(self, name):
+        v = _randn(1024, seed=3)
+        back = wire.local_roundtrip(v, name)
+        blocks = np.asarray(v).reshape(-1, 128)
+        step = np.repeat(np.abs(blocks).max(axis=1), 128)
+        err = np.abs(np.asarray(back) - np.asarray(v))
+        assert err.max() <= (step * _COOP_BOUNDS[name] + 1e-6).max()
+        assert np.all(err <= step * _COOP_BOUNDS[name] + 1e-6)
+
+    @pytest.mark.parametrize("name", ["fp16", "bf16"])
+    def test_cast_roundtrip_preserves_dtype(self, name):
+        v = _randn(300, seed=4, scale=1.0)
+        back = wire.local_roundtrip(v, name)
+        assert back.dtype == v.dtype
+        rel = {"fp16": 1e-3, "bf16": 8e-3}[name]
+        np.testing.assert_allclose(np.asarray(back), np.asarray(v),
+                                   rtol=rel, atol=rel)
+
+    def test_none_roundtrip_bitwise(self):
+        v = _randn(257, seed=5)
+        np.testing.assert_array_equal(
+            np.asarray(wire.local_roundtrip(v, "none")), np.asarray(v))
+
+    def test_int4_integer_values_exact(self):
+        # Values already on the ±7 grid survive the nibble pack exactly.
+        v = jnp.tile(jnp.arange(-7, 8, dtype=jnp.float32), 128)[:1280]
+        back = wire.local_roundtrip(v, "int4")
+        np.testing.assert_allclose(np.asarray(back), np.asarray(v),
+                                   atol=1e-5)
+
+    def test_int4_nibble_pack_halves_payload(self):
+        c4, c8 = wire.get_codec("int4"), wire.get_codec("int8")
+        payload4 = c4.encode(jnp.ones((256,), jnp.float32))[0]
+        assert payload4.shape == (128,) and payload4.dtype == jnp.uint8
+        assert c4.wire_nbytes(256) == 128 + 8  # payload + 2 f32 scales
+        assert c8.wire_nbytes(256) == 256 + 8
+        assert wire.get_codec("none").wire_nbytes(256) == 1024
+
+    def test_zero_blocks_exact_all_codecs(self):
+        v = jnp.zeros((256,), jnp.float32)
+        for name in wire.wire_names():
+            np.testing.assert_array_equal(
+                np.asarray(wire.local_roundtrip(v, name)), 0.0)
+
+
+class TestPolicyGrammar:
+    def test_exact_and_auto(self):
+        assert wire.parse_wire_policy("exact").exact
+        p = wire.parse_wire_policy("auto")
+        assert (p.big, p.small, p.threshold_bytes) == (
+            "int8", "none", None)
+
+    def test_explicit_pairs(self):
+        p = wire.parse_wire_policy("big=int4,small=bf16,threshold=4096")
+        assert (p.big, p.small, p.threshold_bytes) == (
+            "int4", "bf16", 4096)
+
+    def test_bad_specs_raise(self):
+        with pytest.raises(HorovodTpuError, match="unknown wire format"):
+            wire.parse_wire_policy("big=int9")
+        with pytest.raises(HorovodTpuError, match="unknown .* key"):
+            wire.parse_wire_policy("huge=int8")
+        with pytest.raises(HorovodTpuError, match="threshold"):
+            wire.parse_wire_policy("threshold=1MB")
+        with pytest.raises(HorovodTpuError, match="key=value"):
+            wire.parse_wire_policy("int8")
+
+    def test_classification(self):
+        p = wire.parse_wire_policy("big=int4,small=none,threshold=1000")
+        assert p.codec_for(1000, True) == "int4"
+        assert p.codec_for(999, True) == "none"
+        assert p.codec_for(10**9, False) == "none"  # int leaves: exact
+
+    def test_env_round_trip(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_WIRE_POLICY", raising=False)
+        assert wire.policy_from_env() is None
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY", "auto")
+        assert wire.policy_from_env().big == "int8"
+
+    def test_threshold_defers_to_autotune_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WIRE_THRESHOLD", "2048")
+        p = wire.parse_wire_policy("auto")
+        assert p.codec_for(2048, True) == "int8"
+        assert p.codec_for(2047, True) == "none"
+
+
+class TestPolicyPlan:
+    def test_plan_reports_savings(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY",
+                           "big=int8,small=none,threshold=4096")
+        big = jnp.zeros((4096,), jnp.float32)      # 16 KB -> int8
+        small = jnp.zeros((64,), jnp.float32)      # 256 B -> exact
+        plan = dp.wire_policy_plan([big, small],
+                                   fusion_threshold_bytes=4096)
+        by_wire = {w: (raw, wb) for _, w, raw, wb in plan}
+        assert by_wire["none"] == (256, 256)
+        raw, wb = by_wire["int8"]
+        assert raw == 16384 and wb == 4096 + 4 * 32  # payload + scales
+        assert raw / wb > 2  # the >=2x acceptance bar for big buckets
+
+    def test_plan_all_exact_without_policy(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_WIRE_POLICY", raising=False)
+        plan = dp.wire_policy_plan([jnp.zeros((10,), jnp.float32)])
+        assert plan == [([0], "none", 40, 40)]
+
+
+def _reduce(mesh, leaves, ef=None, threshold=4096):
+    n_ef = len(ef) if ef is not None else 0
+
+    def step(*args):
+        ls = list(args[:len(leaves)])
+        efs = list(args[len(leaves):]) or None
+        res, new_ef = dp.reduce_gradient_buckets(
+            ls, axis_name="r", fusion_threshold_bytes=threshold,
+            error_feedback_leaves=efs)
+        outs = [None] * len(ls)
+        for idxs, os_ in res:
+            for i, o in zip(idxs, os_):
+                outs[i] = o
+        return tuple(outs), (tuple(new_ef) if new_ef else ())
+
+    args = list(leaves) + (list(ef) if ef else [])
+    f = jax.jit(shard_map(
+        step, mesh=mesh, in_specs=(P("r"),) * len(args),
+        out_specs=(tuple(P() for _ in leaves),
+                   tuple(P("r") for _ in range(n_ef))),
+        check_vma=False))
+    outs, new_ef = f(*args)
+    return [o[0] for o in outs], list(new_ef)
+
+
+class TestPolicyReduction:
+    def test_auto_policy_quantizes_big_exactly_keeps_small(
+            self, mesh8, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY",
+                           "big=int8,small=none,threshold=4096")
+        rng = np.random.default_rng(7)
+        big = jnp.asarray(rng.normal(size=(8, 2048)).astype(np.float32))
+        small = jnp.asarray(rng.normal(size=(8, 64)).astype(np.float32))
+        (o_big, o_small), _ = _reduce(mesh8, [big, small])
+        # small bucket is exact up to psum-vs-np summation order
+        np.testing.assert_allclose(
+            np.asarray(o_small),
+            np.asarray(jnp.mean(small, axis=0)), rtol=1e-6, atol=1e-6)
+        # big bucket is quantized: close but not exact
+        exact = np.asarray(jnp.mean(big, axis=0))
+        err = np.abs(np.asarray(o_big) - exact).max()
+        assert 0 < err < 8 * np.abs(np.asarray(big)).max() / 100
+
+    def test_exact_policy_bitwise_equal_to_no_policy(
+            self, mesh8, monkeypatch):
+        rng = np.random.default_rng(8)
+        g = jnp.asarray(rng.normal(size=(8, 1024)).astype(np.float32))
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY", "exact")
+        (o_exact,), _ = _reduce(mesh8, [g])
+        monkeypatch.delenv("HOROVOD_WIRE_POLICY")
+        (o_none,), _ = _reduce(mesh8, [g])
+        np.testing.assert_array_equal(np.asarray(o_exact),
+                                      np.asarray(o_none))
+
+    def test_int_leaves_stay_exact_under_policy(self, mesh8,
+                                                monkeypatch):
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY",
+                           "big=int4,small=int4,threshold=0")
+        counts = jnp.tile(jnp.arange(64, dtype=jnp.int32), (8, 1))
+        (out,), _ = _reduce(mesh8, [counts])
+        # Identical ranks averaged: the arange survives bit-exactly,
+        # which int4 quantization (levels ±7) could not deliver.
+        np.testing.assert_allclose(np.asarray(out), np.arange(64))
+
+    def test_cast_wire_bucket(self, mesh8, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY",
+                           "big=bf16,small=none,threshold=1024")
+        g = jnp.asarray(np.random.default_rng(9).normal(
+            size=(8, 2048)).astype(np.float32))
+        (out,), _ = _reduce(mesh8, [g])
+        exact = np.asarray(jnp.mean(g, axis=0))
+        np.testing.assert_allclose(np.asarray(out), exact,
+                                   rtol=2e-2, atol=2e-2)
+        assert np.abs(np.asarray(out) - exact).max() > 0
+
+    def test_error_feedback_slices_per_bucket(self, mesh8, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY",
+                           "big=int4,small=none,threshold=4096")
+        rng = np.random.default_rng(10)
+        big = jnp.asarray(rng.normal(size=(8, 2048)).astype(np.float32))
+        small = jnp.asarray(rng.normal(size=(8, 64)).astype(np.float32))
+        ef = [jnp.zeros_like(big), jnp.zeros_like(small)]
+        _, (r_big, r_small) = _reduce(mesh8, [big, small], ef=ef)
+        assert np.abs(np.asarray(r_big)).max() > 0
+        np.testing.assert_array_equal(np.asarray(r_small), 0.0)
+
+    def test_ef_reduces_accumulated_drift_multi_step(
+            self, mesh8, monkeypatch):
+        # Repeated reductions of the SAME gradients: with EF the
+        # accumulated mean output converges on the exact mean; without
+        # it the quantization bias repeats identically every step.
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY",
+                           "big=int4,small=none,threshold=1024")
+        rng = np.random.default_rng(11)
+        g = jnp.asarray(rng.normal(size=(8, 1024)).astype(np.float32))
+        exact = np.asarray(jnp.mean(g, axis=0))
+        steps = 8
+
+        acc_no_ef = np.zeros_like(exact)
+        for _ in range(steps):
+            (out,), _ = _reduce(mesh8, [g])
+            acc_no_ef += np.asarray(out)
+
+        acc_ef = np.zeros_like(exact)
+        ef = [jnp.zeros_like(g)]
+        for _ in range(steps):
+            (out,), new_ef = _reduce(mesh8, [g], ef=ef)
+            acc_ef += np.asarray(out)
+            ef = [new_ef[0]]
+
+        drift_no_ef = np.abs(acc_no_ef / steps - exact).max()
+        drift_ef = np.abs(acc_ef / steps - exact).max()
+        assert drift_ef < drift_no_ef / 2
+
+    def test_non_average_op_rejected(self, mesh8, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY", "auto")
+        from horovod_tpu.ops import collectives as C
+        g = jnp.zeros((8, 256), jnp.float32)
+
+        def step(x):
+            res, _ = dp.reduce_gradient_buckets(
+                [x], axis_name="r", op=C.Max,
+                fusion_threshold_bytes=1024)
+            return res[0][1][0]
+
+        with pytest.raises(ValueError, match="Average or Sum"):
+            jax.jit(shard_map(
+                step, mesh=mesh8, in_specs=(P("r"),), out_specs=P(),
+                check_vma=False))(g)
+
+    def test_explicit_compression_wins_over_policy(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY", "auto")
+        assert dp.active_wire_policy() is not None
+        assert dp.active_wire_policy(
+            compression=hvd.Compression.int8) is None
+        monkeypatch.setenv("HOROVOD_WIRE_POLICY", "exact")
+        assert dp.active_wire_policy() is None
