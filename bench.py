@@ -257,6 +257,7 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
     legacy = os.environ.get("HOROVOD_BENCH_LEGACY_PIPELINE") == "1"
     sharded = os.environ.get("HOROVOD_SHARD_OPTIMIZER") == "1"
     quant = bool(os.environ.get("HOROVOD_WIRE_POLICY"))
+    guard = os.environ.get("HOROVOD_GUARD") == "1"
     if legacy or not distributed:
         pipeline = "legacy"
     elif sharded:
@@ -265,6 +266,12 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
         # Overlap pipeline + per-bucket wire policy (docs/WIRE.md): big
         # buckets ride the quantized ring, small stay exact.
         pipeline = "quant"
+    elif guard:
+        # Overlap pipeline + fused non-finite sentinel (docs/GUARD.md):
+        # HOROVOD_GUARD=1 arms the skip-step gate inside the
+        # DistributedOptimizer; the delta vs "overlap" is the sentinel
+        # cost (one scalar per bucket + one tiny Max-allreduce).
+        pipeline = "guard"
     else:
         pipeline = "overlap"
     if pipeline == "sharded":
@@ -273,7 +280,7 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
         opt = hvd.DistributedOptimizer(base_opt, shard_optimizer_states=True)
         step_fn = build_step(opt, v["config"], distributed=True,
                              reduce_grads_in_step=False)
-    elif pipeline in ("overlap", "quant"):
+    elif pipeline in ("overlap", "quant", "guard"):
         opt = hvd.DistributedOptimizer(base_opt, fused_apply=True)
         step_fn = build_step(opt, v["config"], distributed=True,
                              reduce_grads_in_step=False)
@@ -320,7 +327,7 @@ _LAST_SIM_RECORD = None
 
 def _run_sim_record(n: int, distributed: bool, timeout: float,
                     legacy: bool = False, sharded: bool = False,
-                    quant: bool = False):
+                    quant: bool = False, guard: bool = False):
     """Run one sim child; return its full JSON record (or None)."""
     global _LAST_SIM_RECORD
     _LAST_SIM_RECORD = None
@@ -328,12 +335,15 @@ def _run_sim_record(n: int, distributed: bool, timeout: float,
     env.pop("XLA_FLAGS", None)
     env.pop("HOROVOD_SHARD_OPTIMIZER", None)
     env.pop("HOROVOD_WIRE_POLICY", None)
+    env.pop("HOROVOD_GUARD", None)
     if legacy:
         env["HOROVOD_BENCH_LEGACY_PIPELINE"] = "1"
     if sharded:
         env["HOROVOD_SHARD_OPTIMIZER"] = "1"
     if quant:
         env["HOROVOD_WIRE_POLICY"] = "auto"
+    if guard:
+        env["HOROVOD_GUARD"] = "1"
     cmd = [sys.executable, os.path.abspath(__file__), "--sim-child", str(n)]
     if not distributed:
         cmd.append("--no-dist")
@@ -355,9 +365,9 @@ def _run_sim_record(n: int, distributed: bool, timeout: float,
 
 def _run_sim(n: int, distributed: bool, timeout: float,
              legacy: bool = False, sharded: bool = False,
-             quant: bool = False):
+             quant: bool = False, guard: bool = False):
     rec = _run_sim_record(n, distributed, timeout, legacy=legacy,
-                          sharded=sharded, quant=quant)
+                          sharded=sharded, quant=quant, guard=guard)
     return None if rec is None else rec["step_time_s"]
 
 
@@ -521,6 +531,20 @@ def sim_scaling_efficiency(timeout: float = 600.0,
                     "wire)")
                 extras["wire_bytes_saved"] = int(saved)
                 extras["wire_bytes_raw"] = int(raw)
+        # Training-health guardian: the same overlap pipeline with the
+        # fused non-finite sentinel + skip-step gate armed
+        # (HOROVOD_GUARD=1, docs/GUARD.md).  The delta vs the plain
+        # overlap median is the no-fault guard overhead — the GUARD.md
+        # claim is that it stays within ~1% of the step.
+        t8_guard = _run_sim(8, True, timeout, guard=True)
+        if t8_guard is not None:
+            overhead = (t8_guard - t8m) / t8m
+            log(f"sim-scaling n=8 guard pipeline: {t8_guard*1e3:.1f} "
+                f"ms/step -> sentinel overhead "
+                f"{(t8_guard - t8m)*1e3:+.1f} ms/step "
+                f"({100 * overhead:+.1f}%)")
+            extras["t8_guard_ms"] = round(t8_guard * 1e3, 1)
+            extras["guard_overhead"] = round(overhead, 4)
 
     def _trimmed_median(vals):
         s = _np.sort(_np.asarray(vals))

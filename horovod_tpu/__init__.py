@@ -186,4 +186,11 @@ from .parallel.hierarchical import (  # noqa: F401
 
 from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401
+from . import guard  # noqa: F401
 from . import metrics  # noqa: F401
+
+from .guard import (  # noqa: F401
+    DynamicLossScale,
+    GuardState,
+    TrainingGuard,
+)

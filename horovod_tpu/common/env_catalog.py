@@ -130,6 +130,10 @@ CATALOG: Tuple[EnvVar, ...] = (
     _v("HOROVOD_RESPAWN_BACKOFF_MAX", "30.0", "elastic",
        "Cap in seconds of the exponential respawn backoff.",
        "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_CKPT_QUARANTINE_KEEP", "3", "elastic",
+       "Newest `.corrupt` quarantined checkpoint directories kept for "
+       "forensics; older ones are pruned (0 keeps none).",
+       "FAULT_TOLERANCE.md"),
 
     # -- fault injection / retries --------------------------------------
     _v("HOROVOD_FAULT_SPEC", "(unset)", "faults",
@@ -234,6 +238,26 @@ CATALOG: Tuple[EnvVar, ...] = (
        "Byte threshold above which the wire policy routes a bucket to "
        "its big (quantized) codec; autotunable.", "WIRE.md"),
 
+    # -- training-health guardian ---------------------------------------
+    _v("HOROVOD_GUARD", "0", "guard",
+       "1 arms the training-health guardian in the distributed "
+       "optimizer: fused non-finite sentinel plus coordinated "
+       "skip-step.", "GUARD.md"),
+    _v("HOROVOD_GUARD_LOSS_SCALE", "(unset)", "guard",
+       "Initial dynamic loss scale (e.g. 65536).  Unset keeps a static "
+       "scale of 1.0: skip-step only, bitwise-identical clean steps.",
+       "GUARD.md"),
+    _v("HOROVOD_GUARD_GROWTH_INTERVAL", "2000", "guard",
+       "Clean applies before the dynamic loss scale doubles; "
+       "autotunable as `loss_scale_growth_interval`.", "GUARD.md"),
+    _v("HOROVOD_GUARD_DIGEST_INTERVAL", "100", "guard",
+       "Steps between cross-replica parameter-digest divergence checks "
+       "(0 disables); autotunable as `guard_digest_interval`.",
+       "GUARD.md"),
+    _v("HOROVOD_GUARD_MAX_NONFINITE", "3", "guard",
+       "Consecutive non-finite steps tolerated before the guardian "
+       "escalates to checkpoint rollback.", "GUARD.md"),
+
     # -- collectives / ops ----------------------------------------------
     _v("HOROVOD_HIERARCHICAL_ALLREDUCE", "0", "ops",
        "1 routes multi-slice allreduce through ICI reduce-scatter -> "
@@ -257,6 +281,10 @@ CATALOG: Tuple[EnvVar, ...] = (
     _v("HOROVOD_COLLECTIVE_CONSISTENCY_CHECK", "0", "ops",
        "1 enables the cross-rank shape/dtype/generation consistency "
        "guard around collectives.", "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_CONSISTENCY_TIMEOUT", "30.0", "ops",
+       "Seconds the consistency check waits for peers' collective "
+       "signatures before declaring them divergent/stalled (read per "
+       "check).", "FAULT_TOLERANCE.md"),
     _v("HOROVOD_JOIN_MODE", "0", "ops",
        "1 arms hvd.join() semantics: ranks that exhausted data "
        "contribute masked zeros.", "PROCESS_SETS.md"),
@@ -320,7 +348,8 @@ PREFIXES: Dict[str, str] = {
 
 _COMPONENT_ORDER = (
     "topology", "launcher", "rendezvous", "elastic", "faults",
-    "metrics", "timeline", "autotune", "ops", "models", "bench",
+    "metrics", "timeline", "autotune", "guard", "ops", "models",
+    "bench",
 )
 
 _HEADER = """\

@@ -283,6 +283,11 @@ def _reset() -> None:
     Under a driver-managed elastic run, re-rendezvous first: fetch the new
     generation's rank/size/coordinator from the control plane so `init()`
     builds the new mesh."""
+    # Wire error-feedback residuals were encoded against the OLD
+    # generation's gradients/membership — invalidate them before the new
+    # mesh exists so they can't bleed into the first recovered step.
+    from ..ops import wire as _wire
+    _wire.reset_error_feedback()
     basics.shutdown()
     try:
         from ..runner.elastic_worker import (

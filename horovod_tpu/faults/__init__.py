@@ -81,6 +81,16 @@ CATALOG = {
     "state.commit": "Inside State.commit, before the snapshot.",
     "checkpoint.save": "Before a durable checkpoint write.",
     "checkpoint.restore": "Before a durable checkpoint read.",
+    # training-health guardian (guard/controller.py maybe_inject); err
+    # mode is TRANSLATED into data corruption rather than raised: the
+    # guard loop must detect and recover, not crash.
+    "guard.nan_grad":
+        "Before a training step: err poisons this rank's next batch "
+        "with NaN, so backward produces non-finite gradients.",
+    "guard.param_bitflip":
+        "Before a training step: err flips one mantissa bit of this "
+        "rank's first parameter (silent replica divergence for the "
+        "digest check to catch).",
 }
 
 _lock = threading.Lock()

@@ -1,0 +1,49 @@
+"""Training-health guardian.
+
+Four layers (docs/GUARD.md):
+
+1. **Fused non-finite sentinel** (`sentinel`): per-bucket any-NaN/Inf
+   flags computed inside the compiled gradient reduction and OR-ed
+   across ranks — one extra scalar per bucket on the wire.
+2. **Coordinated skip-step + dynamic loss scaling** (`loss_scale`):
+   on a flagged step every rank skips the optimizer apply in lockstep
+   and decays the scale; clean streaks grow it back.  No host
+   round-trip — the flag rides the reduced buckets.
+3. **Cross-replica divergence detection** (`digest`): periodic
+   per-bucket parameter checksums allgathered and compared bit-exact.
+4. **Escalation ladder** (`controller.TrainingGuard`): K consecutive
+   non-finite steps or any digest mismatch → restore the last
+   digest-verified checkpoint, reset wire error-feedback state, bump
+   the generation counter, resume.
+
+Enable in-jit guarding with ``DistributedOptimizer(..., guard=True)``
+(or ``HOROVOD_GUARD=1``); wrap the host loop with ``TrainingGuard``.
+"""
+
+from .controller import GuardVerdict, TrainingGuard  # noqa: F401
+from .digest import check_replica_divergence, param_digests  # noqa: F401
+from .loss_scale import (  # noqa: F401
+    DynamicLossScale,
+    GuardState,
+    select_on_flag,
+)
+from .sentinel import (  # noqa: F401
+    bucket_flags_local,
+    crossrank_or,
+    local_nonfinite,
+    sliced_nonfinite,
+)
+
+__all__ = [
+    "DynamicLossScale",
+    "GuardState",
+    "GuardVerdict",
+    "TrainingGuard",
+    "bucket_flags_local",
+    "check_replica_divergence",
+    "crossrank_or",
+    "local_nonfinite",
+    "param_digests",
+    "select_on_flag",
+    "sliced_nonfinite",
+]

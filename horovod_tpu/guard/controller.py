@@ -1,0 +1,263 @@
+"""The guard controller: the host-side escalation ladder.
+
+`TrainingGuard` sits in the training loop around the compiled step.
+Inside jit the sentinel + skip-step + loss-scale machinery already ran
+(see `DistributedOptimizer(guard=...)`); the controller only *reads*
+that verdict per step, keeps the metrics current, schedules the
+periodic cross-replica digest check, and — on K consecutive non-finite
+steps or any digest mismatch — restores the last digest-verified
+checkpoint, resets wire error-feedback state, and bumps the generation
+counter.  See docs/GUARD.md for the ladder.
+
+It also owns the two guard fault points (`guard.nan_grad`,
+`guard.param_bitflip`): unlike every other point in the catalog, their
+`err` mode is translated into data corruption rather than raised — the
+guard loop must detect and recover, not crash.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import faults as _faults
+from ..common import basics, util
+from ..metrics import catalog as _met
+from . import digest as _digest
+from .loss_scale import DynamicLossScale, GuardState
+
+logger = logging.getLogger("horovod_tpu.guard")
+
+
+class GuardVerdict(NamedTuple):
+    """What `TrainingGuard.observe` concluded about one step."""
+
+    flagged: bool                 # this apply's sentinel fired
+    loss_scale: float             # current loss scale (post-update)
+    nonfinite_steps: int          # consecutive flagged applies
+    rollback: bool                # escalate: restore + reset now
+    mismatch_bucket: Optional[int]  # digest-diverged bucket, if any
+
+
+def _first_float_leaf(tree: Any):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    for i, leaf in enumerate(leaves):
+        if jnp.issubdtype(jnp.result_type(leaf), jnp.inexact):
+            return leaves, treedef, i
+    return leaves, treedef, None
+
+
+def _poison_nan(batch: Any) -> Any:
+    """Set the first element of the first float leaf to NaN (the
+    `guard.nan_grad` translation: backward then produces non-finite
+    gradients on this rank only)."""
+    leaves, treedef, i = _first_float_leaf(batch)
+    if i is None:
+        return batch
+    leaf = jnp.asarray(leaves[i])
+    idx = (0,) * leaf.ndim
+    leaves[i] = leaf.at[idx].set(jnp.nan)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _flip_bit(params: Any) -> Any:
+    """Flip one mantissa bit of the first element of the first float
+    parameter (the `guard.param_bitflip` translation: a silent,
+    still-finite replica divergence for the digest check)."""
+    leaves, treedef, i = _first_float_leaf(params)
+    if i is None:
+        return params
+    leaf = np.asarray(leaves[i])
+    if leaf.dtype.itemsize == 2:
+        view, bit = np.uint16, np.uint16(1 << 6)
+    elif leaf.dtype.itemsize == 8:
+        view, bit = np.uint64, np.uint64(1 << 40)
+    else:
+        leaf = leaf.astype(np.float32) \
+            if leaf.dtype != np.float32 else leaf
+        view, bit = np.uint32, np.uint32(1 << 20)
+    flat = leaf.reshape(-1).copy()
+    bits = flat[:1].view(view)
+    bits ^= bit
+    leaves[i] = jnp.asarray(flat.reshape(leaf.shape), leaves[i].dtype)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class TrainingGuard:
+    """Host-side training-health controller.
+
+    Typical loop (see tests/data/guard_main.py for the full np=2
+    recipe)::
+
+        guard = TrainingGuard(scaler, checkpoint_dir=dir)
+        guard.checkpoint(0, state)           # digest-verified baseline
+        for step in range(n):
+            batch, params = guard.maybe_inject(batch, params)
+            params, opt_state = compiled_step(params, opt_state, batch)
+            v = guard.observe(opt_state, params, step)
+            if v.rollback:
+                params, opt_state = guard.rollback((params, opt_state))
+    """
+
+    def __init__(
+        self,
+        scaler: Optional[DynamicLossScale] = None,
+        checkpoint_dir: Optional[str] = None,
+        manager=None,
+        digest_interval: Optional[int] = None,
+        max_nonfinite: Optional[int] = None,
+        process_set=None,
+    ):
+        self.scaler = scaler or DynamicLossScale.from_env()
+        if manager is None and checkpoint_dir is not None:
+            from ..utils.checkpoint import CheckpointManager
+            manager = CheckpointManager(checkpoint_dir)
+        self._mgr = manager
+        self._digest_interval = digest_interval
+        self._max_nonfinite = (
+            max_nonfinite if max_nonfinite is not None
+            else util.env_int("GUARD_MAX_NONFINITE", 3))
+        self._ps = process_set
+        self.generation = 0
+        self.last_verified_step: Optional[int] = None
+        self._digest_parts = None
+
+    def digest_interval(self) -> int:
+        if self._digest_interval is not None:
+            return int(self._digest_interval)
+        from ..utils.autotune import current_guard_digest_interval
+        return current_guard_digest_interval()
+
+    # -- fault translation ----------------------------------------------
+    def maybe_inject(self, batch: Any, params: Any):
+        """Fire the guard fault points; translate `err` into data
+        corruption (NaN batch / parameter bit-flip) instead of raising.
+        Call once per step, before the compiled step."""
+        if not _faults.active():
+            return batch, params
+        try:
+            _faults.point("guard.nan_grad")
+        except _faults.FaultInjected:
+            logger.warning("guard.nan_grad fired: poisoning batch")
+            batch = _poison_nan(batch)
+        try:
+            _faults.point("guard.param_bitflip")
+        except _faults.FaultInjected:
+            logger.warning("guard.param_bitflip fired: flipping one "
+                           "parameter bit")
+            params = _flip_bit(params)
+        return batch, params
+
+    # -- per-step observation -------------------------------------------
+    @staticmethod
+    def _guard_state(opt_state: Any) -> Optional[GuardState]:
+        if isinstance(opt_state, GuardState):
+            return opt_state
+        g = getattr(opt_state, "guard", None)
+        return g if isinstance(g, GuardState) else None
+
+    def observe(self, opt_state: Any, params: Any,
+                step: int) -> GuardVerdict:
+        """Read the step's in-jit verdict (host sync on two scalars),
+        update metrics, run the periodic digest check, and decide
+        whether to escalate.  The caller performs the rollback."""
+        gs = self._guard_state(opt_state)
+        flagged = False
+        scale = 1.0
+        nonfinite = 0
+        if gs is not None:
+            flagged = bool(np.asarray(gs.bucket_flags).max() > 0)
+            scale = float(np.asarray(gs.loss_scale))
+            nonfinite = int(np.asarray(gs.nonfinite_steps))
+            if _met.enabled():
+                _met.loss_scale.set(scale)
+                if flagged:
+                    _met.nonfinite_steps.inc()
+        if flagged:
+            logger.warning(
+                "step %d: non-finite gradients (bucket flags %s); "
+                "optimizer apply skipped on all ranks, loss scale now "
+                "%g (%d consecutive)", step,
+                np.asarray(gs.bucket_flags).tolist(), scale, nonfinite)
+
+        mismatch = None
+        interval = self.digest_interval()
+        if (not flagged and interval > 0 and step > 0
+                and step % interval == 0):
+            mismatch = self._check_digests(params)
+            if mismatch is not None:
+                logger.error(
+                    "step %d: cross-replica parameter digest mismatch "
+                    "in bucket %d (silent divergence)", step, mismatch)
+                if _met.enabled():
+                    _met.digest_mismatch.inc()
+
+        rollback = mismatch is not None or (
+            self._max_nonfinite > 0 and nonfinite >= self._max_nonfinite)
+        return GuardVerdict(flagged=flagged, loss_scale=scale,
+                            nonfinite_steps=nonfinite, rollback=rollback,
+                            mismatch_bucket=mismatch)
+
+    def _check_digests(self, params: Any) -> Optional[int]:
+        if not (basics.is_initialized() and basics.num_processes() > 1):
+            return None
+        d = _digest.param_digests(params, parts=self._digest_parts)
+        return _digest.check_replica_divergence(d, process_set=self._ps)
+
+    # -- checkpoint / rollback ------------------------------------------
+    def checkpoint(self, step: int, state: Any) -> bool:
+        """Digest-verify `state`'s params across replicas, then save.
+        A diverged snapshot is refused (rolling back to it would pin the
+        corruption).  `state` may be any pytree; digesting covers every
+        float leaf in it."""
+        if self._mgr is None:
+            return False
+        mismatch = self._check_digests(state)
+        if mismatch is not None:
+            logger.error(
+                "refusing checkpoint at step %d: replicas already "
+                "diverged (bucket %d)", step, mismatch)
+            if _met.enabled():
+                _met.digest_mismatch.inc()
+            return False
+        self._mgr.save(step, state, force=True)
+        self.last_verified_step = step
+        return True
+
+    def rollback(self, template: Any) -> Any:
+        """Escalate: restore the last digest-verified checkpoint, reset
+        wire error-feedback residuals, bump the generation counter, and
+        clear host-side guard counters.  Returns the restored state (or
+        None when no checkpoint exists — the caller must then reinit)."""
+        from ..ops import wire as _wire
+        if _met.enabled():
+            _met.guard_rollbacks.inc()
+        restored = None
+        if self._mgr is not None:
+            restored = self._mgr.restore_latest(template=template)
+        _wire.reset_error_feedback()
+        self.generation += 1
+        logger.warning(
+            "guard rollback: generation now %d (restored step %s)",
+            self.generation, self._mgr.latest_step()
+            if self._mgr is not None else None)
+        return restored
+
+    @staticmethod
+    def reset_guard_state(opt_state: Any,
+                          scaler: DynamicLossScale) -> Any:
+        """Fresh `GuardState` in a restored/rolled-back optimizer state
+        (same bucket count), so stale counters don't survive the
+        generation bump."""
+        gs = TrainingGuard._guard_state(opt_state)
+        if gs is None or not hasattr(opt_state, "_replace"):
+            return opt_state
+        fresh = scaler.init(int(np.asarray(gs.bucket_flags).shape[0]))
+        return opt_state._replace(guard=fresh)
+
+
+__all__ = ["GuardVerdict", "TrainingGuard"]

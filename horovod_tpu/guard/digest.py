@@ -1,0 +1,86 @@
+"""Cross-replica divergence detection: periodic parameter digests.
+
+Every `HOROVOD_GUARD_DIGEST_INTERVAL` steps the controller computes a
+cheap per-bucket float checksum of the (nominally replicated) model
+parameters — `[sum, sum(|x|)]` per bucket, bucketed by the SAME
+`gradient_bucket_partition` the reduction uses, so a mismatch names the
+bucket that diverged — and allgathers the digest matrix.  Replicas that
+drifted silently (SDC, a stale error-feedback residual, a partition
+bug) disagree bit-for-bit in at least one row; the escalation ladder in
+`guard.controller` turns that into a rollback.
+
+Digest cost: 2 floats per bucket per rank on the wire, amortized over
+the interval — negligible next to one gradient reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import basics
+from ..ops import collectives as C
+
+
+def param_digests(params: Any,
+                  parts: Optional[Sequence[Sequence[int]]] = None
+                  ) -> np.ndarray:
+    """f64[B, 2] per-bucket `[sum, sum(|x|)]` over the parameter pytree,
+    bucketed like the gradient reduction (`parts` overrides the
+    partition, e.g. to reuse one computed at init)."""
+    leaves = jax.tree_util.tree_leaves(params)
+    if parts is None:
+        # Lazy: data_parallel imports guard.sentinel; avoid the cycle.
+        from ..parallel.data_parallel import gradient_bucket_partition
+        parts = gradient_bucket_partition(leaves)
+    rows: List[np.ndarray] = []
+    for idxs in parts:
+        s = 0.0
+        a = 0.0
+        for i in idxs:
+            leaf = np.asarray(leaves[i], dtype=np.float64) \
+                if jnp.issubdtype(jnp.result_type(leaves[i]),
+                                  jnp.inexact) else None
+            if leaf is None:
+                continue
+            s += float(leaf.sum())
+            a += float(np.abs(leaf).sum())
+        rows.append(np.asarray([s, a], np.float64))
+    if not rows:
+        rows = [np.zeros((2,), np.float64)]
+    return np.stack(rows)
+
+
+def check_replica_divergence(digests: np.ndarray,
+                             process_set=None) -> Optional[int]:
+    """Allgather this rank's digest matrix and compare: returns the
+    index of the first bucket whose digest differs across any pair of
+    ranks (bit-exact comparison — replicated params must match
+    exactly), or None when all replicas agree.  Eager collective; call
+    from the host-side guard loop, never inside jit."""
+    if not basics.is_initialized():
+        return None
+    ps_size = basics.size() if process_set is None \
+        else process_set.size()
+    if ps_size <= 1:
+        return None
+    # Ship the f64 BIT PATTERN as int32 words: jnp would silently
+    # truncate float64 to f32 without jax_enable_x64, and the compare
+    # below is bit-exact anyway.
+    bits = np.ascontiguousarray(digests, np.float64).view(np.int32)
+    gathered = np.asarray(
+        C.allgather(jnp.asarray(bits), name="guard_digest",
+                    process_set=process_set))
+    per_rank = gathered.reshape((ps_size,) + bits.shape)
+    ref = per_rank[0]
+    for r in range(1, ps_size):
+        neq = (per_rank[r] != ref).any(axis=-1)
+        if neq.any():
+            return int(np.argmax(neq))
+    return None
+
+
+__all__ = ["check_replica_divergence", "param_digests"]

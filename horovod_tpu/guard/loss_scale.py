@@ -1,0 +1,147 @@
+"""Dynamic loss scaling — the GradScaler-style schedule the training
+guard applies on every flagged step.
+
+Reference shape: torch.cuda.amp.GradScaler / tf.mixed_precision
+DynamicLossScale — multiply the loss by `scale` so small bf16/fp16
+gradients survive the backward pass, divide the reduced gradients by the
+same `scale` before the optimizer apply, HALVE the scale whenever the
+cross-rank non-finite sentinel flags a step (the apply is skipped in
+lockstep), and GROW it again after `growth_interval` consecutive clean
+applies.  Everything is `jnp.where`-based so the whole schedule lives
+inside the compiled step: no host round-trip decides whether to skip.
+
+The scale/counters travel in `GuardState`, carried by
+`DistributedOptState.guard` when `DistributedOptimizer(guard=...)` is
+on (see docs/GUARD.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..common import util
+
+
+class GuardState(NamedTuple):
+    """Per-step training-guard state (a pytree; rides the optimizer
+    state through the compiled step)."""
+
+    loss_scale: jnp.ndarray      # f32 scalar — current loss scale
+    good_steps: jnp.ndarray      # i32 scalar — consecutive clean applies
+    nonfinite_steps: jnp.ndarray  # i32 scalar — CONSECUTIVE flagged steps
+    #                               (the escalation ladder's K counter)
+    bucket_flags: jnp.ndarray    # f32[B] — last apply's per-bucket
+    #                               non-finite flags (attribution)
+    pending_flag: jnp.ndarray    # f32 scalar — OR of early-reduction
+    #                               pass flags since the last apply
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicLossScale:
+    """Loss-scale schedule configuration (static; the mutable scale and
+    counters live in `GuardState`).
+
+    `dynamic=False` pins the scale at `init_scale` forever — the
+    coordinated skip-step still runs, but no scaling arithmetic touches
+    the gradients when `init_scale == 1.0` (the guard-without-scaling
+    mode `from_env` returns when HOROVOD_GUARD_LOSS_SCALE is unset).
+
+    `growth_interval=None` defers to the live autotuner/env value
+    (`current_guard_growth_interval`) at trace time, so the
+    `loss_scale_growth_interval` knob takes effect on the next retrace.
+    """
+
+    init_scale: float = 65536.0
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: Optional[int] = None
+    dynamic: bool = True
+
+    @classmethod
+    def from_env(cls) -> "DynamicLossScale":
+        """HOROVOD_GUARD_LOSS_SCALE=<initial scale> arms dynamic
+        scaling; unset means skip-step only (static scale 1.0)."""
+        spec = util.getenv("GUARD_LOSS_SCALE")
+        if not spec:
+            return cls(init_scale=1.0, dynamic=False)
+        return cls(init_scale=float(spec), dynamic=True)
+
+    def _growth_interval(self) -> int:
+        if self.growth_interval is not None:
+            return int(self.growth_interval)
+        from ..utils.autotune import current_guard_growth_interval
+        return current_guard_growth_interval()
+
+    def init(self, n_buckets: int = 1) -> GuardState:
+        return GuardState(
+            loss_scale=jnp.asarray(self.init_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            nonfinite_steps=jnp.zeros((), jnp.int32),
+            bucket_flags=jnp.zeros((max(1, n_buckets),), jnp.float32),
+            pending_flag=jnp.zeros((), jnp.float32),
+        )
+
+    def scale_loss(self, state: GuardState, loss: Any) -> Any:
+        """Multiply the loss (pytree ok) by the current scale — call in
+        the step BEFORE `jax.grad`, paired with the optimizer's
+        internal unscale."""
+        return jax.tree_util.tree_map(
+            lambda v: v * state.loss_scale.astype(jnp.result_type(v)),
+            loss)
+
+    def unscale(self, state: GuardState, grads: Any) -> Any:
+        """Divide a gradient pytree by the current scale (what
+        `DistributedOptimizer(guard=...)` does internally before the
+        apply)."""
+        inv = 1.0 / state.loss_scale
+        return jax.tree_util.tree_map(
+            lambda g: (g * inv).astype(g.dtype), grads)
+
+    def update(self, state: GuardState,
+               bucket_flags: jnp.ndarray) -> GuardState:
+        """Advance the schedule given this apply's cross-rank per-bucket
+        flags: on overflow halve the scale and bump the consecutive
+        non-finite counter; on a clean apply grow the scale after
+        `growth_interval` good steps.  Pure `jnp.where` — identical on
+        every rank because `bucket_flags` is (the flags ride the
+        reduced buckets)."""
+        flag = jnp.maximum(jnp.max(bucket_flags), state.pending_flag)
+        bad = flag > 0
+        nonfinite = jnp.where(bad, state.nonfinite_steps + 1, 0)
+        good = jnp.where(bad, 0, state.good_steps + 1)
+        scale = state.loss_scale
+        if self.dynamic:
+            grow = jnp.logical_and(~bad, good >= self._growth_interval())
+            scale = jnp.where(
+                bad, scale * jnp.float32(self.backoff_factor),
+                jnp.where(grow, scale * jnp.float32(self.growth_factor),
+                          scale))
+            good = jnp.where(grow, 0, good)
+        return GuardState(
+            loss_scale=scale, good_steps=good,
+            nonfinite_steps=nonfinite, bucket_flags=bucket_flags,
+            pending_flag=jnp.zeros((), jnp.float32))
+
+    def accumulate(self, state: GuardState,
+                   pass_flags: jnp.ndarray) -> GuardState:
+        """Fold one early-reduction pass's flags into `pending_flag`
+        (consumed and cleared by the next `update`)."""
+        return state._replace(
+            pending_flag=jnp.maximum(state.pending_flag,
+                                     jnp.max(pass_flags)))
+
+
+def select_on_flag(flag: jnp.ndarray, clean: Any, flagged: Any) -> Any:
+    """Per-leaf `jnp.where(flag > 0, flagged, clean)` over two matching
+    pytrees — the gate callers use to revert caller-threaded state
+    (e.g. wire error-feedback residuals) on a flagged step."""
+    bad = flag > 0
+    return jax.tree_util.tree_map(
+        lambda c, f: jnp.where(bad, f, c), clean, flagged)
+
+
+__all__ = ["DynamicLossScale", "GuardState", "select_on_flag"]

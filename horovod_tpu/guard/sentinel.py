@@ -1,0 +1,113 @@
+"""Fused non-finite sentinel: per-bucket any-NaN/Inf flags computed
+inside the already-compiled reduction program.
+
+Each flag is a single f32 0/1 scalar per gradient bucket — `max`-reduced
+locally over the bucket's float leaves, then OR-ed across ranks with one
+Max-allreduce over the stacked flag vector, so every rank sees the
+bit-identical verdict the skip-step gate keys on.  Both the INPUT leaves
+(pre-wire; a quantized codec can launder NaN through an integer cast)
+and the reduced OUTPUT leaves (post-reduce overflow) feed the flag.
+
+No host round-trip: inside jit this lowers to `lax.pmax` on the same
+axis the gradient reduction used; eager it rides the normal allreduce
+bracket.  Cost is one scalar per bucket on the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import collectives as C
+
+
+def _leaf_nonfinite(leaf) -> Optional[jnp.ndarray]:
+    """0/1 f32 scalar: 1 when `leaf` holds any non-finite value; None
+    for non-float leaves (ints are finite by construction)."""
+    dt = jnp.result_type(leaf)
+    if not jnp.issubdtype(dt, jnp.inexact):
+        return None
+    return jnp.any(~jnp.isfinite(leaf)).astype(jnp.float32)
+
+
+def local_nonfinite(leaves: Sequence[Any]) -> jnp.ndarray:
+    """0/1 f32 scalar over a flat leaf list (this rank's view only)."""
+    flags = [f for f in map(_leaf_nonfinite, leaves) if f is not None]
+    if not flags:
+        return jnp.zeros((), jnp.float32)
+    return jnp.max(jnp.stack(flags))
+
+
+def bucket_flags_local(
+    leaves: Sequence[Any],
+    parts: Sequence[Sequence[int]],
+    outputs: Optional[Sequence[Any]] = None,
+) -> jnp.ndarray:
+    """f32[B] local per-bucket flags over the bucket partition `parts`
+    (index lists into `leaves`, as `gradient_bucket_partition` returns).
+    When `outputs` (same indexing) is given, each bucket's flag also
+    covers its reduced output leaves."""
+    out: List[jnp.ndarray] = []
+    for idxs in parts:
+        flag = local_nonfinite([leaves[i] for i in idxs])
+        if outputs is not None:
+            flag = jnp.maximum(
+                flag, local_nonfinite([outputs[i] for i in idxs]))
+        out.append(flag)
+    if not out:
+        return jnp.zeros((1,), jnp.float32)
+    return jnp.stack(out)
+
+
+def sliced_nonfinite(
+    leaves: Sequence[Any],
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """0/1 f32 scalar over a flat leaf list, where each participant on
+    `axis_name` scans only its 1/N contiguous slice of every float
+    leaf.  For REPLICATED data (an allreduce output every rank holds)
+    the subsequent cross-rank Max-OR restores full coverage while
+    cutting the redundant per-rank scan N-fold; the slice split is a
+    deterministic function of shapes, so the OR-ed verdict is still
+    bit-identical everywhere.  Falls back to the full local scan when
+    no axis is in scope (eager path)."""
+    if axis_name is None:
+        return local_nonfinite(leaves)
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    flags: List[jnp.ndarray] = []
+    for leaf in leaves:
+        dt = jnp.result_type(leaf)
+        if not jnp.issubdtype(dt, jnp.inexact):
+            continue
+        flat = jnp.ravel(leaf)
+        per = flat.size // n
+        if per:
+            mine = jax.lax.dynamic_slice(flat, (idx * per,), (per,))
+            flags.append(jnp.any(~jnp.isfinite(mine))
+                         .astype(jnp.float32))
+        tail = flat[n * per:]
+        if tail.size:
+            flags.append(jnp.any(~jnp.isfinite(tail))
+                         .astype(jnp.float32))
+    if not flags:
+        return jnp.zeros((), jnp.float32)
+    return jnp.max(jnp.stack(flags))
+
+
+def crossrank_or(
+    flags: jnp.ndarray,
+    axis_name: Optional[str] = None,
+    process_set=None,
+) -> jnp.ndarray:
+    """OR the 0/1 flag vector across ranks (one Max-allreduce; bit-exact
+    on 0/1 so every rank agrees).  Works eager and in-jit, including the
+    hierarchical ("dcn", "hvd") axis pair."""
+    return C.allreduce(flags, op=C.Max, axis_name=axis_name,
+                       process_set=process_set)
+
+
+__all__ = ["bucket_flags_local", "crossrank_or", "local_nonfinite",
+           "sliced_nonfinite"]

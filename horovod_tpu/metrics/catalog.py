@@ -146,6 +146,24 @@ checkpoint_rollbacks = _REG.counter(
     "Corrupt durable checkpoints skipped during restore (rolled back "
     "to an older good step).")
 
+# -- training-health guardian (guard/, parallel/optimizer.py) ---------------
+nonfinite_steps = _REG.counter(
+    "hvd_nonfinite_steps_total",
+    "Training steps whose cross-rank non-finite sentinel flagged (the "
+    "optimizer apply was skipped in lockstep on every rank).")
+loss_scale = _REG.gauge(
+    "hvd_loss_scale",
+    "Current dynamic loss scale (halved on flagged steps, grown after "
+    "loss_scale_growth_interval clean applies; see docs/GUARD.md).")
+guard_rollbacks = _REG.counter(
+    "hvd_guard_rollbacks_total",
+    "Guard escalations: restores of the last digest-verified checkpoint "
+    "after K consecutive non-finite steps or a digest mismatch.")
+digest_mismatch = _REG.counter(
+    "hvd_digest_mismatch_total",
+    "Cross-replica parameter-digest mismatches detected (silent replica "
+    "divergence, attributed to a bucket).")
+
 _enabled = not util.env_bool("METRICS_DISABLE", False)
 
 

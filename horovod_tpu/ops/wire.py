@@ -339,14 +339,64 @@ def policy_from_env() -> Optional[WirePolicy]:
     return parse_wire_policy(spec)
 
 
+# -- error-feedback reset hooks ---------------------------------------------
+# EF residuals are CALLER-owned state (threaded through steps like
+# optimizer state), so the wire layer cannot zero them directly.  What it
+# can do is own the reset *protocol*: holders register a callback (or
+# poll the generation counter) and the elastic reset / guard rollback
+# paths call `reset_error_feedback()` — without this, a residual encoded
+# against pre-recovery gradients bleeds its stale correction into the
+# first post-recovery step.
+_ef_generation = 0
+_ef_reset_hooks: list = []
+
+
+def register_error_feedback_reset(hook) -> None:
+    """Register `hook()` to run on every `reset_error_feedback()` —
+    for holders of EF residual state (training loops, State objects)
+    that must zero it when a recovery path invalidates it."""
+    _ef_reset_hooks.append(hook)
+
+
+def unregister_error_feedback_reset(hook) -> None:
+    """Remove a previously registered reset hook (no-op if absent)."""
+    try:
+        _ef_reset_hooks.remove(hook)
+    except ValueError:
+        pass
+
+
+def reset_error_feedback() -> int:
+    """Invalidate all outstanding wire error-feedback residuals: bump
+    the generation counter and run the registered hooks.  Called by the
+    elastic reset path and the guard rollback; returns the new
+    generation."""
+    global _ef_generation
+    _ef_generation += 1
+    for hook in list(_ef_reset_hooks):
+        hook()
+    return _ef_generation
+
+
+def error_feedback_generation() -> int:
+    """The current EF generation — holders that cannot register a hook
+    compare this against the generation they captured at residual-init
+    and re-zero when it moved."""
+    return _ef_generation
+
+
 __all__ = [
     "WireCodec",
     "WirePolicy",
     "cast_wire_names",
     "compressor_wire",
+    "error_feedback_generation",
     "get_codec",
     "local_roundtrip",
     "parse_wire_policy",
     "policy_from_env",
+    "register_error_feedback_reset",
+    "reset_error_feedback",
+    "unregister_error_feedback_reset",
     "wire_names",
 ]

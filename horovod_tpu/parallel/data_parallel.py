@@ -203,6 +203,53 @@ def wire_policy_plan(
     return plan
 
 
+def _sentinel_flags(
+    leaves: Sequence[Any],
+    results,
+    axis_name: Optional[str],
+    process_set: Optional[ProcessSet],
+    input_buckets=(),
+    sliced_inputs: bool = False,
+) -> Any:
+    """The fused non-finite sentinel: per-bucket 0/1 flags over the
+    reduced OUTPUT leaves, OR-ed across ranks with one Max-allreduce so
+    every rank keys the skip-step gate off the identical f32[B] vector.
+
+    Exact and dtype-cast wires PROPAGATE non-finites (NaN+x=NaN,
+    fp16 overflow goes to Inf), so the output check alone is complete
+    for them — no pass over the inputs.  A quantizing codec's integer
+    cast can launder NaN, so buckets riding one are listed in
+    `input_buckets` (bucket positions, or True for all) and get the
+    extra full pre-wire INPUT-leaf check.  `sliced_inputs` adds a 1/N
+    sliced input scan to the remaining buckets: logically redundant,
+    but scanning the inputs gives XLA's scheduler non-finite work that
+    overlaps the collectives — the outputs-only program measured ~2x
+    slower end-to-end on the CPU backend.  Cost: one scalar per
+    bucket.  See docs/GUARD.md."""
+    from ..guard import sentinel as _sent
+    tl = _tl.get_timeline()
+    flags = []
+    for k, (idxs, outs) in enumerate(results):
+        # The reduced outputs are replicated across the axis, so each
+        # participant scans only its 1/N interleave; the Max-allreduce
+        # below restores full coverage.
+        f = _sent.sliced_nonfinite(outs, axis_name)
+        if input_buckets is True or k in input_buckets:
+            f = jnp.maximum(
+                f, _sent.local_nonfinite([leaves[i] for i in idxs]))
+        elif sliced_inputs:
+            f = jnp.maximum(f, _sent.sliced_nonfinite(
+                [leaves[i] for i in idxs], axis_name))
+        flags.append(f)
+        if tl is not None:
+            tl.instant(f"guard_bucket_{k}", category="guard",
+                       args={"leaves": len(idxs)})
+    vec = (jnp.stack(flags) if flags
+           else jnp.zeros((1,), jnp.float32))
+    return _sent.crossrank_or(vec, axis_name=axis_name,
+                              process_set=process_set)
+
+
 def reduce_gradient_buckets(
     leaves: Sequence[Any],
     op: C.ReduceOp = C.Average,
@@ -212,6 +259,7 @@ def reduce_gradient_buckets(
     fusion_threshold_bytes: Optional[int] = None,
     bucket_order=None,
     error_feedback_leaves=None,
+    sentinel: bool = False,
 ):
     """Reduce a flat gradient-leaf list bucket by bucket.
 
@@ -220,6 +268,10 @@ def reduce_gradient_buckets(
     (the partition from `gradient_bucket_partition`), and `new_ef` is
     the updated per-float-leaf EF residual list in original float-leaf
     order (None unless `error_feedback_leaves` was passed).
+
+    `sentinel=True` appends a third element: the cross-rank-agreed
+    f32[B] per-bucket non-finite flag vector (`_sentinel_flags`),
+    computed inside the same compiled program as the reduction.
 
     This is the single reduction engine behind `allreduce_gradients`
     (which reassembles the full tree) and the per-bucket fused optimizer
@@ -327,8 +379,13 @@ def reduce_gradient_buckets(
                         leaves[i].shape)
                 offset += n
             results.append((idxs, outs))
-        return results, (new_ef if error_feedback_leaves is not None
-                         else None)
+        ef_out = (new_ef if error_feedback_leaves is not None else None)
+        if sentinel:
+            # Every float bucket rode the quantized ring: input checks on.
+            return results, ef_out, _sentinel_flags(
+                leaves, results, axis_name, process_set,
+                input_buckets=True)
+        return results, ef_out
     if policy is not None:
         if op not in (C.Average, C.Sum):
             raise ValueError(
@@ -357,12 +414,15 @@ def reduce_gradient_buckets(
         results = []
         raw_total = wire_total = 0
         fmt_bytes: dict = {}
+        launder_buckets = set()  # rode a NaN-laundering quantized codec
         for k, idxs in enumerate(parts):
             all_float = all(i in float_ord for i in idxs)
             raw = sum(leaves[i].size * leaves[i].dtype.itemsize
                       for i in idxs)
             codec = _wire.get_codec(policy.codec_for(raw, all_float))
             nelem = sum(leaves[i].size for i in idxs)
+            if not codec.exact and codec.cast_dtype is None:
+                launder_buckets.add(k)
             if codec.exact:
                 wbytes = raw
                 outs = list(C.grouped_allreduce(
@@ -423,6 +483,10 @@ def reduce_gradient_buckets(
                     _met.wire_format_bytes.labels(fmt).set(b)
             else:
                 _met.wire_bytes_saved.inc(raw_total - wire_total)
+        if sentinel:
+            return results, new_ef, _sentinel_flags(
+                leaves, results, axis_name, process_set,
+                input_buckets=launder_buckets, sliced_inputs=True)
         return results, new_ef
     compressed, ctxs = [], []
     for leaf in leaves:
@@ -440,6 +504,9 @@ def reduce_gradient_buckets(
         results.append(
             (idxs, [compression.decompress(r, ctxs[i])
                     for i, r in zip(idxs, reduced)]))
+    if sentinel:
+        return results, None, _sentinel_flags(
+            leaves, results, axis_name, process_set, sliced_inputs=True)
     return results, None
 
 
@@ -452,6 +519,7 @@ def allreduce_gradients(
     fusion_threshold_bytes: Optional[int] = None,
     bucket_order=None,
     error_feedback_state: Any = None,
+    sentinel: bool = False,
 ) -> Any:
     """Average a gradient pytree across ranks with wire compression and
     fusion-buffer-style bucketing (reference: FusionBufferManager — here
@@ -480,11 +548,20 @@ def allreduce_gradients(
     quantization bias telescopes away (time-averaged error O(1/t)
     instead of a persistent bias).  When passed, the return value is
     `(reduced, new_error_feedback_state)`; thread the state through
-    your step like optimizer state."""
+    your step like optimizer state.
+
+    `sentinel=True` additionally returns the cross-rank per-bucket
+    non-finite flag vector (f32[B]) as the LAST element — `reduced` /
+    `(reduced, flags)` / `(reduced, new_ef, flags)` depending on which
+    options are on (see docs/GUARD.md)."""
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
-        return ((grads, error_feedback_state)
-                if error_feedback_state is not None else grads)
+        out = [grads]
+        if error_feedback_state is not None:
+            out.append(error_feedback_state)
+        if sentinel:
+            out.append(jnp.zeros((1,), jnp.float32))
+        return tuple(out) if len(out) > 1 else out[0]
     if _met.enabled():
         nbytes = sum(l.size * l.dtype.itemsize for l in leaves
                      if hasattr(l, "size") and hasattr(l, "dtype"))
@@ -499,19 +576,27 @@ def allreduce_gradients(
     ef_leaves = ef_def = None
     if error_feedback_state is not None:
         ef_leaves, ef_def = jax.tree_util.tree_flatten(error_feedback_state)
-    results, new_ef = reduce_gradient_buckets(
+    red = reduce_gradient_buckets(
         leaves, op=op, compression=compression, axis_name=axis_name,
         process_set=process_set,
         fusion_threshold_bytes=fusion_threshold_bytes,
-        bucket_order=bucket_order, error_feedback_leaves=ef_leaves)
+        bucket_order=bucket_order, error_feedback_leaves=ef_leaves,
+        sentinel=sentinel)
+    if sentinel:
+        results, new_ef, flags = red
+    else:
+        results, new_ef = red
     out = [None] * len(leaves)
     for idxs, reduced in results:
         for i, r in zip(idxs, reduced):
             out[i] = r
     result = jax.tree_util.tree_unflatten(treedef, out)
+    ret = [result]
     if error_feedback_state is not None:
-        return result, jax.tree_util.tree_unflatten(ef_def, new_ef)
-    return result
+        ret.append(jax.tree_util.tree_unflatten(ef_def, new_ef))
+    if sentinel:
+        ret.append(flags)
+    return tuple(ret) if len(ret) > 1 else result
 
 
 def error_feedback_init(grads: Any):

@@ -50,6 +50,8 @@ class DistributedOptState(NamedTuple):
     #                     fused_apply / shard_optimizer_states
     accum: Any          # local gradient accumulator
     counter: jnp.ndarray  # passes since last sync
+    guard: Any = None   # guard.GuardState when guard= is on (loss scale,
+    #                     skip counters, per-bucket sentinel flags)
 
 
 class _ShardSlot(NamedTuple):
@@ -98,7 +100,8 @@ def sharded_state_specs(state: DistributedOptState, axis_name=GLOBAL_AXIS):
     inner = jax.tree_util.tree_map(
         lambda _: PartitionSpec(axis), state.inner)
     accum = jax.tree_util.tree_map(lambda _: PartitionSpec(), state.accum)
-    return DistributedOptState(inner, accum, PartitionSpec())
+    guard = jax.tree_util.tree_map(lambda _: PartitionSpec(), state.guard)
+    return DistributedOptState(inner, accum, PartitionSpec(), guard)
 
 
 def DistributedGradientTransformation(
@@ -115,6 +118,7 @@ def DistributedGradientTransformation(
     early_reduction: bool = False,
     shard_optimizer_states: Optional[bool] = None,
     allgather_wire: Optional[str] = None,
+    guard: Any = None,
 ) -> optax.GradientTransformation:
     """Wrap `optimizer` so updates are computed from cross-rank-reduced
     gradients.  See module docstring for the reference mapping.
@@ -164,7 +168,18 @@ def DistributedGradientTransformation(
     `lax.all_gather` in the wire dtype; cooperative wires (int8 / int4 /
     fp8_*) ride the block-scaled payload gather — flat axis only (the
     ring spans one named axis, so a 2-tuple hierarchical axis needs a
-    cast wire)."""
+    cast wire).
+
+    `guard` (env: HOROVOD_GUARD) arms the training-health guardian
+    (docs/GUARD.md): the reduction computes a fused per-bucket
+    non-finite sentinel OR-ed across ranks, the incoming gradients are
+    unscaled by the current dynamic loss scale, and on a flagged step
+    EVERY rank skips the optimizer apply in lockstep (updates zeroed,
+    inner state reverted) while the scale decays — all inside the
+    compiled step, no host round-trip.  `True` reads the schedule from
+    the env (`DynamicLossScale.from_env`); pass a `DynamicLossScale`
+    for explicit knobs.  State rides `DistributedOptState.guard`.
+    Incompatible with op=Adasum (no reduction result to flag)."""
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
     if op is C.Adasum and (fused_apply or early_reduction):
@@ -172,6 +187,23 @@ def DistributedGradientTransformation(
             "fused_apply / early_reduction are incompatible with "
             "op=Adasum: Adasum combines post-update deltas, so there is "
             "no per-bucket reduction result to consume early")
+    if guard is None:
+        guard = util.env_bool("GUARD", False)
+    if guard is False:
+        scaler = None
+    else:
+        from ..guard.loss_scale import DynamicLossScale
+        scaler = (DynamicLossScale.from_env() if guard is True
+                  else guard)
+        if not isinstance(scaler, DynamicLossScale):
+            raise ValueError(
+                f"guard= takes True/False or a guard.DynamicLossScale, "
+                f"got {guard!r}")
+        if op is C.Adasum:
+            raise ValueError(
+                "guard= is incompatible with op=Adasum: Adasum combines "
+                "post-update deltas, so there is no per-bucket "
+                "reduction result for the non-finite sentinel to flag")
     if shard_optimizer_states is None:
         shard_optimizer_states = util.env_bool("SHARD_OPTIMIZER", False)
     if allgather_wire is None:
@@ -218,12 +250,12 @@ def DistributedGradientTransformation(
             "allgather_wire requires shard_optimizer_states=True (it "
             "is the wire of the sharded param allgather)")
 
-    def reduce_grads(grads):
+    def reduce_grads(grads, sentinel=False):
         return allreduce_gradients(
             grads, op=op, compression=compression, axis_name=axis_name,
             process_set=process_set,
             fusion_threshold_bytes=fusion_threshold_bytes,
-            bucket_order=bucket_order,
+            bucket_order=bucket_order, sentinel=sentinel,
         )
 
     def _partition(leaves):
@@ -253,6 +285,29 @@ def DistributedGradientTransformation(
             return jnp.ravel(leaves[idxs[0]]).astype(dt)
         return jnp.concatenate(
             [jnp.ravel(leaves[i]).astype(dt) for i in idxs])
+
+    def _guard_parts(leaves):
+        # The flag vector's bucketing must match the apply path's:
+        # shard groups for the ZeRO path, the reduction partition
+        # otherwise (both functions are deterministic in the tunables,
+        # so init and update agree exactly like the state partitions).
+        return (_shard_groups(leaves) if shard_optimizer_states
+                else _partition(leaves))
+
+    # Multiplying by a loss scale pinned at 1.0 would still perturb NaN
+    # payload bits and defeat the "guard-on equals guard-off bitwise"
+    # contract on clean runs, so the static-1.0 schedule skips the
+    # arithmetic entirely.
+    _unscales = scaler is not None and (
+        scaler.dynamic or scaler.init_scale != 1.0)
+
+    def _unscale(tree, gstate):
+        if not _unscales:
+            return tree
+        inv = 1.0 / gstate.loss_scale
+        return jax.tree_util.tree_map(
+            lambda g: (g * inv.astype(jnp.result_type(g))).astype(
+                jnp.result_type(g)), tree)
 
     def init_fn(params):
         if shard_optimizer_states:
@@ -294,7 +349,12 @@ def DistributedGradientTransformation(
             _met.opt_state_bytes.set(optimizer_state_bytes(
                 DistributedOptState(inner, None, None)))
         accum = jax.tree_util.tree_map(jnp.zeros_like, params)
-        return DistributedOptState(inner, accum, jnp.zeros((), jnp.int32))
+        guard_state = None
+        if scaler is not None:
+            g_leaves = jax.tree_util.tree_flatten(params)[0]
+            guard_state = scaler.init(len(_guard_parts(g_leaves)))
+        return DistributedOptState(inner, accum, jnp.zeros((), jnp.int32),
+                                   guard_state)
 
     def _sharded_update(grads, state, params, pre_reduced):
         from ..utils.autotune import current_ag_fusion
@@ -345,6 +405,9 @@ def DistributedGradientTransformation(
         rs_bytes = 0
         ag_bytes = 0
         pending = []  # deferred (send_shard, finish) under fused allgather
+        g_flags = []  # per-group local sentinel flags (guard= only)
+        if scaler is not None:
+            from ..guard import sentinel as _sent
 
         for gi, (idxs, slot) in enumerate(zip(groups, state.inner)):
             if not isinstance(slot, _ShardSlot):
@@ -356,6 +419,15 @@ def DistributedGradientTransformation(
             shapes = [jnp.shape(leaves[i]) for i in idxs]
             sizes = [leaves[i].size for i in idxs]
             flat = _group_flat(leaves, idxs, dt)
+            # Sentinel input flag: pre-wire, over the whole group (the
+            # reduce-scatter leaves each rank only 1/N of the OUTPUT,
+            # so the input side must be local).  Only needed when the
+            # wire can LAUNDER a NaN (quantized integer cast) — exact
+            # and cast wires propagate non-finites into some rank's
+            # output shard, which the cross-rank flag OR already sees.
+            in_flag = (_sent.local_nonfinite([flat])
+                       if scaler is not None and rs_wire is not None
+                       and rs_codec.cast_dtype is None else None)
             padn = (-flat.size) % n_now
             padded = flat.size + padn
             shard_sz = padded // n_now
@@ -422,6 +494,12 @@ def DistributedGradientTransformation(
                     g_shard = (g_shard / n_now).astype(g_shard.dtype)
                 g_shard = compression.decompress(g_shard, ctx)
                 rs_bytes += padded * jnp.dtype(c.dtype).itemsize
+
+            if scaler is not None:
+                out_flag = _sent.local_nonfinite([g_shard])
+                g_flags.append(out_flag if in_flag is None
+                               else jnp.maximum(in_flag, out_flag))
+                g_shard = _unscale(g_shard, state.guard)
 
             p_shard = None
             if p_leaves is not None:
@@ -509,8 +587,14 @@ def DistributedGradientTransformation(
             if not pre_reduced:
                 _met.rs_bytes.set(rs_bytes)
             _met.param_ag_bytes.set(ag_bytes)
+        flags = None
+        if scaler is not None:
+            vec = (jnp.stack(g_flags) if g_flags
+                   else jnp.zeros((1,), jnp.float32))
+            flags = _sent.crossrank_or(vec, axis_name=axis_name,
+                                       process_set=process_set)
         return (jax.tree_util.tree_unflatten(treedef, out),
-                tuple(new_inner))
+                tuple(new_inner), flags)
 
     def _fused_update(grads, state, params, pre_reduced):
         leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -524,8 +608,20 @@ def DistributedGradientTransformation(
                 "fusion threshold / bucket order moved under the state "
                 "(autotuner proposal?) — re-init the optimizer state "
                 "after tunables change")
+        flags = None
         if pre_reduced:
             results = [(idxs, [leaves[i] for i in idxs]) for idxs in parts]
+            if scaler is not None:
+                # Already cross-rank reduced, so the leaves (and hence
+                # these flags) are rank-identical — no collective needed.
+                from ..guard import sentinel as _sent
+                flags = _sent.bucket_flags_local(leaves, parts)
+        elif scaler is not None:
+            results, _, flags = reduce_gradient_buckets(
+                leaves, op=op, compression=compression,
+                axis_name=axis_name, process_set=process_set,
+                fusion_threshold_bytes=fusion_threshold_bytes,
+                bucket_order=bucket_order, sentinel=True)
         else:
             results, _ = reduce_gradient_buckets(
                 leaves, op=op, compression=compression,
@@ -540,17 +636,21 @@ def DistributedGradientTransformation(
         for (idxs, reduced), bstate in zip(results, state.inner):
             bparams = ([p_leaves[i] for i in idxs]
                        if p_leaves is not None else None)
-            u, s2 = optimizer.update(list(reduced), bstate, bparams)
+            reduced = _unscale(list(reduced), state.guard) \
+                if scaler is not None else list(reduced)
+            u, s2 = optimizer.update(reduced, bstate, bparams)
             new_inner.append(s2)
             for i, ui in zip(idxs, u):
                 out[i] = ui
-        return jax.tree_util.tree_unflatten(treedef, out), tuple(new_inner)
+        return (jax.tree_util.tree_unflatten(treedef, out),
+                tuple(new_inner), flags)
 
     def _sync_update(grads, state, params, pre_reduced=False):
+        flags = None
         if op is C.Adasum:
             # Adasum mode: compute the local delta first, then combine
             # deltas with the projection-corrected reduction (reference:
-            # _DistributedAdasumOptimizer).
+            # _DistributedAdasumOptimizer).  guard= is rejected above.
             updates, inner = optimizer.update(grads, state.inner, params)
             updates = jax.tree_util.tree_map(
                 lambda u: C.allreduce(u, op=C.Adasum, axis_name=axis_name,
@@ -558,14 +658,25 @@ def DistributedGradientTransformation(
                 updates,
             )
         elif shard_optimizer_states:
-            updates, inner = _sharded_update(grads, state, params,
-                                             pre_reduced)
+            updates, inner, flags = _sharded_update(grads, state, params,
+                                                    pre_reduced)
         elif fused_apply:
-            updates, inner = _fused_update(grads, state, params,
-                                           pre_reduced)
+            updates, inner, flags = _fused_update(grads, state, params,
+                                                  pre_reduced)
         else:
             if not pre_reduced:
-                grads = reduce_grads(grads)
+                if scaler is not None:
+                    grads, flags = reduce_grads(grads, sentinel=True)
+                else:
+                    grads = reduce_grads(grads)
+            elif scaler is not None:
+                # Already reduced (rank-identical): local flags suffice.
+                from ..guard import sentinel as _sent
+                leaves = jax.tree_util.tree_leaves(grads)
+                flags = _sent.bucket_flags_local(leaves,
+                                                 _partition(leaves))
+            if scaler is not None:
+                grads = _unscale(grads, state.guard)
             updates, inner = optimizer.update(grads, state.inner, params)
         if _met.enabled() and not any(
                 isinstance(l, jax.core.Tracer)
@@ -573,13 +684,32 @@ def DistributedGradientTransformation(
             # Eager executions only: under jit this body runs once per
             # compile, so counting here would undercount (and mislead).
             _met.optimizer_syncs.inc()
-        return updates, inner
+        return updates, inner, flags
+
+    def _gate(updates, inner, old_inner, gstate, flags):
+        """The coordinated skip-step: every rank holds the identical
+        cross-rank `flags`, so this lowers to the same select on every
+        replica — zero updates, revert the inner state (masters
+        included), advance the loss-scale schedule.  On a clean step
+        the selects are bitwise identity, keeping the no-fault path
+        equal to the unguarded pipeline."""
+        new_guard = scaler.update(gstate, flags)
+        bad = jnp.maximum(jnp.max(flags), gstate.pending_flag) > 0
+        updates = jax.tree_util.tree_map(
+            lambda u: jnp.where(bad, jnp.zeros_like(u), u), updates)
+        inner = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(bad, o, n), inner, old_inner)
+        return updates, inner, new_guard
 
     if backward_passes_per_step == 1:
         def update_fn(grads, state, params=None):
-            updates, inner = _sync_update(grads, state, params)
+            updates, inner, flags = _sync_update(grads, state, params)
+            guard_state = state.guard
+            if scaler is not None:
+                updates, inner, guard_state = _gate(
+                    updates, inner, state.inner, state.guard, flags)
             return updates, DistributedOptState(
-                inner, state.accum, state.counter
+                inner, state.accum, state.counter, guard_state
             )
 
         return optax.GradientTransformation(init_fn, update_fn)
@@ -592,36 +722,51 @@ def DistributedGradientTransformation(
              if average_aggregated_gradients else 1.0)
 
     def update_fn(grads, state, params=None):
+        gstate = state.guard
         if early_reduction:
-            grads = reduce_grads(grads)
+            if scaler is not None:
+                # Each pass's flags fold into pending_flag now (the
+                # poisoned pass is already inside the accumulator) and
+                # gate the apply on the Nth pass.
+                grads, pflags = reduce_grads(grads, sentinel=True)
+                gstate = scaler.accumulate(gstate, pflags)
+            else:
+                grads = reduce_grads(grads)
         accum = jax.tree_util.tree_map(
             lambda a, g: a + g, state.accum, grads
         )
         counter = state.counter + 1
         is_sync = counter >= backward_passes_per_step
+        state2 = state._replace(guard=gstate)
 
         def do_sync(_):
             agg = jax.tree_util.tree_map(
                 lambda a: (a * scale).astype(a.dtype), accum
             )
-            updates, inner = _sync_update(agg, state, params,
-                                          pre_reduced=early_reduction)
+            updates, inner, flags = _sync_update(
+                agg, state2, params, pre_reduced=early_reduction)
+            guard_state = gstate
+            if scaler is not None:
+                updates, inner, guard_state = _gate(
+                    updates, inner, state.inner, gstate, flags)
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, accum)
-            return updates, inner, zeroed, jnp.zeros((), jnp.int32)
+            return (updates, inner, zeroed, jnp.zeros((), jnp.int32),
+                    guard_state)
 
         def skip(_):
             updates = jax.tree_util.tree_map(jnp.zeros_like, grads)
-            return updates, state.inner, accum, counter
+            return updates, state.inner, accum, counter, gstate
 
         if isinstance(is_sync, jax.core.Tracer):
-            updates, inner, accum2, counter2 = jax.lax.cond(
+            updates, inner, accum2, counter2, guard2 = jax.lax.cond(
                 is_sync, do_sync, skip, operand=None
             )
         else:
-            updates, inner, accum2, counter2 = (
+            updates, inner, accum2, counter2, guard2 = (
                 do_sync(None) if bool(is_sync) else skip(None)
             )
-        return updates, DistributedOptState(inner, accum2, counter2)
+        return updates, DistributedOptState(inner, accum2, counter2,
+                                            guard2)
 
     return optax.GradientTransformation(init_fn, update_fn)
 

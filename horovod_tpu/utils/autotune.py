@@ -347,6 +347,17 @@ def init_from_env() -> Optional[ParameterManager]:
     pm.register("wire_threshold", 64 << 10, 64 << 20, log_scale=True,
                 integer=True,
                 initial=util.env_int("WIRE_THRESHOLD", 1 << 20))
+    # Training-guard knobs (docs/GUARD.md): how many clean applies
+    # before the dynamic loss scale grows back, and how often the
+    # cross-replica parameter-digest collective runs.  Both trade
+    # recovery latency against overhead, so they live in the tuner
+    # space alongside the wire knobs they interact with.
+    pm.register("loss_scale_growth_interval", 10, 10000, log_scale=True,
+                integer=True,
+                initial=util.env_int("GUARD_GROWTH_INTERVAL", 2000))
+    pm.register("guard_digest_interval", 10, 10000, log_scale=True,
+                integer=True,
+                initial=util.env_int("GUARD_DIGEST_INTERVAL", 100))
     _manager = pm
     logger.info("autotune enabled: %s", pm.values())
     return pm
@@ -446,3 +457,40 @@ def current_wire_threshold() -> int:
     overridden by the autotuner when active.  Only consulted when the
     HOROVOD_WIRE_POLICY spec omits an explicit threshold=."""
     return tuned_wire_threshold(util.env_int("WIRE_THRESHOLD", 1 << 20))
+
+
+def tuned_guard_growth_interval(default: int) -> int:
+    """Loss-scale growth interval honoring the autotuner when active
+    (used by guard.DynamicLossScale)."""
+    if _manager is not None and \
+            "loss_scale_growth_interval" in _manager._tunables:
+        return max(1, int(_manager.value("loss_scale_growth_interval")))
+    return default
+
+
+def current_guard_growth_interval() -> int:
+    """The live loss-scale growth interval: HOROVOD_GUARD_GROWTH_INTERVAL
+    (2000 clean applies, the GradScaler default), overridden by the
+    autotuner when active.  Consulted at trace time, so a tuner move
+    takes effect on the next retrace."""
+    return tuned_guard_growth_interval(
+        max(1, util.env_int("GUARD_GROWTH_INTERVAL", 2000)))
+
+
+def tuned_guard_digest_interval(default: int) -> int:
+    """Cross-replica digest interval honoring the autotuner when active
+    (used by guard.TrainingGuard)."""
+    if _manager is not None and \
+            "guard_digest_interval" in _manager._tunables:
+        return max(1, int(_manager.value("guard_digest_interval")))
+    return default
+
+
+def current_guard_digest_interval() -> int:
+    """The live digest-check cadence: HOROVOD_GUARD_DIGEST_INTERVAL
+    (every 100 steps; 0 disables), overridden by the autotuner when
+    active.  Host-side — takes effect on the next step, no retrace."""
+    env = util.env_int("GUARD_DIGEST_INTERVAL", 100)
+    if env <= 0:
+        return 0
+    return tuned_guard_digest_interval(env)

@@ -37,13 +37,14 @@ import shutil
 from typing import Any, Callable, List, Optional
 
 from .. import faults as _faults
-from ..common import basics
+from ..common import basics, util
 from ..common.exceptions import CheckpointCorruptError
 from ..metrics import catalog as _met
 
 logger = logging.getLogger("horovod_tpu.checkpoint")
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+_CORRUPT_RE = re.compile(r"^step_(\d+)\.corrupt$")
 _DIGEST_FILE = "state.sha256"
 
 
@@ -208,7 +209,9 @@ class CheckpointManager:
     def _quarantine(self, step: int) -> None:
         """Move a corrupt step_N aside as step_N.corrupt (kept for
         forensics, excluded from step listings) so rollback can't pick
-        it again."""
+        it again.  The quarantine is capped at the newest
+        HOROVOD_CKPT_QUARANTINE_KEEP entries (default 3) — repeated
+        rollbacks must not grow the directory unboundedly."""
         src = os.path.join(self._dir, f"step_{step}")
         dst = src + ".corrupt"
         try:
@@ -218,6 +221,28 @@ class CheckpointManager:
             shutil.rmtree(src, ignore_errors=True)
         if _met.enabled():
             _met.checkpoint_rollbacks.inc()
+        self._prune_quarantine()
+
+    def _prune_quarantine(self) -> None:
+        keep = max(0, util.env_int("CKPT_QUARANTINE_KEEP", 3))
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return
+        steps = []
+        for name in names:
+            m = _CORRUPT_RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        steps.sort()
+        stale = steps[:-keep] if keep else steps
+        for s in stale:
+            shutil.rmtree(os.path.join(self._dir, f"step_{s}.corrupt"),
+                          ignore_errors=True)
+        if stale:
+            logger.info(
+                "pruned %d quarantined checkpoint(s) older than the "
+                "newest %d (steps %s)", len(stale), keep, stale)
 
     def _read_latest_good(self, template: Any) -> Optional[Any]:
         """Newest step first; corrupt steps are quarantined and the scan

@@ -43,8 +43,14 @@ _seqs: Dict[tuple, int] = {}
 _round = 0
 _kv = None
 
-_TIMEOUT_S = 30.0
 _POLL_S = 0.02
+
+
+def _timeout_s() -> float:
+    """How long to wait for peers' signatures before declaring them
+    divergent/stalled (HOROVOD_CONSISTENCY_TIMEOUT seconds; read per
+    check so tests and long-compile phases can adjust it live)."""
+    return util.env_float("CONSISTENCY_TIMEOUT", 30.0)
 
 
 def enabled() -> bool:
@@ -111,7 +117,8 @@ def check(sig: Dict[str, Any], ranks=None) -> None:
     me = basics.process_index()
     mine = json.dumps(sig, sort_keys=True)
     kv.put(f"{base}/{me}", mine)
-    deadline = time.monotonic() + _TIMEOUT_S
+    timeout_s = _timeout_s()
+    deadline = time.monotonic() + timeout_s
     while True:
         keys = kv.keys(f"{base}/")
         have = {int(k.rsplit("/", 1)[1]) for k in keys}
@@ -121,7 +128,7 @@ def check(sig: Dict[str, Any], ranks=None) -> None:
             missing = sorted(set(expected) - have)
             raise HorovodTpuError(
                 f"collective consistency check: processes {missing} did "
-                f"not submit collective #{s} within {_TIMEOUT_S}s (this "
+                f"not submit collective #{s} within {timeout_s}s (this "
                 f"process submitted {mine}) — peers are running a "
                 f"different program or have stalled")
         time.sleep(_POLL_S)

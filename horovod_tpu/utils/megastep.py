@@ -97,7 +97,8 @@ def scan_steps(step_fn: Callable[[Any, Any], Tuple[Any, Any]],
 
 def early_reduction_body(grad_fn: Callable[[Any, Any], Any], k: int,
                          reduce_fn: Callable[[Any], Any] = None,
-                         average: bool = True) -> Callable:
+                         average: bool = True,
+                         sentinel: bool = False) -> Callable:
     """Unjitted `fn(params, batches) -> reduced_grads` accumulating
     gradients over k microbatches stacked on a leading [k, ...] axis,
     with microbatch j's cross-rank reduction issued BEFORE microbatch
@@ -131,6 +132,14 @@ def early_reduction_body(grad_fn: Callable[[Any, Any], Any], k: int,
     plain `dynamic_slice` — the slice of an allreduce equals the
     reduce-scatter by the same linearity, preserving the bitwise
     contract above (see docs/SHARDED_OPTIMIZER.md).
+
+    `sentinel=True` runs each microbatch's reduction with the fused
+    non-finite sentinel (docs/GUARD.md) and returns
+    `(reduced_grads, flags)` where `flags` is the elementwise max of
+    every pass's cross-rank per-bucket flag vector — feed it to
+    `DynamicLossScale.accumulate` / the skip-step gate.  With a custom
+    `reduce_fn`, that fn must accept `sentinel=` and return the
+    `(reduced, flags)` pair itself.
     """
     if not isinstance(k, int) or k < 1:
         raise HorovodTpuError(
@@ -138,12 +147,19 @@ def early_reduction_body(grad_fn: Callable[[Any, Any], Any], k: int,
     if reduce_fn is None:
         from ..parallel.data_parallel import allreduce_gradients
         reduce_fn = allreduce_gradients
+    if sentinel:
+        reduce_fn = partial(reduce_fn, sentinel=True)
 
     def many(params, batches):
         acc = [None, None]
+        flags = None
         for j in range(k):
             mb = jax.tree.map(lambda b: b[j], batches)
             r = reduce_fn(grad_fn(params, mb))
+            if sentinel:
+                r, f = r
+                flags = f if flags is None else jax.numpy.maximum(
+                    flags, f)
             prev = acc[j % 2]
             acc[j % 2] = r if prev is None else jax.tree.map(
                 lambda a, g: a + g, prev, r)
@@ -152,17 +168,19 @@ def early_reduction_body(grad_fn: Callable[[Any, Any], Any], k: int,
         if average:
             total = jax.tree.map(
                 lambda g: (g / k).astype(g.dtype), total)
-        return total
+        return (total, flags) if sentinel else total
 
     return many
 
 
 def early_reduction_steps(grad_fn: Callable[[Any, Any], Any], k: int,
                           reduce_fn: Callable[[Any], Any] = None,
-                          average: bool = True) -> Callable:
+                          average: bool = True,
+                          sentinel: bool = False) -> Callable:
     """Jitted `early_reduction_body` (params are read, not updated —
     nothing to donate)."""
-    return jax.jit(early_reduction_body(grad_fn, k, reduce_fn, average))
+    return jax.jit(early_reduction_body(grad_fn, k, reduce_fn, average,
+                                        sentinel))
 
 
 __all__ = ["repeat_body", "scan_body", "repeat_steps", "scan_steps",

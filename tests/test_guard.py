@@ -1,0 +1,554 @@
+"""Training-health guardian (horovod_tpu/guard/, docs/GUARD.md): fused
+non-finite sentinel, coordinated skip-step with dynamic loss scaling,
+cross-replica digest divergence detection, and the rollback ladder.
+
+Fast tests run on the 8-virtual-rank mesh (conftest.py); the real
+np=2 cross-process drill lives in TestGuardCrossProcess at the bottom
+(tests/data/guard_main.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import faults
+from horovod_tpu.guard import (
+    DynamicLossScale,
+    GuardState,
+    TrainingGuard,
+    bucket_flags_local,
+    check_replica_divergence,
+    crossrank_or,
+    local_nonfinite,
+    param_digests,
+    select_on_flag,
+    sliced_nonfinite,
+)
+from horovod_tpu.parallel.data_parallel import allreduce_gradients
+
+N = 8  # virtual ranks (conftest XLA_FLAGS)
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# DynamicLossScale / GuardState schedule
+# ---------------------------------------------------------------------------
+
+def test_loss_scale_backoff_and_growth():
+    s = DynamicLossScale(init_scale=1024.0, growth_interval=2)
+    gs = s.init(3)
+    assert float(gs.loss_scale) == 1024.0
+    assert gs.bucket_flags.shape == (3,)
+
+    gs = s.update(gs, jnp.array([0.0, 1.0, 0.0]))  # overflow
+    assert float(gs.loss_scale) == 512.0
+    assert int(gs.nonfinite_steps) == 1
+    assert int(gs.good_steps) == 0
+
+    gs = s.update(gs, jnp.zeros(3))                # clean
+    assert float(gs.loss_scale) == 512.0
+    assert int(gs.nonfinite_steps) == 0
+    assert int(gs.good_steps) == 1
+
+    gs = s.update(gs, jnp.zeros(3))                # 2nd clean -> grow
+    assert float(gs.loss_scale) == 1024.0
+    assert int(gs.good_steps) == 0
+
+
+def test_consecutive_nonfinite_counter():
+    s = DynamicLossScale(init_scale=4.0, growth_interval=100)
+    gs = s.init(1)
+    for k in range(3):
+        gs = s.update(gs, jnp.ones(1))
+        assert int(gs.nonfinite_steps) == k + 1
+    gs = s.update(gs, jnp.zeros(1))
+    assert int(gs.nonfinite_steps) == 0  # CONSECUTIVE, not cumulative
+
+
+def test_static_scale_never_moves():
+    s = DynamicLossScale(init_scale=1.0, dynamic=False)
+    gs = s.init(1)
+    gs = s.update(gs, jnp.ones(1))
+    assert float(gs.loss_scale) == 1.0
+    assert int(gs.nonfinite_steps) == 1  # skip-step ladder still counts
+
+
+def test_from_env(monkeypatch):
+    monkeypatch.delenv("HOROVOD_GUARD_LOSS_SCALE", raising=False)
+    s = DynamicLossScale.from_env()
+    assert s.init_scale == 1.0 and not s.dynamic
+    monkeypatch.setenv("HOROVOD_GUARD_LOSS_SCALE", "2048")
+    s = DynamicLossScale.from_env()
+    assert s.init_scale == 2048.0 and s.dynamic
+
+
+def test_pending_flag_bridges_passes():
+    """An early-reduction pass flag must gate the NEXT update even when
+    the sync pass itself reduces clean."""
+    s = DynamicLossScale(init_scale=64.0, growth_interval=100)
+    gs = s.accumulate(s.init(1), jnp.ones(1))
+    assert float(gs.pending_flag) == 1.0
+    gs = s.update(gs, jnp.zeros(1))
+    assert float(gs.loss_scale) == 32.0       # pending counted as bad
+    assert float(gs.pending_flag) == 0.0      # consumed
+
+
+def test_select_on_flag():
+    clean = {"a": jnp.ones(2)}
+    old = {"a": jnp.zeros(2)}
+    out = select_on_flag(jnp.asarray(1.0), clean, old)
+    assert (np.asarray(out["a"]) == 0).all()
+    out = select_on_flag(jnp.asarray(0.0), clean, old)
+    assert (np.asarray(out["a"]) == 1).all()
+
+
+# ---------------------------------------------------------------------------
+# Sentinel primitives
+# ---------------------------------------------------------------------------
+
+def test_local_nonfinite_scalar():
+    assert float(local_nonfinite([jnp.ones(3)])) == 0.0
+    assert float(local_nonfinite([jnp.array([1.0, jnp.nan])])) == 1.0
+    assert float(local_nonfinite([jnp.array([jnp.inf])])) == 1.0
+    # Integer leaves carry no non-finite values and must not upcast.
+    assert float(local_nonfinite([jnp.arange(3)])) == 0.0
+    assert float(local_nonfinite([])) == 0.0
+
+
+def test_bucket_flags_local_attribution():
+    leaves = [jnp.ones(4), jnp.array([jnp.nan, 1.0]), jnp.ones(2)]
+    flags = bucket_flags_local(leaves, [[0, 2], [1]])
+    assert np.asarray(flags).tolist() == [0.0, 1.0]
+
+
+def test_sentinel_flags_cross_rank_or(mesh):
+    """A NaN on ONE rank's gradient shard must flag ALL ranks (bitwise
+    0/1 Max-OR inside the compiled reduction)."""
+    data = np.ones((N, 4), np.float32)
+    data[3, 0] = np.nan  # rank 3 only
+
+    def body(x):
+        out, flags = allreduce_gradients({"g": x[0]}, sentinel=True)
+        return out["g"], flags
+
+    sm = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(hvd.GLOBAL_AXIS),),
+        out_specs=(P(), P()), check_vma=False)
+    _, flags = jax.jit(sm)(jnp.asarray(data))
+    assert np.asarray(flags).tolist() == [1.0]
+
+    _, flags = jax.jit(sm)(jnp.ones((N, 4), jnp.float32))
+    assert np.asarray(flags).tolist() == [0.0]
+
+
+def test_sliced_nonfinite_full_coverage(mesh):
+    """The sliced scan (each participant checks its 1/N interleave of a
+    replicated buffer) + cross-rank OR must still catch a non-finite at
+    EVERY position, including the non-divisible tail."""
+    def body(x):
+        f = sliced_nonfinite([x], hvd.GLOBAL_AXIS)
+        return crossrank_or(jnp.stack([f]), axis_name=hvd.GLOBAL_AXIS)
+
+    sm = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(),), out_specs=P(),
+        check_vma=False))
+    clean = jnp.arange(33.0)  # 33 % 8 != 0: exercises the tail
+    assert np.asarray(sm(clean)).tolist() == [0.0]
+    for i in range(33):
+        assert np.asarray(sm(clean.at[i].set(jnp.nan))).tolist() == [1.0], i
+    # Eager fallback (no axis in scope) degrades to the full local scan.
+    assert float(sliced_nonfinite([jnp.array([1.0, jnp.inf])])) == 1.0
+    assert float(sliced_nonfinite([jnp.arange(3)])) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Guarded optimizer: coordinated skip-step inside the compiled step
+# ---------------------------------------------------------------------------
+
+def _compiled_step(opt, mesh, scale_loss=True):
+    def loss_fn(w, x, y, scale):
+        return jnp.mean((x @ w - y) ** 2) * scale
+
+    def step(w, opt_state, x, y):
+        scale = (opt_state.guard.loss_scale if scale_loss
+                 else jnp.float32(1.0))
+        grads = jax.grad(loss_fn)(w, x, y, scale)
+        updates, opt_state = opt.update(grads, opt_state, w)
+        return optax.apply_updates(w, updates), opt_state
+
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P(hvd.GLOBAL_AXIS), P(hvd.GLOBAL_AXIS)),
+        out_specs=(P(), P()), check_vma=False)
+    return jax.jit(sm)
+
+
+def _data(poison_rank=None):
+    rng = np.random.RandomState(0)
+    xs = rng.uniform(size=(N * 2, 4)).astype(np.float32)
+    ys = rng.uniform(size=(N * 2,)).astype(np.float32)
+    if poison_rank is not None:
+        xs = xs.copy()
+        xs[poison_rank * 2, 0] = np.nan
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
+@pytest.mark.parametrize("extra", [
+    {},
+    {"fused_apply": True},
+    {"shard_optimizer_states": True},
+], ids=["plain", "fused", "sharded"])
+def test_skip_step_and_decay(mesh, extra):
+    scaler = DynamicLossScale(init_scale=1024.0, growth_interval=100)
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), guard=scaler, **extra)
+    compiled = _compiled_step(opt, mesh)
+    w = jnp.zeros((4,), jnp.float32)
+    state = opt.init(w)
+    xs, ys = _data()
+
+    w1, state = compiled(w, state, xs, ys)          # clean
+    w1_host = np.asarray(w1)
+    assert float(state.guard.loss_scale) == 1024.0
+    assert (w1_host != 0).any()
+
+    bad_xs, _ = _data(poison_rank=5)
+    w2, state = compiled(w1, state, bad_xs, ys)     # flagged
+    assert (np.asarray(w2) == w1_host).all()        # apply skipped
+    assert float(state.guard.loss_scale) == 512.0
+    assert int(state.guard.nonfinite_steps) == 1
+    assert float(np.asarray(state.guard.bucket_flags).max()) == 1.0
+
+    w3, state = compiled(w2, state, xs, ys)         # recovered
+    assert np.isfinite(np.asarray(w3)).all()
+    assert (np.asarray(w3) != w1_host).any()
+    assert int(state.guard.nonfinite_steps) == 0
+
+
+def test_skipped_step_preserves_inner_state(mesh):
+    """Adam moments must not absorb the poisoned gradients."""
+    scaler = DynamicLossScale(init_scale=256.0, growth_interval=100)
+    opt = hvd.DistributedOptimizer(optax.adam(1e-2), guard=scaler)
+    compiled = _compiled_step(opt, mesh)
+    w = jnp.zeros((4,), jnp.float32)
+    state = opt.init(w)
+    xs, ys = _data()
+    w, state = compiled(w, state, xs, ys)
+    inner_before = jax.tree_util.tree_map(np.asarray, state.inner)
+
+    bad_xs, _ = _data(poison_rank=0)
+    w, state = compiled(w, state, bad_xs, ys)
+    inner_after = jax.tree_util.tree_map(np.asarray, state.inner)
+    for a, b in zip(jax.tree_util.tree_leaves(inner_before),
+                    jax.tree_util.tree_leaves(inner_after)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_guard_static_scale_bitwise_equals_unguarded(mesh):
+    """Acceptance: with the static 1.0 schedule (skip-step only) and no
+    faults, the trajectory must be BITWISE identical to the unguarded
+    pipeline — the sentinel/gate must not perturb a single bit.
+    Dyadic hyperparameters + integral gradients (the TestShardedOptimizer
+    idiom) keep every intermediate exactly representable, so XLA's
+    freedom to contract mul+add to FMA differently in the two program
+    shapes cannot cost a ulp."""
+    rng = np.random.RandomState(0)
+    grads = jnp.asarray(np.round(rng.randn(N, 16) * 4), jnp.float32)
+
+    def run(guard):
+        opt = hvd.DistributedOptimizer(optax.sgd(0.25, momentum=0.5),
+                                       guard=guard)
+
+        def body(g):
+            w = jnp.zeros((16,), jnp.float32)
+            state = opt.init(w)
+            for _ in range(4):
+                u, state = opt.update(g[0], state, w)
+                w = w + u
+            return w, jnp.stack(jax.tree_util.tree_leaves(state.inner))
+
+        sm = jax.shard_map(body, mesh=mesh,
+                           in_specs=(P(hvd.GLOBAL_AXIS),),
+                           out_specs=(P(), P()), check_vma=False)
+        w, inner = jax.jit(sm)(grads)
+        return np.asarray(w), np.asarray(inner)
+
+    w_off, inner_off = run(False)
+    w_on, inner_on = run(DynamicLossScale(init_scale=1.0, dynamic=False))
+    assert w_off.tobytes() == w_on.tobytes()
+    assert inner_off.tobytes() == inner_on.tobytes()
+
+
+def test_early_reduction_pending_flag_skips_megastep(mesh):
+    """A NaN in accumulation pass 1 of 2 must skip the whole fused
+    apply on the sync pass (pending_flag bridge)."""
+    scaler = DynamicLossScale(init_scale=128.0, growth_interval=100)
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.1), guard=scaler, backward_passes_per_step=2,
+        early_reduction=True)
+    compiled = _compiled_step(opt, mesh)
+    w = jnp.zeros((4,), jnp.float32)
+    state = opt.init(w)
+    xs, ys = _data()
+    bad_xs, _ = _data(poison_rank=2)
+
+    w, state = compiled(w, state, bad_xs, ys)   # pass 1 (poisoned)
+    w, state = compiled(w, state, xs, ys)       # pass 2 -> sync apply
+    assert (np.asarray(w) == 0).all()           # megastep skipped
+    assert float(state.guard.loss_scale) == 64.0
+
+    w, state = compiled(w, state, xs, ys)       # clean megastep
+    w, state = compiled(w, state, xs, ys)
+    assert (np.asarray(w) != 0).any()
+    assert float(state.guard.loss_scale) == 64.0
+
+
+def test_early_reduction_body_sentinel_flags():
+    """megastep.early_reduction_body(sentinel=True) returns the
+    per-pass OR of bucket flags alongside the accumulated total."""
+    from horovod_tpu.utils.megastep import early_reduction_body
+
+    def grad_fn(params, batch):
+        return {"w": params["w"] * batch}
+
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    batches = jnp.stack([jnp.float32(1.0), jnp.float32(jnp.nan)])
+    total, flags = early_reduction_body(grad_fn, 2, sentinel=True)(
+        params, batches)
+    assert float(np.asarray(flags).max()) == 1.0
+    clean = jnp.stack([jnp.float32(1.0), jnp.float32(2.0)])
+    total, flags = early_reduction_body(grad_fn, 2, sentinel=True)(
+        params, clean)
+    assert float(np.asarray(flags).max()) == 0.0
+    np.testing.assert_allclose(np.asarray(total["w"]), 1.5)  # averaged
+
+
+# ---------------------------------------------------------------------------
+# Digests
+# ---------------------------------------------------------------------------
+
+def test_param_digests_bit_sensitive():
+    params = {"a": np.ones((4,), np.float32),
+              "b": np.arange(6, dtype=np.float32)}
+    d1 = param_digests(params)
+    d2 = param_digests(params)
+    assert d1.shape[1] == 2 and (d1 == d2).all()
+
+    flipped = {"a": params["a"].copy(), "b": params["b"]}
+    bits = flipped["a"].view(np.uint32)
+    bits[0] ^= np.uint32(1 << 20)
+    d3 = param_digests(flipped)
+    assert (d1 != d3).any()
+
+
+def test_digest_check_single_process_is_noop():
+    d = param_digests({"w": np.ones(3, np.float32)})
+    assert check_replica_divergence(d) is None
+
+
+# ---------------------------------------------------------------------------
+# TrainingGuard: host-side ladder
+# ---------------------------------------------------------------------------
+
+def _gs(scale=512.0, nonfinite=0, flags=(0.0,)):
+    return GuardState(
+        loss_scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.zeros((), jnp.int32),
+        nonfinite_steps=jnp.asarray(nonfinite, jnp.int32),
+        bucket_flags=jnp.asarray(flags, jnp.float32),
+        pending_flag=jnp.zeros((), jnp.float32))
+
+
+def test_observe_reads_verdict_and_escalates():
+    tg = TrainingGuard(scaler=DynamicLossScale(), digest_interval=0,
+                       max_nonfinite=2)
+    v = tg.observe(_gs(), {"w": np.ones(3)}, step=1)
+    assert not v.flagged and not v.rollback and v.loss_scale == 512.0
+
+    v = tg.observe(_gs(nonfinite=1, flags=(1.0,)), {"w": np.ones(3)}, 2)
+    assert v.flagged and v.nonfinite_steps == 1 and not v.rollback
+
+    v = tg.observe(_gs(nonfinite=2, flags=(1.0,)), {"w": np.ones(3)}, 3)
+    assert v.rollback  # K consecutive -> escalate
+
+
+def test_maybe_inject_translates_faults():
+    tg = TrainingGuard(scaler=DynamicLossScale(), digest_interval=0)
+    batch = {"x": jnp.ones((2, 2), jnp.float32)}
+    params = {"w": jnp.ones((3,), jnp.float32)}
+    try:
+        faults.install("guard.nan_grad@1:err")
+        b2, p2 = tg.maybe_inject(batch, params)
+        assert np.isnan(np.asarray(b2["x"])[0, 0])
+        assert (np.asarray(p2["w"]) == 1).all()
+
+        faults.install("guard.param_bitflip@1:err")
+        b3, p3 = tg.maybe_inject(batch, params)
+        assert (np.asarray(b3["x"]) == 1).all()
+        old = np.asarray(params["w"]).view(np.uint32)
+        new = np.asarray(p3["w"]).view(np.uint32)
+        assert np.isfinite(np.asarray(p3["w"])).all()
+        assert (old != new).sum() == 1  # exactly one word differs
+        assert bin(int(old[0] ^ new[0])).count("1") == 1  # by one bit
+    finally:
+        faults.clear()
+    # Disarmed: zero-overhead no-op.
+    b4, p4 = tg.maybe_inject(batch, params)
+    assert b4 is batch and p4 is params
+
+
+def test_rollback_restores_resets_and_bumps_generation(tmp_path):
+    from horovod_tpu.ops import wire
+
+    tg = TrainingGuard(scaler=DynamicLossScale(),
+                       checkpoint_dir=str(tmp_path), digest_interval=0)
+    state = {"w": np.arange(4, dtype=np.float32)}
+    assert tg.checkpoint(3, state)
+    assert tg.last_verified_step == 3
+
+    calls = []
+    hook = lambda: calls.append(1)  # noqa: E731
+    wire.register_error_feedback_reset(hook)
+    try:
+        gen0 = wire.error_feedback_generation()
+        restored = tg.rollback(template=state)
+    finally:
+        wire.unregister_error_feedback_reset(hook)
+    assert (np.asarray(restored["w"]) == state["w"]).all()
+    assert tg.generation == 1
+    assert calls == [1]  # EF residuals invalidated
+    assert wire.error_feedback_generation() == gen0 + 1
+
+
+def test_reset_guard_state_reseeds():
+    scaler = DynamicLossScale(init_scale=1024.0, growth_interval=100)
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), guard=scaler)
+    state = opt.init(jnp.zeros((4,), jnp.float32))
+    dirty = state._replace(guard=_gs(scale=2.0, nonfinite=7))
+    fresh = TrainingGuard.reset_guard_state(dirty, scaler)
+    assert float(fresh.guard.loss_scale) == 1024.0
+    assert int(fresh.guard.nonfinite_steps) == 0
+    assert fresh.guard.bucket_flags.shape == \
+        dirty.guard.bucket_flags.shape
+
+
+# ---------------------------------------------------------------------------
+# Satellites: quarantine cap, consistency timeout, wire reset hooks
+# ---------------------------------------------------------------------------
+
+def test_quarantine_pruned_to_newest_keep(tmp_path, monkeypatch):
+    from horovod_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path))
+    for s in range(1, 6):
+        (tmp_path / f"step_{s}.corrupt").mkdir()
+    monkeypatch.setenv("HOROVOD_CKPT_QUARANTINE_KEEP", "2")
+    mgr._prune_quarantine()
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == ["step_4.corrupt", "step_5.corrupt"]
+
+    # keep=0 empties the quarantine entirely.
+    monkeypatch.setenv("HOROVOD_CKPT_QUARANTINE_KEEP", "0")
+    mgr._prune_quarantine()
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_quarantine_moves_then_prunes(tmp_path, monkeypatch):
+    from horovod_tpu.utils.checkpoint import CheckpointManager
+
+    monkeypatch.setenv("HOROVOD_CKPT_QUARANTINE_KEEP", "1")
+    mgr = CheckpointManager(str(tmp_path))
+    for s in (1, 2):
+        (tmp_path / f"step_{s}").mkdir()
+        mgr._quarantine(s)
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == ["step_2.corrupt"]
+
+
+def test_consistency_timeout_from_env(monkeypatch):
+    from horovod_tpu.utils import consistency
+
+    monkeypatch.delenv("HOROVOD_CONSISTENCY_TIMEOUT", raising=False)
+    assert consistency._timeout_s() == 30.0
+    monkeypatch.setenv("HOROVOD_CONSISTENCY_TIMEOUT", "2.5")
+    assert consistency._timeout_s() == 2.5  # read per check, live
+
+
+def test_wire_reset_hooks_register_unregister():
+    from horovod_tpu.ops import wire
+
+    calls = []
+    hook = lambda: calls.append(1)  # noqa: E731
+    wire.register_error_feedback_reset(hook)
+    g0 = wire.error_feedback_generation()
+    assert wire.reset_error_feedback() == g0 + 1
+    assert calls == [1]
+    wire.unregister_error_feedback_reset(hook)
+    wire.reset_error_feedback()
+    assert calls == [1]  # unregistered hooks stay silent
+
+
+# ---------------------------------------------------------------------------
+# REAL np=2 cross-process drill
+# ---------------------------------------------------------------------------
+
+GUARD_WORKER = os.path.join(REPO_ROOT, "tests", "data", "guard_main.py")
+
+
+@pytest.mark.integration
+class TestGuardCrossProcess:
+    """End-to-end ladder under real gloo collectives: rank-1-only NaN
+    injection -> both ranks skip the SAME step and decay the SAME loss
+    scale; rank-1-only bit-flip -> digest mismatch -> both ranks roll
+    back to the digest-verified checkpoint; bitwise-identical finish."""
+
+    def test_nan_skip_and_bitflip_rollback(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        env["HVD_TEST_OUT"] = str(tmp_path)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             "python", GUARD_WORKER],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO_ROOT)
+        assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+        res = {}
+        for rank in (0, 1):
+            path = tmp_path / f"rank{rank}.json"
+            assert path.exists(), \
+                f"rank {rank} wrote no result:\n{r.stdout}\n{r.stderr}"
+            res[rank] = json.loads(path.read_text())
+
+        # Lockstep: the whole per-step trace is identical across ranks.
+        assert res[0]["trace"] == res[1]["trace"]
+        by_step = {t["step"]: t for t in res[0]["trace"]}
+        # Phase A: only step 3 (rank 1's NaN injection) flags; both
+        # ranks decay 1024 -> 512 together.
+        assert [t["step"] for t in res[0]["trace"] if t["flagged"]] == [3]
+        assert by_step[2]["scale"] == 1024.0
+        assert by_step[3]["scale"] == 512.0
+        assert by_step[3]["nonfinite"] == 1
+        assert by_step[4]["scale"] == 512.0
+        assert by_step[4]["nonfinite"] == 0
+        # Phase B: the step-8 digest check catches rank 1's bit-flip,
+        # attributes it, and both ranks roll back to step 4's snapshot.
+        for rank in (0, 1):
+            assert res[rank]["rollback_at"] == 8, res[rank]
+            assert res[rank]["mismatch_bucket"] == 0
+            assert res[rank]["generation"] == 1
+            assert res[rank]["last_verified_step"] == 4
+            assert res[rank]["final_digest_clean"], res[rank]
+            assert np.isfinite(res[rank]["final_w"]).all()
+        # Bitwise-identical final parameters across ranks.
+        assert res[0]["final_w"] == res[1]["final_w"]

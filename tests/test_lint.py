@@ -7,6 +7,7 @@ in-process against synthetic fixture projects (tmp_path trees) and run
 """
 
 import os
+import re
 import subprocess
 import sys
 import textwrap
@@ -680,3 +681,94 @@ def test_wire_registry_missing_doc_is_error(tmp_path):
     findings = WireRegistry().run(proj)
     assert [f.rule for f in findings] == ["error"]
     assert "docs/WIRE.md" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# training-health guardian (guard/) catalog gates
+# ---------------------------------------------------------------------------
+
+from hvdlint import FaultPoints  # noqa: E402
+from hvdlint.catalogs import (  # noqa: E402
+    _CAT_RE,
+    _FAULT_DOC_ROW_RE,
+    _SITE_RE,
+)
+
+GUARD_METRICS = ("hvd_nonfinite_steps_total", "hvd_loss_scale",
+                 "hvd_guard_rollbacks_total", "hvd_digest_mismatch_total")
+GUARD_KNOBS = ("loss_scale_growth_interval", "guard_digest_interval")
+GUARD_FAULT_POINTS = ("guard.nan_grad", "guard.param_bitflip")
+GUARD_ENV_VARS = ("HOROVOD_GUARD", "HOROVOD_GUARD_LOSS_SCALE",
+                  "HOROVOD_GUARD_GROWTH_INTERVAL",
+                  "HOROVOD_GUARD_DIGEST_INTERVAL",
+                  "HOROVOD_GUARD_MAX_NONFINITE",
+                  "HOROVOD_CONSISTENCY_TIMEOUT",
+                  "HOROVOD_CKPT_QUARANTINE_KEEP")
+
+_ENV_DECL_RE = re.compile(r'_v\(\s*"(HOROVOD_[A-Z0-9_]+)"')
+_ENV_DOC_ROW_RE = re.compile(r"^\|\s*`(HOROVOD_[A-Z0-9_]+)`",
+                             re.MULTILINE)
+
+
+def test_guard_metrics_registered_and_documented():
+    """The four guardian metrics must exist on BOTH sides the
+    metrics-catalog analyzer diffs, so deleting either side is a tier-1
+    failure, not silent drift."""
+    declared = set(_REG_RE.findall(
+        _repo_text("horovod_tpu/metrics/catalog.py")))
+    documented = set(_DOC_ROW_RE.findall(_repo_text("docs/METRICS.md")))
+    for metric in GUARD_METRICS:
+        assert metric in declared, metric
+        assert metric in documented, metric
+
+
+def test_guard_knobs_registered_and_documented():
+    knobs = set(_KNOB_RE.findall(
+        _repo_text("horovod_tpu/utils/autotune.py")))
+    doc = _repo_text("docs/AUTOTUNE.md")
+    for knob in GUARD_KNOBS:
+        assert knob in knobs, knob
+        assert f"`{knob}`" in doc, knob
+
+
+def test_guard_fault_points_declared_fired_documented():
+    declared = set(_CAT_RE.findall(
+        _repo_text("horovod_tpu/faults/__init__.py")))
+    documented = set(_FAULT_DOC_ROW_RE.findall(
+        _repo_text("docs/FAULT_TOLERANCE.md")))
+    fired = set(_SITE_RE.findall(
+        _repo_text("horovod_tpu/guard/controller.py")))
+    for point in GUARD_FAULT_POINTS:
+        assert point in declared, point
+        assert point in documented, point
+        assert point in fired, point
+
+
+def test_guard_env_vars_cataloged_and_documented():
+    declared = set(_ENV_DECL_RE.findall(
+        _repo_text("horovod_tpu/common/env_catalog.py")))
+    documented = set(_ENV_DOC_ROW_RE.findall(
+        _repo_text("docs/ENV_VARS.md")))
+    for var in GUARD_ENV_VARS:
+        assert var in declared, var
+        assert var in documented, var
+
+
+def test_fault_points_catches_guard_doc_drift(tmp_path):
+    """Drop guard.nan_grad's doc row from a copy of the REAL repo
+    files: the fault-points analyzer must flag exactly that point."""
+    doc = "\n".join(
+        line for line in
+        _repo_text("docs/FAULT_TOLERANCE.md").splitlines()
+        if "`guard.nan_grad`" not in line)
+    proj = make_project(tmp_path, {
+        "horovod_tpu/faults/__init__.py":
+            _repo_text("horovod_tpu/faults/__init__.py"),
+        "docs/FAULT_TOLERANCE.md": doc,
+    })
+    findings = FaultPoints().run(proj)
+    # The fixture carries no call sites, so ignore the dead-point noise
+    # and check the doc-drift rule precisely.
+    assert [(f.rule, "guard.nan_grad" in f.message) for f in findings
+            if f.rule == "undocumented-point"] == [
+        ("undocumented-point", True)]
