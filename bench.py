@@ -258,6 +258,7 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
     sharded = os.environ.get("HOROVOD_SHARD_OPTIMIZER") == "1"
     quant = bool(os.environ.get("HOROVOD_WIRE_POLICY"))
     guard = os.environ.get("HOROVOD_GUARD") == "1"
+    fusedc = os.environ.get("HOROVOD_FUSED_COLLECTIVES") == "1"
     if legacy or not distributed:
         pipeline = "legacy"
     elif sharded:
@@ -272,6 +273,14 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
         # DistributedOptimizer; the delta vs "overlap" is the sentinel
         # cost (one scalar per bucket + one tiny Max-allreduce).
         pipeline = "guard"
+    elif fusedc:
+        # Overlap pipeline + chunked fused computation-collective
+        # pipeline (docs/FUSED_COLLECTIVES.md): each bucket's reduction
+        # runs as fused_chunk_bytes chunks whose collectives issue while
+        # the rest of the bucket packs; the delta vs "overlap" is the
+        # intra-bucket wire time the chunking hides (or the chunking
+        # overhead, when negative).
+        pipeline = "fused"
     else:
         pipeline = "overlap"
     if pipeline == "sharded":
@@ -280,7 +289,7 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
         opt = hvd.DistributedOptimizer(base_opt, shard_optimizer_states=True)
         step_fn = build_step(opt, v["config"], distributed=True,
                              reduce_grads_in_step=False)
-    elif pipeline in ("overlap", "quant", "guard"):
+    elif pipeline in ("overlap", "quant", "guard", "fused"):
         opt = hvd.DistributedOptimizer(base_opt, fused_apply=True)
         step_fn = build_step(opt, v["config"], distributed=True,
                              reduce_grads_in_step=False)
@@ -315,6 +324,22 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
         record["wire_bytes_saved"] = sum(
             raw - wb for _, _, raw, wb in plan)
         record["wire_bytes_raw"] = sum(raw for _, _, raw, _ in plan)
+    if pipeline == "fused":
+        # Static per-chunk pipeline schedule over the gradient leaves:
+        # chunk counts and the occupancy model (1 - 1/k per bucket —
+        # the fraction of a bucket's wire time another chunk's stage
+        # covers).  Same bookkeeping the fused_bucket_k timeline
+        # instants carry.
+        fplan = hvd.fused_pipeline_plan(
+            jax.tree_util.tree_leaves(state["params"]))
+        ks = [k for _, _, k, _, _ in fplan]
+        record["fused_buckets"] = len(fplan)
+        record["fused_chunks_total"] = int(sum(ks))
+        record["fused_chunk_bytes"] = int(fplan[0][3]) if fplan else 0
+        record["fused_occupancy_mean"] = round(
+            sum(occ for *_, occ in fplan) / max(1, len(fplan)), 4)
+        record["fused_occupancy_max"] = round(
+            max((occ for *_, occ in fplan), default=0.0), 4)
     print(json.dumps(record))
 
 
@@ -327,7 +352,8 @@ _LAST_SIM_RECORD = None
 
 def _run_sim_record(n: int, distributed: bool, timeout: float,
                     legacy: bool = False, sharded: bool = False,
-                    quant: bool = False, guard: bool = False):
+                    quant: bool = False, guard: bool = False,
+                    fused: bool = False):
     """Run one sim child; return its full JSON record (or None)."""
     global _LAST_SIM_RECORD
     _LAST_SIM_RECORD = None
@@ -336,6 +362,7 @@ def _run_sim_record(n: int, distributed: bool, timeout: float,
     env.pop("HOROVOD_SHARD_OPTIMIZER", None)
     env.pop("HOROVOD_WIRE_POLICY", None)
     env.pop("HOROVOD_GUARD", None)
+    env.pop("HOROVOD_FUSED_COLLECTIVES", None)
     if legacy:
         env["HOROVOD_BENCH_LEGACY_PIPELINE"] = "1"
     if sharded:
@@ -344,6 +371,8 @@ def _run_sim_record(n: int, distributed: bool, timeout: float,
         env["HOROVOD_WIRE_POLICY"] = "auto"
     if guard:
         env["HOROVOD_GUARD"] = "1"
+    if fused:
+        env["HOROVOD_FUSED_COLLECTIVES"] = "1"
     cmd = [sys.executable, os.path.abspath(__file__), "--sim-child", str(n)]
     if not distributed:
         cmd.append("--no-dist")
@@ -365,9 +394,11 @@ def _run_sim_record(n: int, distributed: bool, timeout: float,
 
 def _run_sim(n: int, distributed: bool, timeout: float,
              legacy: bool = False, sharded: bool = False,
-             quant: bool = False, guard: bool = False):
+             quant: bool = False, guard: bool = False,
+             fused: bool = False):
     rec = _run_sim_record(n, distributed, timeout, legacy=legacy,
-                          sharded=sharded, quant=quant, guard=guard)
+                          sharded=sharded, quant=quant, guard=guard,
+                          fused=fused)
     return None if rec is None else rec["step_time_s"]
 
 
@@ -545,6 +576,36 @@ def sim_scaling_efficiency(timeout: float = 600.0,
                 f"({100 * overhead:+.1f}%)")
             extras["t8_guard_ms"] = round(t8_guard * 1e3, 1)
             extras["guard_overhead"] = round(overhead, 4)
+        # Fused computation-collective pipeline: the overlap path with
+        # HOROVOD_FUSED_COLLECTIVES=1 (docs/FUSED_COLLECTIVES.md) —
+        # bucket reductions software-pipelined in fused_chunk_bytes
+        # chunks.  collective_share_fused vs collective_share is the
+        # intra-bucket wire time the chunking hides; the per-chunk
+        # occupancy stats ship from the child's static schedule.
+        _LAST_SIM_RECORD = None
+        t8_fused = _run_sim(8, True, timeout, fused=True)
+        rec_fused = _LAST_SIM_RECORD
+        if t8_fused is not None:
+            fused_share = (t8_fused - t8_nodist) / t8_fused
+            log(f"sim-scaling n=8 fused pipeline: {t8_fused*1e3:.1f} "
+                f"ms/step -> collective share "
+                f"{(t8_fused - t8_nodist)*1e3:.1f} ms/step "
+                f"({100 * fused_share:.1f}%)")
+            extras["t8_fused_ms"] = round(t8_fused * 1e3, 1)
+            extras["collective_share_fused"] = round(fused_share, 4)
+            if rec_fused is not None:
+                for key in ("fused_buckets", "fused_chunks_total",
+                            "fused_chunk_bytes", "fused_occupancy_mean",
+                            "fused_occupancy_max"):
+                    if key in rec_fused:
+                        extras[key] = rec_fused[key]
+                if "fused_occupancy_mean" in rec_fused:
+                    log(f"sim-scaling fused pipeline occupancy: mean "
+                        f"{rec_fused['fused_occupancy_mean']:.3f} max "
+                        f"{rec_fused['fused_occupancy_max']:.3f} over "
+                        f"{rec_fused.get('fused_chunks_total', 0)} "
+                        f"chunks in {rec_fused.get('fused_buckets', 0)} "
+                        f"buckets")
 
     def _trimmed_median(vals):
         s = _np.sort(_np.asarray(vals))

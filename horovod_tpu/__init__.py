@@ -146,6 +146,7 @@ from .parallel.data_parallel import (  # noqa: F401
     distributed_grad,
     DistributedGradientTape,
     error_feedback_init,
+    fused_pipeline_plan,
     gradient_bucket_partition,
     shard_batch,
     wire_policy_plan,

@@ -237,6 +237,14 @@ CATALOG: Tuple[EnvVar, ...] = (
     _v("HOROVOD_WIRE_THRESHOLD", "1048576", "autotune",
        "Byte threshold above which the wire policy routes a bucket to "
        "its big (quantized) codec; autotunable.", "WIRE.md"),
+    _v("HOROVOD_WIRE_BIG_FORMAT", "int8", "autotune",
+       "Codec the wire policy's auto mode assigns to big buckets; "
+       "autotunable as `wire_big_format` (per-bucket-class format "
+       "search).", "WIRE.md"),
+    _v("HOROVOD_FUSED_CHUNK_BYTES", "1048576", "autotune",
+       "Chunk size of the fused computation-collective software "
+       "pipeline; autotunable as `fused_chunk_bytes`.",
+       "FUSED_COLLECTIVES.md"),
 
     # -- training-health guardian ---------------------------------------
     _v("HOROVOD_GUARD", "0", "guard",
@@ -292,6 +300,14 @@ CATALOG: Tuple[EnvVar, ...] = (
        "Seconds the guarded jax.devices() probe waits before declaring "
        "the accelerator unreachable (bench.py uses 120).",
        "COMPONENTS.md"),
+    _v("HOROVOD_FUSED_COLLECTIVES", "0", "ops",
+       "1 routes bucket reductions and the ZeRO-1 scatter/gather pair "
+       "through the chunked fused computation-collective pipeline.",
+       "FUSED_COLLECTIVES.md"),
+    _v("HOROVOD_FUSED_PALLAS", "0", "ops",
+       "1 runs the fused pipeline's matmul chunks through the tiled "
+       "Pallas kernel instead of the XLA dot decomposition.",
+       "FUSED_COLLECTIVES.md"),
     _v("HOROVOD_ADASUM_PALLAS", "0", "ops",
        "1 routes Adasum dot/norm/scaled-add through the fused Pallas "
        "kernels.", "ADASUM.md"),

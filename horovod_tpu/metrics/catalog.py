@@ -97,6 +97,15 @@ param_ag_bytes = _REG.gauge(
     "hvd_param_ag_bytes",
     "Static bytes entering the sharded-optimizer param allgather per "
     "step, at wire width (trace time; multiply by hvd_steps_total).")
+fused_steps = _REG.counter(
+    "hvd_fused_steps",
+    "Compiled steps executed with the fused computation-collective "
+    "pipeline armed (HOROVOD_FUSED_COLLECTIVES=1; see "
+    "docs/FUSED_COLLECTIVES.md).")
+fused_chunk_bytes = _REG.gauge(
+    "hvd_fused_chunk_bytes",
+    "Live chunk size of the fused pipeline's software-pipelined "
+    "collectives (trace time; the fused_chunk_bytes autotuner knob).")
 
 # -- observability / control plane ------------------------------------------
 stall_warnings = _REG.counter(

@@ -252,9 +252,12 @@ class WirePolicy:
     leaf stay exact regardless (counters must sum exactly).
     `threshold_bytes=None` defers to the live autotuner/env value
     (`current_wire_threshold`) at classification time, so the tuned
-    knob takes effect on the next retrace."""
+    knob takes effect on the next retrace; `big=None` defers the FORMAT
+    the same way (`current_wire_big_format`, the `wire_big_format`
+    knob) — the per-bucket-class codec search, not just the size
+    cutoff."""
 
-    big: str = "none"
+    big: Optional[str] = "none"
     small: str = "none"
     threshold_bytes: Optional[int] = None
 
@@ -268,17 +271,16 @@ class WirePolicy:
         from ..utils.autotune import current_wire_threshold
         return current_wire_threshold()
 
+    def _big(self) -> str:
+        if self.big is not None:
+            return self.big
+        from ..utils.autotune import current_wire_big_format
+        return get_codec(current_wire_big_format()).name
+
     def codec_for(self, nbytes: int, all_float: bool) -> str:
         if not all_float:
             return "none"
-        return self.big if nbytes >= self._threshold() else self.small
-
-
-#: What "auto" means: large (fc/embedding-class) buckets ride the int8
-#: ring with blockwise scales — 4x fewer wire bytes with the most
-#: magnitude-robust 1-byte format — while small norm/bias buckets stay
-#: exact.  int4 is opt-in via the explicit grammar (big=int4,...).
-_AUTO_BIG = "int8"
+        return self._big() if nbytes >= self._threshold() else self.small
 
 
 def parse_wire_policy(spec: str) -> WirePolicy:
@@ -286,11 +288,13 @@ def parse_wire_policy(spec: str) -> WirePolicy:
 
     * ``"exact"`` — every bucket exact (bitwise-equal to the unwired
       pipeline);
-    * ``"auto"`` — big buckets ride int8, small stay exact, with the
-      threshold from the autotuner/env (`wire_threshold` knob);
+    * ``"auto"`` — big buckets ride the searched format (the
+      `wire_big_format` knob / HOROVOD_WIRE_BIG_FORMAT, int8 default),
+      small stay exact, with the threshold from the autotuner/env
+      (`wire_threshold` knob);
     * explicit ``key=value`` pairs: ``big=<codec>``, ``small=<codec>``,
       ``threshold=<bytes>`` (e.g. ``big=int4,small=none,
-      threshold=1048576``); omitted keys default to big=int8,
+      threshold=1048576``); omitted keys default to big=autotuned,
       small=none, threshold=autotuned.
 
     Unknown codec names and malformed pairs raise `HorovodTpuError`.
@@ -299,8 +303,11 @@ def parse_wire_policy(spec: str) -> WirePolicy:
     if spec == "exact":
         return WirePolicy()
     if spec == "auto":
-        return WirePolicy(big=_AUTO_BIG, small="none")
-    big, small, threshold = _AUTO_BIG, "none", None
+        # big=None defers the format to the autotuner/env at
+        # classification time (current_wire_big_format), mirroring the
+        # threshold deferral — the tuner searches codec AND cutoff.
+        return WirePolicy(big=None, small="none")
+    big, small, threshold = None, "none", None
     for part in spec.split(","):
         part = part.strip()
         if not part:

@@ -203,6 +203,38 @@ def wire_policy_plan(
     return plan
 
 
+def fused_pipeline_plan(
+    leaves: Sequence[Any],
+    policy: Optional[_wire.WirePolicy] = None,
+    fusion_threshold_bytes: Optional[int] = None,
+    bucket_order=None,
+    chunk_bytes: Optional[int] = None,
+) -> list:
+    """The chunk schedule the fused pipeline would run for `leaves`: one
+    `(indices, wire_name, n_chunks, chunk_bytes, occupancy)` tuple per
+    bucket over the `wire_policy_plan` partition.  `occupancy` is the
+    pipeline-overlap model 1 - 1/n_chunks — the fraction of a bucket's
+    wire time that hides behind another chunk's stage (a 1-chunk bucket
+    overlaps nothing; k chunks expose only the first chunk's latency).
+    Pure bookkeeping — usable from bench/tests without a mesh."""
+    from ..ops import fused_collectives as _fc
+    if chunk_bytes is None:
+        from ..utils.autotune import current_fused_chunk_bytes
+        chunk_bytes = current_fused_chunk_bytes()
+    plan = []
+    for idxs, name, raw, _wb in wire_policy_plan(
+            leaves, policy=policy,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            bucket_order=bucket_order):
+        nelem = sum(leaves[i].size for i in idxs)
+        itemsize = max((leaves[i].dtype.itemsize for i in idxs),
+                       default=4)
+        chunks = _fc.plan_chunks(nelem, itemsize, chunk_bytes=chunk_bytes)
+        k = len(chunks)
+        plan.append((idxs, name, k, chunk_bytes, 1.0 - 1.0 / k))
+    return plan
+
+
 def _sentinel_flags(
     leaves: Sequence[Any],
     results,
@@ -284,9 +316,14 @@ def reduce_gradient_buckets(
     feedback, integer or small buckets exact (see docs/WIRE.md and
     `active_wire_policy`).
     """
+    from ..ops import fused_collectives as _fc
     from ..ops.compression import _CooperativeCompressor
     _cooperative = (isinstance(compression, type) and
                     issubclass(compression, _CooperativeCompressor))
+    # Fused computation-collective pipeline: in-jit only (the chunked
+    # collectives need the mesh axis).  Read at trace time; the program
+    # cache key carries the env so flipping it retraces.
+    fused = _fc.fused_enabled() and axis_name is not None
     # Per-bucket wire policy: in-jit only (the cooperative ring needs
     # the mesh axis in scope; the eager path always reduces exactly).
     policy = (active_wire_policy(compression, process_set)
@@ -361,9 +398,17 @@ def reduce_gradient_buckets(
                 ef_flat = jnp.concatenate(
                     [error_feedback_leaves[float_ord[i]].reshape(-1)
                      for i in idxs])
-                reduced, err = quantized_allreduce_shard(
-                    flat, axis_name, average=(op is C.Average),
-                    wire=wire, error_feedback=ef_flat)
+                if fused:
+                    reduced, err = _fc.pipelined_allreduce_shard(
+                        flat, axis_name, average=(op is C.Average),
+                        wire=wire, error_feedback=ef_flat)
+                else:
+                    reduced, err = quantized_allreduce_shard(
+                        flat, axis_name, average=(op is C.Average),
+                        wire=wire, error_feedback=ef_flat)
+            elif fused:
+                reduced = _fc.pipelined_allreduce_shard(
+                    flat, axis_name, average=(op is C.Average), wire=wire)
             else:
                 reduced = quantized_allreduce_shard(
                     flat, axis_name, average=(op is C.Average), wire=wire)
@@ -425,14 +470,23 @@ def reduce_gradient_buckets(
                 launder_buckets.add(k)
             if codec.exact:
                 wbytes = raw
-                outs = list(C.grouped_allreduce(
-                    [leaves[i] for i in idxs], op=op,
-                    axis_name=axis_name))
+                group = [leaves[i] for i in idxs]
+                # pipelined_grouped_allreduce is bitwise-equal to the
+                # unfused grouped collective (psum is elementwise), so
+                # the fused exact path keeps the exact-wire contract.
+                outs = list(
+                    _fc.pipelined_grouped_allreduce(
+                        group, op=op, axis_name=axis_name) if fused
+                    else C.grouped_allreduce(
+                        group, op=op, axis_name=axis_name))
             elif codec.cast_dtype is not None:
                 wbytes = nelem * jnp.dtype(codec.cast_dtype).itemsize
-                reduced = C.grouped_allreduce(
-                    [leaves[i].astype(codec.cast_dtype) for i in idxs],
-                    op=op, axis_name=axis_name)
+                group = [leaves[i].astype(codec.cast_dtype) for i in idxs]
+                reduced = (
+                    _fc.pipelined_grouped_allreduce(
+                        group, op=op, axis_name=axis_name) if fused
+                    else C.grouped_allreduce(
+                        group, op=op, axis_name=axis_name))
                 outs = [r.astype(leaves[i].dtype)
                         for i, r in zip(idxs, reduced)]
             else:
@@ -444,9 +498,18 @@ def reduce_gradient_buckets(
                     ef_flat = jnp.concatenate(
                         [error_feedback_leaves[float_ord[i]].reshape(-1)
                          for i in idxs])
-                    reduced, err = quantized_allreduce_shard(
+                    if fused:
+                        reduced, err = _fc.pipelined_allreduce_shard(
+                            flat, axis_name, average=(op is C.Average),
+                            wire=codec.name, error_feedback=ef_flat)
+                    else:
+                        reduced, err = quantized_allreduce_shard(
+                            flat, axis_name, average=(op is C.Average),
+                            wire=codec.name, error_feedback=ef_flat)
+                elif fused:
+                    reduced = _fc.pipelined_allreduce_shard(
                         flat, axis_name, average=(op is C.Average),
-                        wire=codec.name, error_feedback=ef_flat)
+                        wire=codec.name)
                 else:
                     reduced = quantized_allreduce_shard(
                         flat, axis_name, average=(op is C.Average),
@@ -472,6 +535,12 @@ def reduce_gradient_buckets(
                            args={"format": codec.name,
                                  "leaves": len(idxs), "raw_bytes": raw,
                                  "wire_bytes": wbytes})
+                if fused:
+                    cb = _fc.plan_chunks(nelem, 4)
+                    tl.instant(f"fused_bucket_{k}", category="fused",
+                               args={"format": codec.name,
+                                     "chunks": len(cb),
+                                     "chunk_bytes": 4 * cb[0][1]})
             results.append((idxs, outs))
         if _met.enabled():
             if traced:
@@ -481,6 +550,9 @@ def reduce_gradient_buckets(
                 _met.wire_bytes_saved_per_step.set(raw_total - wire_total)
                 for fmt, b in fmt_bytes.items():
                     _met.wire_format_bytes.labels(fmt).set(b)
+                if fused:
+                    from ..utils.autotune import current_fused_chunk_bytes
+                    _met.fused_chunk_bytes.set(current_fused_chunk_bytes())
             else:
                 _met.wire_bytes_saved.inc(raw_total - wire_total)
         if sentinel:
@@ -498,9 +570,15 @@ def reduce_gradient_buckets(
     results = []
     for idxs in parts:
         group = [compressed[i] for i in idxs]
-        reduced = C.grouped_allreduce(
-            group, op=op, axis_name=axis_name, process_set=process_set
-        )
+        if fused and process_set is None and op in (C.Average, C.Sum):
+            # process-set subsets keep the unfused grouped collective —
+            # the chunked path has no subset plumbing.
+            reduced = _fc.pipelined_grouped_allreduce(
+                group, op=op, axis_name=axis_name)
+        else:
+            reduced = C.grouped_allreduce(
+                group, op=op, axis_name=axis_name,
+                process_set=process_set)
         results.append(
             (idxs, [compression.decompress(r, ctxs[i])
                     for i, r in zip(idxs, reduced)]))
@@ -733,13 +811,21 @@ def data_parallel(
         # a spec change (tests/operators flipping HOROVOD_WIRE_POLICY
         # between steps) must retrace just like a knob proposal.
         wire_spec = util.getenv("WIRE_POLICY")
+        # Trace-time envs the bucketing bakes in: the auto policy's big
+        # format and the fused pipeline's on/off + chunk size all change
+        # the traced program, so a flip between steps must retrace (the
+        # knob-tuned values ride pm.values() below; these cover the
+        # env-only case with no tuner attached).
+        env_part = (wire_spec, util.getenv("WIRE_BIG_FORMAT"),
+                    util.getenv("FUSED_COLLECTIVES"),
+                    util.getenv("FUSED_CHUNK_BYTES"))
         pm = _at.get_manager()
         if pm is None:
-            return (wire_spec,) if wire_spec else None
+            return env_part if any(env_part) else None
         # ALL live knob values (fusion threshold, bucket order, min
         # buckets, ...): any proposal the tuner applies must force a
         # retrace, or the step keeps running the old bucketing.
-        return (wire_spec, tuple(pm.values().items()))
+        return (env_part, tuple(pm.values().items()))
 
     def _autotune_record(args):
         from ..utils import autotune as _at
@@ -815,6 +901,9 @@ def data_parallel(
             tl.mark_cycle()
         if _met.enabled():
             _met.steps.inc()
+            from ..ops.fused_collectives import fused_enabled
+            if fused_enabled():
+                _met.fused_steps.inc()
         return out
 
     return call

@@ -357,6 +357,7 @@ def DistributedGradientTransformation(
                                    guard_state)
 
     def _sharded_update(grads, state, params, pre_reduced):
+        from ..ops import fused_collectives as _fc
         from ..utils.autotune import current_ag_fusion
 
         leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -400,6 +401,10 @@ def DistributedGradientTransformation(
         ag_codec = _wire.get_codec(allgather_wire)
         ag_wt = ag_codec.cast_dtype
         fuse_ag = bool(current_ag_fusion())
+        # Chunked fused pipeline (HOROVOD_FUSED_COLLECTIVES=1): single-
+        # axis paths only — the hierarchical collectives carry their own
+        # DCN/ICI split and stay whole-buffer.
+        fused = _fc.fused_enabled() and not hier
         out = [None] * len(leaves)
         new_inner = [None] * len(groups)
         rs_bytes = 0
@@ -487,7 +492,12 @@ def DistributedGradientTransformation(
                 c, ctx = compression.compress(flat)
                 if padn:
                     c = jnp.concatenate([c, jnp.zeros((padn,), c.dtype)])
-                g_shard = lax.psum_scatter(c, ax, tiled=True)
+                # pipelined_psum_scatter is bitwise-equal to the tiled
+                # scatter (chunks keep rank ownership; the sum is
+                # elementwise), so the fused route preserves the
+                # replicated-path parity contract below.
+                g_shard = (_fc.pipelined_psum_scatter(c, ax) if fused
+                           else lax.psum_scatter(c, ax, tiled=True))
                 if op is C.Average:
                     # Divide in the wire dtype: elementwise identical to
                     # the replicated path's lax.pmean on the same wire.
@@ -550,6 +560,13 @@ def DistributedGradientTransformation(
             elif hier:
                 _finish(_hier.hierarchical_all_gather(
                     send, dcn_ax, ici_ax))
+            elif fused:
+                # Chunked prefetch-order gather; block-aligned chunks
+                # keep cooperative encodes bitwise-equal to the
+                # whole-buffer gather.
+                _finish(_fc.pipelined_allgather_shard(
+                    send, ax,
+                    wire=ag_codec.name if ag_codec.cooperative else None))
             elif ag_codec.cooperative:
                 _finish(quantized_allgather_shard(
                     send, ax, wire=ag_codec.name))
@@ -563,7 +580,13 @@ def DistributedGradientTransformation(
             for _, items in by_dt.items():
                 cat = (jnp.concatenate([s for s, _ in items])
                        if len(items) > 1 else items[0][0])
-                if ag_codec.cooperative:
+                if fused:
+                    stacked = _fc.pipelined_allgather_shard(
+                        cat, ax,
+                        wire=(ag_codec.name if ag_codec.cooperative
+                              else None),
+                        stacked=True)
+                elif ag_codec.cooperative:
                     # Non-hier guaranteed (validated at construction):
                     # one block-scaled payload gather for the whole
                     # fused buffer, reshaped to the (n, W) band layout.

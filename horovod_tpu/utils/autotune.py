@@ -347,6 +347,22 @@ def init_from_env() -> Optional[ParameterManager]:
     pm.register("wire_threshold", 64 << 10, 64 << 20, log_scale=True,
                 integer=True,
                 initial=util.env_int("WIRE_THRESHOLD", 1 << 20))
+    # Wire-policy FORMAT knob (index into _WIRE_BIG_FORMATS): which
+    # codec the policy's "auto" mode assigns to the big bucket class.
+    # Searching the format alongside the size threshold lets the tuner
+    # trade wire bytes against quantization error per bucket class; the
+    # winner enters the program-cache key through pm.values() like every
+    # other knob (see data_parallel._autotune_key).
+    pm.register("wire_big_format", 0, len(_WIRE_BIG_FORMATS) - 1,
+                integer=True,
+                initial=_WIRE_BIG_FORMATS.index(_env_wire_big_format()))
+    # Fused computation-collective pipeline chunk size: how finely the
+    # fused paths (ops/fused_collectives.py) slice a bucket so codec
+    # work and compute hide behind in-flight ring hops.  Smaller chunks
+    # pipeline deeper but pay more per-collective overhead.
+    pm.register("fused_chunk_bytes", 64 << 10, 16 << 20, log_scale=True,
+                integer=True,
+                initial=util.env_int("FUSED_CHUNK_BYTES", 1 << 20))
     # Training-guard knobs (docs/GUARD.md): how many clean applies
     # before the dynamic loss scale grows back, and how often the
     # cross-replica parameter-digest collective runs.  Both trade
@@ -457,6 +473,57 @@ def current_wire_threshold() -> int:
     overridden by the autotuner when active.  Only consulted when the
     HOROVOD_WIRE_POLICY spec omits an explicit threshold=."""
     return tuned_wire_threshold(util.env_int("WIRE_THRESHOLD", 1 << 20))
+
+
+# Big-bucket codec candidates the wire-format search can pick between
+# (index into this tuple is the knob's integer value): the cooperative
+# block-scaled formats plus the cast wires — everything that compresses;
+# "none" stays reachable through HOROVOD_WIRE_POLICY=exact instead.
+_WIRE_BIG_FORMATS = ("int8", "int4", "fp8_e4m3", "fp8_e5m2", "bf16",
+                     "fp16")
+
+
+def _env_wire_big_format() -> str:
+    fmt = util.getenv("WIRE_BIG_FORMAT") or "int8"
+    if fmt not in _WIRE_BIG_FORMATS:
+        raise ValueError(
+            f"HOROVOD_WIRE_BIG_FORMAT must be one of "
+            f"{_WIRE_BIG_FORMATS}, got {fmt!r}")
+    return fmt
+
+
+def tuned_wire_big_format(default: str) -> str:
+    """Big-bucket wire codec honoring the autotuner when active (used
+    by WirePolicy.codec_for when the spec's big= is deferred)."""
+    if _manager is not None and "wire_big_format" in _manager._tunables:
+        return _WIRE_BIG_FORMATS[int(_manager.value("wire_big_format"))]
+    return default
+
+
+def current_wire_big_format() -> str:
+    """The live big-bucket codec for HOROVOD_WIRE_POLICY=auto:
+    HOROVOD_WIRE_BIG_FORMAT (int8 default — the most magnitude-robust
+    1-byte format), overridden by the autotuner when active.  Consulted
+    at classification (trace) time, so a tuner move takes effect on the
+    next retrace."""
+    return tuned_wire_big_format(_env_wire_big_format())
+
+
+def tuned_fused_chunk_bytes(default: int) -> int:
+    """Fused-pipeline chunk size honoring the autotuner when active
+    (used by ops/fused_collectives.py chunk planning)."""
+    if _manager is not None and "fused_chunk_bytes" in _manager._tunables:
+        return int(_manager.value("fused_chunk_bytes"))
+    return default
+
+
+def current_fused_chunk_bytes() -> int:
+    """The live fused-pipeline chunk size: HOROVOD_FUSED_CHUNK_BYTES
+    (1 MB default), overridden by the autotuner when active.  Only
+    consulted when HOROVOD_FUSED_COLLECTIVES=1 routes a reduction
+    through the chunked pipeline."""
+    return tuned_fused_chunk_bytes(
+        util.env_int("FUSED_CHUNK_BYTES", 1 << 20))
 
 
 def tuned_guard_growth_interval(default: int) -> int:

@@ -133,6 +133,15 @@ def early_reduction_body(grad_fn: Callable[[Any, Any], Any], k: int,
     reduce-scatter by the same linearity, preserving the bitwise
     contract above (see docs/SHARDED_OPTIMIZER.md).
 
+    With HOROVOD_FUSED_COLLECTIVES=1 the default `reduce_fn` rides the
+    chunked fused computation-collective pipeline
+    (docs/FUSED_COLLECTIVES.md): each microbatch's exact reduction runs
+    as `fused_chunk_bytes` chunks whose first collective issues while
+    the rest of the bucket packs — and since the chunked exact path is
+    bitwise-equal to the unfused grouped collective, the early-reduction
+    linearity contract above is unchanged (tested fused x megastep x
+    sharded in tests/test_optimizer.py).
+
     `sentinel=True` runs each microbatch's reduction with the fused
     non-finite sentinel (docs/GUARD.md) and returns
     `(reduced_grads, flags)` where `flags` is the elementwise max of

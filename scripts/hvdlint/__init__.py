@@ -11,6 +11,7 @@ from .catalogs import FaultPoints, MetricsCatalog
 from .envvars import EnvVarRegistry
 from .excepts import ExceptionDiscipline
 from .locks import LockDiscipline
+from .pallas import PallasGuard
 from .purity import JitPurity
 from .wires import WireRegistry
 
@@ -24,9 +25,10 @@ ALL = [
     MetricsCatalog(),
     FaultPoints(),
     WireRegistry(),
+    PallasGuard(),
 ]
 
 __all__ = ["Analyzer", "Finding", "Project", "run_all", "ALL",
            "LockDiscipline", "JitPurity", "EnvVarRegistry",
            "ExceptionDiscipline", "MetricsCatalog", "FaultPoints",
-           "WireRegistry"]
+           "WireRegistry", "PallasGuard"]

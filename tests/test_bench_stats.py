@@ -15,8 +15,8 @@ import bench  # noqa: E402
 
 def _feed(monkeypatch, times):
     """times: list of (t1, t8) per pair; the compute-only, legacy,
-    sharded, quantized and guard pipeline probes of the extras block
-    are fed the last pair's t8."""
+    sharded, quantized, guard and fused pipeline probes of the extras
+    block are fed the last pair's t8."""
     seq = []
     for t1, t8 in times:
         seq += [t1, t8]
@@ -25,11 +25,12 @@ def _feed(monkeypatch, times):
     seq.append(times[-1][1])     # the sharded-pipeline probe
     seq.append(times[-1][1])     # the quantized-wire probe
     seq.append(times[-1][1])     # the guard-pipeline probe
+    seq.append(times[-1][1])     # the fused-pipeline probe
     it = iter(seq)
     monkeypatch.setattr(
         bench, "_run_sim",
         lambda n, dist, timeout, legacy=False, sharded=False,
-        quant=False, guard=False: next(it))
+        quant=False, guard=False, fused=False: next(it))
 
 
 class TestSimScalingStats:
@@ -52,6 +53,11 @@ class TestSimScalingStats:
         # Guard probe fed the median t8 -> zero sentinel overhead.
         assert extras["t8_guard_ms"] == pytest.approx(8800.0)
         assert extras["guard_overhead"] == pytest.approx(0.0)
+        # Fused probe fed the compute-only t8 -> zero collective share.
+        assert extras["t8_fused_ms"] == pytest.approx(8800.0)
+        assert extras["collective_share_fused"] == pytest.approx(0.0)
+        # Stubbed probe leaves no child record -> no occupancy stats.
+        assert "fused_occupancy_mean" not in extras
         # Stubbed probes leave no child record, so the byte comparison
         # is (correctly) absent rather than fabricated.
         assert "opt_state_bytes_sharded" not in extras
@@ -88,12 +94,12 @@ class TestSimScalingStats:
     def test_failed_pair_retried(self, monkeypatch):
         monkeypatch.setenv("HOROVOD_BENCH_SIM_MAX_RUNS", "3")
         seq = [1.0, None, 1.0, 8.9, 1.0, 8.8, 1.0, 8.7,
-               8.5, 8.6, 8.6, 8.6, 8.6]
+               8.5, 8.6, 8.6, 8.6, 8.6, 8.6]
         it = iter(seq)
         monkeypatch.setattr(
             bench, "_run_sim",
             lambda n, dist, timeout, legacy=False, sharded=False,
-            quant=False, guard=False: next(it))
+            quant=False, guard=False, fused=False: next(it))
         median, spread, effs, ci, rejected, extras = \
             bench.sim_scaling_efficiency(runs=3)
         assert len(effs) == 3   # the failed attempt was retried
@@ -120,5 +126,5 @@ class TestSimScalingStats:
         monkeypatch.setattr(
             bench, "_run_sim",
             lambda n, dist, timeout, legacy=False, sharded=False,
-            quant=False, guard=False: next(it))
+            quant=False, guard=False, fused=False: next(it))
         assert bench.sim_scaling_efficiency(runs=3) is None

@@ -887,3 +887,251 @@ def test_bucket_order_agrees_under_wire_policy(mesh):
     for a, b, e in zip(fwd, rev, exact):
         assert np.abs(a - e).max() < N * scale / 50
         assert np.abs(b - e).max() < N * scale / 50
+
+
+# ---------------------------------------------------------------------------
+# Fused computation-collective pipeline (docs/FUSED_COLLECTIVES.md)
+# ---------------------------------------------------------------------------
+
+def _fused_env(monkeypatch, chunk_bytes=256):
+    """Arm the fused pipeline with a tiny chunk size so every test
+    buffer actually splits into several chunks."""
+    monkeypatch.setenv("HOROVOD_FUSED_COLLECTIVES", "1")
+    monkeypatch.setenv("HOROVOD_FUSED_CHUNK_BYTES", str(chunk_bytes))
+
+
+def test_plan_chunks_alignment_and_coverage():
+    from horovod_tpu.ops.fused_collectives import plan_chunks
+    for n, cb in ((100000, 65536), (128, 65536), (5000, 1024),
+                  (1, 1024)):
+        ch = plan_chunks(n, 4, chunk_bytes=cb)
+        assert all(off % 128 == 0 for off, _ in ch)
+        assert sum(w for _, w in ch) == n
+        offs = [off for off, _ in ch]
+        assert offs == sorted(offs)
+
+
+def test_pipelined_grouped_allreduce_bitwise(mesh):
+    """The chunked exact grouped allreduce must be BITWISE-equal to the
+    unfused one — psum is elementwise, so chunk boundaries cannot move
+    any element's reduction."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.ops import collectives as C
+    from horovod_tpu.ops.fused_collectives import \
+        pipelined_grouped_allreduce
+
+    rng = np.random.RandomState(31)
+    a = jnp.asarray(rng.randn(N, 300).astype(np.float32))
+    b = jnp.asarray(rng.randn(N, 7, 5).astype(np.float32))
+    c = jnp.asarray(rng.randint(0, 9, (N, 11)).astype(np.int32))
+
+    def run(fn):
+        def f(x, y, z):
+            return tuple(fn([x[0], y[0], z[0]]))
+        sm = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(hvd.GLOBAL_AXIS),) * 3,
+            out_specs=(P(),) * 3, check_vma=False))
+        return [np.asarray(o) for o in sm(a, b, c)]
+
+    ref = run(lambda ts: C.grouped_allreduce(
+        ts, op=C.Average, axis_name=hvd.GLOBAL_AXIS))
+    got = run(lambda ts: pipelined_grouped_allreduce(
+        ts, op=C.Average, axis_name=hvd.GLOBAL_AXIS, chunk_bytes=256))
+    for r, g in zip(ref, got):
+        np.testing.assert_array_equal(r, g)
+
+
+def test_pipelined_allgather_shard_bitwise_on_wires(mesh):
+    """Block-aligned chunking keeps every codec's scale-block boundaries
+    where the whole-buffer encode puts them: the chunked gather is
+    bitwise-equal for exact AND cooperative wires, including a
+    non-block-multiple tail."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from jax import lax
+    from horovod_tpu.ops.fused_collectives import pipelined_allgather_shard
+    from horovod_tpu.ops.quantized import quantized_allgather_shard
+
+    rng = np.random.RandomState(32)
+    shard = jnp.asarray(rng.randn(N, 300).astype(np.float32))
+
+    def run(fn):
+        sm = jax.jit(shard_map(
+            lambda x: fn(x[0]), mesh=mesh,
+            in_specs=(P(hvd.GLOBAL_AXIS),), out_specs=P(),
+            check_vma=False))
+        return np.asarray(sm(shard))
+
+    ax = hvd.GLOBAL_AXIS
+    exact_ref = run(lambda s: lax.all_gather(s, ax, tiled=True))
+    exact_got = run(lambda s: pipelined_allgather_shard(
+        s, ax, chunk_bytes=512))
+    np.testing.assert_array_equal(exact_ref, exact_got)
+    for wire in ("int8", "int4"):
+        ref = run(lambda s, w=wire: quantized_allgather_shard(
+            s, ax, wire=w))
+        got = run(lambda s, w=wire: pipelined_allgather_shard(
+            s, ax, wire=w, chunk_bytes=512))
+        np.testing.assert_array_equal(ref, got)
+
+
+def test_pipelined_psum_scatter_bitwise(mesh):
+    from jax import shard_map, lax
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.ops.fused_collectives import pipelined_psum_scatter
+
+    rng = np.random.RandomState(33)
+    flat = jnp.asarray(rng.randn(N, N * 137).astype(np.float32))
+
+    def run(fn):
+        sm = jax.jit(shard_map(
+            lambda x: fn(x[0]), mesh=mesh,
+            in_specs=(P(hvd.GLOBAL_AXIS),),
+            out_specs=P(hvd.GLOBAL_AXIS), check_vma=False))
+        return np.asarray(sm(flat))
+
+    ax = hvd.GLOBAL_AXIS
+    ref = run(lambda x: lax.psum_scatter(x, ax, tiled=True)[None])
+    got = run(lambda x: pipelined_psum_scatter(
+        x, ax, chunk_bytes=256)[None])
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_pipelined_allreduce_shard_tolerance_and_ef(mesh):
+    """The chunked quantized ring re-partitions the per-rank ring
+    sub-chunks, so it agrees with the whole-buffer ring to wire
+    tolerance (same contract as bucket-order permutation); the EF
+    residual keeps the telescoping shape contract."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.ops.fused_collectives import pipelined_allreduce_shard
+
+    rng = np.random.RandomState(34)
+    flat = jnp.asarray(rng.randn(N, 2048).astype(np.float32))
+    ef = jnp.zeros((N, 2048), jnp.float32)
+    exact = np.mean(np.asarray(flat), axis=0)
+
+    sm = jax.jit(shard_map(
+        lambda x, e: pipelined_allreduce_shard(
+            x[0], hvd.GLOBAL_AXIS, average=True, wire="int8",
+            error_feedback=e[0], chunk_bytes=1024),
+        mesh=hvd.global_mesh(),
+        in_specs=(P(hvd.GLOBAL_AXIS),) * 2, out_specs=(P(), P()),
+        check_vma=False))
+    red, resid = sm(flat, ef)
+    scale = np.abs(exact).max()
+    assert np.abs(np.asarray(red) - exact).max() < N * scale / 50
+    assert resid.shape == (2048,)
+    # the residual is exactly input-minus-wire per chunk: nonzero
+    assert float(np.abs(np.asarray(resid)).max()) > 0
+
+
+def test_fused_matmul_reduce_scatter_matches_unfused(mesh):
+    from jax import shard_map, lax
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.ops.fused_collectives import \
+        fused_matmul_reduce_scatter
+
+    rng = np.random.RandomState(35)
+    a = jnp.asarray(rng.randn(N, 16, 24).astype(np.float32))
+    b = jnp.asarray(rng.randn(N, 24, 33).astype(np.float32))
+
+    def run(fn):
+        sm = jax.jit(shard_map(
+            lambda x, y: fn(x[0], y[0]), mesh=mesh,
+            in_specs=(P(hvd.GLOBAL_AXIS),) * 2, out_specs=P(),
+            check_vma=False))
+        return np.asarray(sm(a, b))
+
+    ax = hvd.GLOBAL_AXIS
+    ref = run(lambda x, y: lax.psum_scatter(
+        x @ y, ax, scatter_dimension=0, tiled=True))
+    got = run(lambda x, y: fused_matmul_reduce_scatter(
+        x, y, ax, chunk_bytes=256))
+    assert got.shape == ref.shape == (16 // N, 33)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_fused_allgather_matmul_matches_unfused(mesh):
+    from jax import shard_map, lax
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu.ops.fused_collectives import fused_allgather_matmul
+
+    rng = np.random.RandomState(36)
+    x = jnp.asarray(rng.randn(N, 6, 20).astype(np.float32))
+    w = jnp.asarray(rng.randn(N, 9, 20).astype(np.float32))
+
+    def run(fn):
+        sm = jax.jit(shard_map(
+            lambda xx, ww: fn(xx[0], ww[0]), mesh=mesh,
+            in_specs=(P(hvd.GLOBAL_AXIS),) * 2, out_specs=P(),
+            check_vma=False))
+        return np.asarray(sm(x, w))
+
+    ax = hvd.GLOBAL_AXIS
+    ref = run(lambda xx, ww: xx @ lax.all_gather(ww, ax, tiled=True).T)
+    got = run(lambda xx, ww: fused_allgather_matmul(
+        xx, ww, ax, chunk_bytes=256))
+    assert got.shape == ref.shape == (6, N * 9)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_fused_routing_bitwise_exact_wire(mesh, monkeypatch):
+    """HOROVOD_FUSED_COLLECTIVES=1 on the exact wire must not move a
+    single bit of allreduce_gradients — across forward AND reverse
+    bucket orders, and composed with the guard sentinel."""
+    leaves = _order_test_leaves()
+    base = {}
+    for order in ("forward", "reverse"):
+        base[order] = _bucketed_reduce(mesh, leaves, order)
+    _fused_env(monkeypatch)
+    for order in ("forward", "reverse"):
+        got = _bucketed_reduce(mesh, leaves, order)
+        for a, b in zip(base[order], got):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_fused_routing_sentinel_composes(mesh, monkeypatch):
+    """sentinel=True under the fused pipeline: same reduced values
+    bitwise, and the per-bucket flag vector keeps its shape/zeros on
+    finite inputs."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    leaves = _order_test_leaves()
+
+    def run():
+        def f(*xs):
+            outs, flags = hvd.allreduce_gradients(
+                [x[0] for x in xs], axis_name=hvd.GLOBAL_AXIS,
+                fusion_threshold_bytes=512, sentinel=True)
+            return tuple(outs) + (flags,)
+        sm = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(hvd.GLOBAL_AXIS),) * len(leaves),
+            out_specs=tuple(P() for _ in range(len(leaves) + 1)),
+            check_vma=False))
+        outs = sm(*leaves)
+        return [np.asarray(o) for o in outs[:-1]], np.asarray(outs[-1])
+
+    ref, rflags = run()
+    _fused_env(monkeypatch)
+    got, gflags = run()
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(rflags, gflags)
+    assert float(gflags.max()) == 0.0
+
+
+def test_fused_routing_quantized_wire_tolerance(mesh, monkeypatch):
+    """Cooperative wires under the fused pipeline: chunking moves the
+    ring's internal sub-chunk boundaries, so parity is to wire
+    tolerance (the documented contract), not bitwise."""
+    leaves = _order_test_leaves()
+    exact = [np.mean(np.asarray(l), axis=0) for l in leaves]
+    _fused_env(monkeypatch)
+    got = _bucketed_reduce(mesh, leaves, "reverse",
+                           compression=hvd.Compression.int8)
+    scale = max(np.abs(e).max() for e in exact)
+    for g, e in zip(got, exact):
+        assert np.abs(g - e).max() < N * scale / 50

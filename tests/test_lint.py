@@ -772,3 +772,72 @@ def test_fault_points_catches_guard_doc_drift(tmp_path):
     assert [(f.rule, "guard.nan_grad" in f.message) for f in findings
             if f.rule == "undocumented-point"] == [
         ("undocumented-point", True)]
+
+
+# ---------------------------------------------------------------------------
+# pallas-guard
+# ---------------------------------------------------------------------------
+
+def test_pallas_call_without_interpret_flagged(tmp_path):
+    from hvdlint import PallasGuard
+    proj = make_project(tmp_path, {"horovod_tpu/k.py": """\
+        import jax
+        from jax.experimental import pallas as pl  # noqa
+
+        def kern(x):
+            return pl.pallas_call(lambda r, o: None,
+                                  out_shape=x)(x)
+        """})
+    got = rules(PallasGuard().run(proj))
+    assert ("pallas-guard", "missing-interpret") in got
+    # the bare module-level pallas import is also unconditional
+    assert ("pallas-guard", "unguarded-import") in got
+
+
+def test_pallas_static_interpret_flagged(tmp_path):
+    from hvdlint import PallasGuard
+    proj = make_project(tmp_path, {"horovod_tpu/k.py": """\
+        try:
+            from jax.experimental import pallas as pl
+        except ImportError:
+            pl = None
+
+        def kern(x):
+            return pl.pallas_call(lambda r, o: None, out_shape=x,
+                                  interpret=True)(x)
+        """})
+    got = rules(PallasGuard().run(proj))
+    assert got == [("pallas-guard", "static-interpret")]
+
+
+def test_pallas_runtime_guard_clean(tmp_path):
+    from hvdlint import PallasGuard
+    proj = make_project(tmp_path, {"horovod_tpu/k.py": """\
+        PALLAS_AVAILABLE = True
+        if PALLAS_AVAILABLE:
+            from jax.experimental import pallas as pl
+
+        def _interpret():
+            return False
+
+        def kern(x):
+            return pl.pallas_call(lambda r, o: None, out_shape=x,
+                                  interpret=_interpret())(x)
+        """})
+    assert PallasGuard().run(proj) == []
+
+
+def test_pallas_guard_pragma_suppresses(tmp_path):
+    from hvdlint import PallasGuard
+    proj = make_project(tmp_path, {"horovod_tpu/k.py": """\
+        try:
+            from jax.experimental import pallas as pl
+        except ImportError:
+            pl = None
+
+        def kern(x):
+            # lint: allow-static-interpret(debug-only helper)
+            return pl.pallas_call(lambda r, o: None, out_shape=x,
+                                  interpret=True)(x)
+        """})
+    assert PallasGuard().run(proj) == []

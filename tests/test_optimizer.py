@@ -649,3 +649,139 @@ class TestShardedOptimizer:
         # Sharded: 1/N per group plus at most one pad row per group.
         assert sb <= total // N + 4 * len(shard.init(params).inner) * 2
         assert sb < rb / 4
+
+
+# ---------------------------------------------------------------------------
+# Fused computation-collective pipeline composed with the optimizer
+# paths (docs/FUSED_COLLECTIVES.md)
+# ---------------------------------------------------------------------------
+
+
+class TestFusedCollectivesCompose:
+    SHAPES = [(5, 3), (7,), (2, 2, 2), (11,)]
+
+    def _arm(self, monkeypatch, chunk_bytes=256):
+        monkeypatch.setenv("HOROVOD_FUSED_COLLECTIVES", "1")
+        monkeypatch.setenv("HOROVOD_FUSED_CHUNK_BYTES", str(chunk_bytes))
+
+    def test_sharded_trajectory_bitwise(self, monkeypatch):
+        """shard_optimizer_states with the fused pipeline armed: the
+        chunked psum_scatter/allgather pair is bitwise-equal to the
+        whole-buffer pair, so the multi-step trajectory must not move
+        a bit."""
+        stacked = _stacked_grads(21, self.SHAPES, integral=True)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+
+        def make():
+            return hvd.DistributedOptimizer(
+                _dyadic_sgd(), shard_optimizer_states=True,
+                fusion_threshold_bytes=64, axis_name=hvd.GLOBAL_AXIS)
+
+        base = _per_rank_updates(make(), params, stacked)
+        self._arm(monkeypatch)
+        fused = _per_rank_updates(make(), params, stacked)
+        for a, b in zip(base, fused):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_sharded_ag_fusion_bitwise(self, monkeypatch):
+        """fused pipeline x HOROVOD_SHARD_AG_FUSION: the stacked
+        chunked gather must reproduce the fused-allgather band layout
+        bitwise."""
+        stacked = _stacked_grads(22, self.SHAPES, integral=True)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+
+        def make():
+            return hvd.DistributedOptimizer(
+                _dyadic_sgd(), shard_optimizer_states=True,
+                fusion_threshold_bytes=64, axis_name=hvd.GLOBAL_AXIS)
+
+        monkeypatch.setenv("HOROVOD_SHARD_AG_FUSION", "1")
+        base = _per_rank_updates(make(), params, stacked)
+        self._arm(monkeypatch)
+        fused = _per_rank_updates(make(), params, stacked)
+        for a, b in zip(base, fused):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_sharded_cooperative_ag_wire_bitwise(self, monkeypatch):
+        """fused pipeline x cooperative allgather_wire: block-aligned
+        chunks keep the int8 payload gather's scale blocks in place, so
+        even the QUANTIZED param gather is bitwise under chunking."""
+        stacked = _stacked_grads(23, self.SHAPES, integral=True)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+
+        def make():
+            return hvd.DistributedOptimizer(
+                _dyadic_sgd(), shard_optimizer_states=True,
+                allgather_wire="int8", fusion_threshold_bytes=64,
+                axis_name=hvd.GLOBAL_AXIS)
+
+        base = _per_rank_updates(make(), params, stacked)
+        self._arm(monkeypatch)
+        fused = _per_rank_updates(make(), params, stacked)
+        for a, b in zip(base, fused):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_early_reduction_megastep_bitwise(self, monkeypatch):
+        """fused x early_reduction x sharded: each microbatch's chunked
+        exact reduction is bitwise-equal to the unfused one, so the
+        whole megastep trajectory composes bitwise."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        k = 4
+        shapes = [(6,), (3, 2)]
+        mesh = hvd.global_mesh()
+        rng = np.random.RandomState(24)
+        stacked = [jnp.asarray(np.round(rng.randn(N, k, *s) * 8),
+                               jnp.float32) for s in shapes]
+        params = [jnp.zeros(s, jnp.float32) for s in shapes]
+
+        def run():
+            opt = hvd.DistributedOptimizer(
+                _dyadic_sgd(), backward_passes_per_step=k,
+                early_reduction=True, shard_optimizer_states=True,
+                fusion_threshold_bytes=64, axis_name=hvd.GLOBAL_AXIS)
+
+            def body(*xs):
+                state = opt.init(list(params))
+                p = list(params)
+                for j in range(k):
+                    g = [x[0, j] for x in xs]
+                    u, state = opt.update(g, state, p)
+                    p = [pi + ui for pi, ui in zip(p, u)]
+                return p
+
+            sm = shard_map(
+                body, mesh=mesh,
+                in_specs=tuple(P(hvd.GLOBAL_AXIS) for _ in shapes),
+                out_specs=P(), check_vma=False)
+            return jax.jit(sm)(*stacked)
+
+        base = run()
+        self._arm(monkeypatch)
+        fused = run()
+        for a, b in zip(base, fused):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_fused_steps_metric_counts(self, monkeypatch):
+        """hvd_fused_steps increments once per executed step when the
+        pipeline is armed, and stays put when it is not."""
+        from horovod_tpu.metrics import catalog as met
+
+        monkeypatch.setenv("HOROVOD_METRICS", "1")
+        opt = hvd.DistributedOptimizer(_dyadic_sgd(), fused_apply=True)
+        stacked = _stacked_grads(25, self.SHAPES)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+
+        def step_fn(g):
+            u, _ = opt.update(g, opt.init(list(params)), list(params))
+            return u
+
+        step = hvd.data_parallel(step_fn)
+        before = met.fused_steps.labels().get()
+        # data_parallel donates the batch arg: feed a fresh copy per call.
+        step([jnp.array(g) for g in stacked])
+        assert met.fused_steps.labels().get() == before
+        self._arm(monkeypatch)
+        step([jnp.array(g) for g in stacked])
+        assert met.fused_steps.labels().get() == before + 1

@@ -83,3 +83,53 @@ def test_tree_reduce_uses_pallas_when_forced(monkeypatch):
 def test_auto_gating():
     # CPU interpreter default: off unless forced.
     assert not PK.pallas_enabled(10**9)
+
+
+# ---------------------------------------------------------------------------
+# Fused-pipeline Pallas matmul (ops/fused_collectives.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape", [(128, 128, 128), (200, 300, 150),
+                                   (7, 5, 3), (129, 257, 129)])
+def test_pallas_matmul_matches_jnp(shape):
+    from horovod_tpu.ops.fused_collectives import pallas_matmul
+    m, k, n = shape
+    rng = np.random.RandomState(7)
+    a = rng.randn(m, k).astype(np.float32)
+    b = rng.randn(k, n).astype(np.float32)
+    out = pallas_matmul(jnp.asarray(a), jnp.asarray(b))
+    assert out.shape == (m, n)
+    np.testing.assert_allclose(np.asarray(out), a @ b,
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_pallas_matmul_shape_mismatch_raises():
+    from horovod_tpu.common.exceptions import HorovodTpuError
+    from horovod_tpu.ops.fused_collectives import pallas_matmul
+    with pytest.raises(HorovodTpuError, match="inner dims"):
+        pallas_matmul(jnp.zeros((4, 5)), jnp.zeros((6, 7)))
+
+
+def test_fused_pallas_gating(monkeypatch):
+    from horovod_tpu.ops import fused_collectives as fc
+    # Opt-in: off by default even for big operands.
+    monkeypatch.delenv("HOROVOD_FUSED_PALLAS", raising=False)
+    assert not fc.fused_pallas_enabled(10**9)
+    # Tiny operands stay on the XLA dot even when forced.
+    monkeypatch.setenv("HOROVOD_FUSED_PALLAS", "1")
+    assert not fc.fused_pallas_enabled(16)
+    if fc.PALLAS_AVAILABLE:
+        assert fc.fused_pallas_enabled(10**9)
+
+
+def test_chunk_matmul_rides_pallas_when_forced(monkeypatch):
+    # The fused chunks' compute stage must route through the Pallas
+    # kernel when HOROVOD_FUSED_PALLAS=1 and still match the XLA dot.
+    monkeypatch.setenv("HOROVOD_FUSED_PALLAS", "1")
+    from horovod_tpu.ops.fused_collectives import _chunk_matmul
+    rng = np.random.RandomState(8)
+    a = rng.randn(150, 140).astype(np.float32)
+    b = rng.randn(140, 130).astype(np.float32)
+    out = _chunk_matmul(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), a @ b,
+                               rtol=1e-4, atol=1e-3)

@@ -124,9 +124,12 @@ class TestRoundTrip:
 class TestPolicyGrammar:
     def test_exact_and_auto(self):
         assert wire.parse_wire_policy("exact").exact
+        # auto defers BOTH the threshold and the big-bucket format to
+        # the autotuner/env (wire_threshold / wire_big_format knobs).
         p = wire.parse_wire_policy("auto")
         assert (p.big, p.small, p.threshold_bytes) == (
-            "int8", "none", None)
+            None, "none", None)
+        assert p.codec_for(10**9, True) == "int8"  # env default
 
     def test_explicit_pairs(self):
         p = wire.parse_wire_policy("big=int4,small=bf16,threshold=4096")
@@ -153,7 +156,23 @@ class TestPolicyGrammar:
         monkeypatch.delenv("HOROVOD_WIRE_POLICY", raising=False)
         assert wire.policy_from_env() is None
         monkeypatch.setenv("HOROVOD_WIRE_POLICY", "auto")
-        assert wire.policy_from_env().big == "int8"
+        assert wire.policy_from_env().codec_for(10**9, True) == "int8"
+
+    def test_big_format_defers_to_autotune_env(self, monkeypatch):
+        # The per-bucket-class FORMAT search: auto's big codec follows
+        # HOROVOD_WIRE_BIG_FORMAT (and the wire_big_format knob) at
+        # classification time, like the threshold deferral.
+        monkeypatch.setenv("HOROVOD_WIRE_BIG_FORMAT", "int4")
+        p = wire.parse_wire_policy("auto")
+        assert p.codec_for(10**9, True) == "int4"
+        assert p.codec_for(10**9, False) == "none"
+        # An explicit big= pins the codec regardless of the knob.
+        pinned = wire.parse_wire_policy("big=fp8_e4m3")
+        assert pinned.codec_for(10**9, True) == "fp8_e4m3"
+        # Unknown formats fail loudly at classification.
+        monkeypatch.setenv("HOROVOD_WIRE_BIG_FORMAT", "int9")
+        with pytest.raises(Exception, match="int9"):
+            wire.parse_wire_policy("auto").codec_for(10**9, True)
 
     def test_threshold_defers_to_autotune_env(self, monkeypatch):
         monkeypatch.setenv("HOROVOD_WIRE_THRESHOLD", "2048")
