@@ -41,6 +41,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 PROBE_TIMEOUT = float(os.environ.get("HOROVOD_BACKEND_PROBE_TIMEOUT", "120"))
@@ -340,7 +341,35 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
             sum(occ for *_, occ in fplan) / max(1, len(fplan)), 4)
         record["fused_occupancy_max"] = round(
             max((occ for *_, occ in fplan), default=0.0), 4)
+    from horovod_tpu.utils import timeline as _tl_mod
+    if _tl_mod.get_timeline() is not None:
+        # Trace-measured pass (docs/TRACE.md): restart the timeline so
+        # the file holds ONLY device-synced steps — the async warmup/
+        # timing dispatches above would otherwise pollute the cycle
+        # windows `trace analyze` measures — then run per-step-synced
+        # iterations; data_parallel marks one CYCLE_n per call.
+        trace_iters = 6
+        hvd.start_timeline(os.environ["HOROVOD_TIMELINE"],
+                           mark_cycles=True)
+        for _ in range(trace_iters):
+            state, opt_state, loss = step(state, opt_state, sb)
+            sync(loss)
+        hvd.stop_timeline()
+        record["trace_steps"] = trace_iters
     print(json.dumps(record))
+
+
+def _load_trace_core():
+    """The fleet tracer's analyzer (horovod_tpu/trace/core.py), loaded
+    by file path so the bench parent never imports the package (and so
+    never pulls jax in — the same rule hvdlint follows)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "horovod_tpu", "trace", "core.py")
+    spec = importlib.util.spec_from_file_location("_hvd_trace_core", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 # Side channel: the full JSON record of the most recent sim child, so
@@ -353,8 +382,10 @@ _LAST_SIM_RECORD = None
 def _run_sim_record(n: int, distributed: bool, timeout: float,
                     legacy: bool = False, sharded: bool = False,
                     quant: bool = False, guard: bool = False,
-                    fused: bool = False):
-    """Run one sim child; return its full JSON record (or None)."""
+                    fused: bool = False, timeline: "str | None" = None):
+    """Run one sim child; return its full JSON record (or None).
+    `timeline` arms HOROVOD_TIMELINE in the child so it appends the
+    trace-measured synced pass (see run_sim_child)."""
     global _LAST_SIM_RECORD
     _LAST_SIM_RECORD = None
     env = dict(os.environ)
@@ -363,6 +394,11 @@ def _run_sim_record(n: int, distributed: bool, timeout: float,
     env.pop("HOROVOD_WIRE_POLICY", None)
     env.pop("HOROVOD_GUARD", None)
     env.pop("HOROVOD_FUSED_COLLECTIVES", None)
+    env.pop("HOROVOD_TIMELINE", None)
+    env.pop("HOROVOD_TIMELINE_MARK_CYCLES", None)
+    if timeline:
+        env["HOROVOD_TIMELINE"] = timeline
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
     if legacy:
         env["HOROVOD_BENCH_LEGACY_PIPELINE"] = "1"
     if sharded:
@@ -606,6 +642,41 @@ def sim_scaling_efficiency(timeout: float = 600.0,
                         f"{rec_fused.get('fused_chunks_total', 0)} "
                         f"chunks in {rec_fused.get('fused_buckets', 0)} "
                         f"buckets")
+
+        # Trace-MEASURED attribution (docs/TRACE.md): re-run the n=8
+        # dist/no-dist pair with the timeline armed; the fleet tracer's
+        # analyzer reads the per-step critical path from device-synced
+        # CYCLE windows instead of wall-clock subtraction.  The sim mesh
+        # is one process, so the cross-rank skew component is
+        # structurally zero here — skew_share becomes meaningful on
+        # multi-process (np>=2) timelines.  Gated on a real child record
+        # from the probes above: a stubbed/recordless run has no sim
+        # children to re-launch.
+        if _LAST_SIM_RECORD is not None or rec_fused is not None:
+            try:
+                tdir = tempfile.mkdtemp(prefix="hvd_bench_trace_")
+                dist_tl = os.path.join(tdir, "dist.json")
+                nodist_tl = os.path.join(tdir, "nodist.json")
+                _run_sim_record(8, True, timeout, timeline=dist_tl)
+                _run_sim_record(8, False, timeout, timeline=nodist_tl)
+                tc = _load_trace_core()
+                cp_d = tc.analyze([dist_tl])["summary"]
+                cp_n = tc.analyze([nodist_tl])["summary"]
+                d, nd = (cp_d["critical_path_ms_median"],
+                         cp_n["critical_path_ms_median"])
+                if d > 0 and nd > 0:
+                    extras["critical_path_ms_measured"] = round(d, 1)
+                    extras["collective_share_measured"] = round(
+                        max(0.0, 1.0 - nd / d), 4)
+                    extras["skew_share"] = cp_d["skew_share"]
+                    log(f"sim-scaling trace-measured: critical path "
+                        f"{d:.1f} ms/step, collective share "
+                        f"{100 * extras['collective_share_measured']:.1f}"
+                        f"% (measured), skew share "
+                        f"{100 * extras['skew_share']:.1f}%")
+            except Exception as e:  # noqa: BLE001 — must not sink bench
+                log(f"sim-scaling trace-measured attribution "
+                    f"skipped: {e}")
 
     def _trimmed_median(vals):
         s = _np.sort(_np.asarray(vals))

@@ -206,6 +206,19 @@ CATALOG: Tuple[EnvVar, ...] = (
        "1 forces the pure-Python timeline writer (skips the native C++ "
        "buffered writer).", "TIMELINE.md"),
 
+    # -- fleet tracer (horovod_tpu/trace) --------------------------------
+    _v("HOROVOD_TRACE_STEP_SPANS", "1", "trace",
+       "1 emits one per-step host span (ph=X, cat=step) per dispatched "
+       "data_parallel step when the timeline is active — the record the "
+       "fleet tracer's critical-path analysis consumes.", "TRACE.md"),
+    _v("HOROVOD_TRACE_ALIGN", "cycle", "trace",
+       "Cross-rank clock alignment for trace merge/analyze: 'cycle' "
+       "aligns ranks on the CYCLE_n per-step barrier instants, 'wall' "
+       "trusts the raw per-rank clocks.", "TRACE.md"),
+    _v("HOROVOD_TRACE_FLOW_EVENTS", "1", "trace",
+       "1 links the same collective across ranks with Chrome flow "
+       "events (s/t/f) in the merged fleet trace.", "TRACE.md"),
+
     # -- autotune / gradient pipeline -----------------------------------
     _v("HOROVOD_AUTOTUNE", "0", "autotune",
        "1 enables the online autotuner (fusion threshold, bucket "
@@ -364,8 +377,8 @@ PREFIXES: Dict[str, str] = {
 
 _COMPONENT_ORDER = (
     "topology", "launcher", "rendezvous", "elastic", "faults",
-    "metrics", "timeline", "autotune", "guard", "ops", "models",
-    "bench",
+    "metrics", "timeline", "trace", "autotune", "guard", "ops",
+    "models", "bench",
 )
 
 _HEADER = """\

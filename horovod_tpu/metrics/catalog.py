@@ -115,6 +115,25 @@ stall_warnings = _REG.counter(
 stall_aborts = _REG.counter(
     "hvd_stall_aborts_total",
     "Stall-inspector aborts triggered (shutdown threshold exceeded).")
+stall_laggards = _REG.gauge(
+    "hvd_stall_laggards",
+    "Ranks behind the fleet at the most recent stall warning (0 when "
+    "the last warning named no laggard).")
+
+# -- fleet tracer (horovod_tpu/trace, docs/TRACE.md) -------------------------
+critical_path_ms = _REG.gauge(
+    "hvd_critical_path_ms",
+    "Host-side wall time of the last dispatched step (ms); overwritten "
+    "with the cross-rank per-step critical path when trace analysis "
+    "runs (TraceMeasurements.apply_to_metrics).")
+step_skew_ms = _REG.gauge(
+    "hvd_step_skew_ms",
+    "Cross-rank arrival skew at the per-step barrier from the last "
+    "trace analysis (ms; max minus min CYCLE_n arrival).")
+straggler_rank = _REG.gauge(
+    "hvd_straggler_rank",
+    "Rank most often last to arrive at the step barrier in the last "
+    "trace analysis (-1 = none identified).")
 
 # -- elastic driver (runner/elastic/driver.py) ------------------------------
 elastic_rank_added = _REG.counter(

@@ -185,6 +185,37 @@ def render_fleet(snaps: List[dict]) -> str:
         total = _counter_total(agg, name)
         if total:
             lines.append(f"{label}: {int(total)}")
+
+    # Trace attribution (fleet tracer, docs/TRACE.md): per-rank step
+    # critical path is live; skew/straggler appear once trace analysis
+    # has published them on any rank.
+    def _gauge_by_rank(name, keep_zero=False):
+        m = agg.get(name)
+        if not m or m["kind"] != "gauge":
+            return {}
+        per = m["samples"].get((), {})
+        return {r: v for r, v in per.items() if keep_zero or v}
+    cp = _gauge_by_rank("hvd_critical_path_ms")
+    skew = _gauge_by_rank("hvd_step_skew_ms")
+    laggards = _gauge_by_rank("hvd_stall_laggards")
+    if cp or skew:
+        lines.append("")
+        if cp:
+            lines.append("step critical path (ms): " + "  ".join(
+                f"rank{r}={v:.1f}" for r, v in sorted(cp.items())))
+        if skew:
+            lines.append("step barrier skew (ms): " + "  ".join(
+                f"rank{r}={v:.1f}" for r, v in sorted(skew.items())))
+            strag = _gauge_by_rank("hvd_straggler_rank", keep_zero=True)
+            for r, v in sorted(strag.items()):
+                # Only meaningful on ranks whose analysis set the skew
+                # gauge too (the default 0 would read as "rank 0").
+                if r in skew and v >= 0:
+                    lines.append(f"blamed straggler (rank {r}'s "
+                                 f"analysis): rank {int(v)}")
+    if laggards:
+        lines.append("stall laggards (last warning): " + "  ".join(
+            f"rank{r}={int(v)}" for r, v in sorted(laggards.items())))
     return "\n".join(lines) + "\n"
 
 

@@ -19,6 +19,7 @@ update in place in HBM.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -275,7 +276,7 @@ def _sentinel_flags(
         flags.append(f)
         if tl is not None:
             tl.instant(f"guard_bucket_{k}", category="guard",
-                       args={"leaves": len(idxs)})
+                       args={"bucket": k, "leaves": len(idxs)})
     vec = (jnp.stack(flags) if flags
            else jnp.zeros((1,), jnp.float32))
     return _sent.crossrank_or(vec, axis_name=axis_name,
@@ -532,13 +533,13 @@ def reduce_gradient_buckets(
                 # Host-side per-bucket wire label — once per compile for
                 # traced steps, matching the trace-time gauge idiom.
                 tl.instant(f"wire_bucket_{k}", category="wire",
-                           args={"format": codec.name,
+                           args={"bucket": k, "format": codec.name,
                                  "leaves": len(idxs), "raw_bytes": raw,
                                  "wire_bytes": wbytes})
                 if fused:
                     cb = _fc.plan_chunks(nelem, 4)
                     tl.instant(f"fused_bucket_{k}", category="fused",
-                               args={"format": codec.name,
+                               args={"bucket": k, "format": codec.name,
                                      "chunks": len(cb),
                                      "chunk_bytes": 4 * cb[0][1]})
             results.append((idxs, outs))
@@ -850,6 +851,12 @@ def data_parallel(
             return jax.device_put(x, sharding)
         return x
 
+    # Per-step host spans for the fleet tracer (docs/TRACE.md): one
+    # `ph="X"` record per dispatched step, carrying the step ID the
+    # cross-rank merger aligns on.  Gate exists so a timeline run can
+    # drop back to instants-only.
+    trace_step_spans = util.env_bool("TRACE_STEP_SPANS", True)
+
     def call(*args):
         n_args = len(args)
         key = (n_args, _autotune_key())
@@ -879,6 +886,10 @@ def data_parallel(
                 del compiled_cache[k]
             compiled_cache[key] = entry
         fn, in_shardings = entry
+        tl = _tl.get_timeline()
+        t0 = time.perf_counter()
+        t0_us = (tl.now_us()
+                 if tl is not None and trace_step_spans else None)
         args = tuple(
             (jax.tree_util.tree_map(lambda x, s=s: _coerce(x, s), a)
              if isinstance(s, NamedSharding)
@@ -895,12 +906,18 @@ def data_parallel(
         _autotune_record(args)
         # Step-cycle marker (reference: HOROVOD_TIMELINE_MARK_CYCLES
         # marks each runloop cycle; the SPMD analog is one compiled step).
-        from ..utils import timeline as _tl
-        tl = _tl.get_timeline()
         if tl is not None:
             tl.mark_cycle()
+            if t0_us is not None:
+                # Emitted after mark_cycle so the span carries the ID of
+                # the step it measured (step N ends at CYCLE_N).
+                tl.complete("step", category="step", start_us=t0_us)
         if _met.enabled():
             _met.steps.inc()
+            # Host-side wall time of this step's dispatch; the fleet view
+            # reads it per rank, and offline trace analysis overwrites it
+            # with the cross-rank critical path (docs/TRACE.md).
+            _met.critical_path_ms.set((time.perf_counter() - t0) * 1e3)
             from ..ops.fused_collectives import fused_enabled
             if fused_enabled():
                 _met.fused_steps.inc()

@@ -1,0 +1,24 @@
+"""horovod_tpu.trace — the fleet tracer (docs/TRACE.md).
+
+    python -m horovod_tpu.trace merge   name.json name.rank1.json -o fleet.json
+    python -m horovod_tpu.trace analyze name.json name.rank1.json
+
+`core` is pure stdlib (bench.py loads it by file path, jax-free);
+`measure.TraceMeasurements` feeds the analysis back into the metrics
+catalog and the autotuner.
+"""
+
+from .core import (  # noqa: F401
+    analyze,
+    clock_offsets,
+    cycle_arrivals,
+    load_events,
+    load_rank_traces,
+    merge,
+    write_merged,
+)
+from .measure import TraceMeasurements  # noqa: F401
+
+__all__ = ["analyze", "clock_offsets", "cycle_arrivals", "load_events",
+           "load_rank_traces", "merge", "write_merged",
+           "TraceMeasurements"]

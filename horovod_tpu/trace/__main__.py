@@ -1,0 +1,69 @@
+"""`python -m horovod_tpu.trace` — merge / analyze rank timelines.
+
+    # one Perfetto-compatible fleet trace with cross-rank flow events
+    python -m horovod_tpu.trace merge train.json train.rank1.json \
+        -o fleet_trace.json
+
+    # per-step critical path + straggler attribution (JSON report)
+    python -m horovod_tpu.trace analyze train.json train.rank*.json
+
+Inputs are the per-rank HOROVOD_TIMELINE files from a run with
+HOROVOD_TIMELINE_ALL_RANKS=1 and HOROVOD_TIMELINE_MARK_CYCLES=1 (the
+CYCLE_n barrier instants are what the ranks are clock-aligned on).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import core
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.trace",
+        description="Cross-rank fleet trace merge + attribution "
+                    "(docs/TRACE.md).")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    mp = sub.add_parser("merge",
+                        help="join rank timelines into one Perfetto trace")
+    mp.add_argument("files", nargs="+", metavar="RANK_TIMELINE")
+    mp.add_argument("-o", "--out", default="fleet_trace.json")
+    mp.add_argument("--align", choices=("cycle", "wall"), default=None,
+                    help="clock alignment (default: HOROVOD_TRACE_ALIGN "
+                         "or 'cycle')")
+    mp.add_argument("--no-flow", action="store_true",
+                    help="skip cross-rank flow events")
+
+    anp = sub.add_parser("analyze",
+                         help="per-step critical path + straggler "
+                              "attribution")
+    anp.add_argument("files", nargs="+", metavar="RANK_TIMELINE")
+    anp.add_argument("-o", "--out", default=None,
+                     help="also write the JSON report here")
+    anp.add_argument("--align", choices=("cycle", "wall"), default=None)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "merge":
+        merged = core.merge(args.files, align=args.align,
+                            flow=(False if args.no_flow else None))
+        core.write_merged(merged, args.out)
+        md = merged["metadata"]
+        print(f"wrote {args.out}: {len(merged['traceEvents'])} events, "
+              f"ranks {md['ranks']}, {md['flow_events']} flow events, "
+              f"align={md['align']}")
+        return 0
+    report = core.analyze(args.files, align=args.align)
+    text = json.dumps(report, indent=2, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,370 @@
+"""Cross-rank fleet-trace merge + critical-path / straggler analysis.
+
+The per-rank control-plane timelines (utils/timeline.py, HOROVOD_TIMELINE
+with HOROVOD_TIMELINE_ALL_RANKS=1 + HOROVOD_TIMELINE_MARK_CYCLES=1) are
+forensic but blind to each other: each rank's clock is its own
+`perf_counter` origin, so raw wall clocks cannot say whether a slow
+collective was wire time or wait-for-straggler skew.  This module turns
+them into one attributed story:
+
+  - `merge`   — one Perfetto/chrome://tracing JSON, ranks clock-aligned
+    on the per-step barrier (the CYCLE_n instants every rank emits at
+    the same logical point), with flow events linking the same
+    collective across ranks.
+  - `analyze` — per-step critical path, cross-rank barrier skew, and a
+    per-bucket decomposition of collective time into straggler-wait
+    (skew between the last-arriving rank and the rest) vs wire, naming
+    the blamed rank.
+
+Attribution semantics (docs/TRACE.md):
+
+  - skew_ms(step n)      = max_r ts(CYCLE_n) - min_r ts(CYCLE_n)
+  - critical_path_ms(n)  = max_r ts(CYCLE_n) - min_r ts(CYCLE_{n-1})
+  - per collective bucket observed on >= 2 ranks in the same step:
+      wait_ms = max_r start - min_r start   (straggler wait)
+      wire_ms = max_r end   - max_r start   (transfer after last arrival)
+      blamed  = the last-arriving rank
+  - compute_ms(n) = critical_path_ms(n) - wait - wire, clamped at 0.
+
+Pure stdlib ON PURPOSE: bench.py and the offline CLI load this file by
+path (importlib) so trace analysis never drags jax in — the same rule
+hvdlint follows (docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import statistics
+from typing import Dict, List, Optional, Sequence, Union
+
+__all__ = ["load_events", "load_rank_traces", "cycle_arrivals",
+           "clock_offsets", "merge", "write_merged", "analyze"]
+
+_CYCLE_RE = re.compile(r"^CYCLE_(\d+)$")
+_RANK_FILE_RE = re.compile(r"\.rank(\d+)\.")
+
+#: Instant categories that are emitted once per compile per rank and are
+#: therefore linked across ranks by name alone (no step key needed).
+_STATIC_LINK_CATS = frozenset(("wire", "guard", "fused"))
+
+Traces = Dict[int, List[dict]]
+
+
+def _env_true(name: str, default: str) -> bool:
+    return os.environ.get(name, default).strip().lower() not in (
+        "0", "false", "no", "off", "")
+
+
+def load_events(path: str) -> List[dict]:
+    """Parse one rank's timeline.  The writer's array may lack the
+    closing bracket if the process died mid-run (valid per the Chrome
+    trace reader; tolerate it here too, like utils/profiler.py)."""
+    with open(path) as f:
+        text = f.read().strip()
+    if text.endswith(","):
+        text = text[:-1]
+    if text.startswith("[") and not text.endswith("]"):
+        text += "]"
+    events = json.loads(text)
+    if isinstance(events, dict):
+        events = events.get("traceEvents", [])
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: expected a Chrome-trace event array")
+    return events
+
+
+def _rank_of(path: str, events: Sequence[dict]) -> int:
+    for ev in events:
+        if "pid" in ev:
+            return int(ev["pid"])
+    m = _RANK_FILE_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def load_rank_traces(paths: Sequence[str]) -> Traces:
+    """Load `<name>.rank*.json` files into {rank: events}."""
+    traces: Traces = {}
+    for p in paths:
+        events = load_events(p)
+        rank = _rank_of(p, events)
+        if rank in traces:
+            raise ValueError(
+                f"{p}: rank {rank} already loaded — pass one timeline "
+                "file per rank")
+        traces[rank] = events
+    return traces
+
+
+def cycle_arrivals(events: Sequence[dict]) -> Dict[int, float]:
+    """{step n: ts_us of the CYCLE_n barrier instant}."""
+    out: Dict[int, float] = {}
+    for ev in events:
+        m = _CYCLE_RE.match(str(ev.get("name", "")))
+        if m and ev.get("ph") == "i":
+            out[int(m.group(1))] = float(ev.get("ts", 0.0))
+    return out
+
+
+def clock_offsets(traces: Traces, align: str = "cycle") -> Dict[int, float]:
+    """Per-rank clock offset (us) subtracted to land every rank on the
+    reference rank's clock.  `cycle` aligns on the per-step barrier: the
+    median over common steps of ts_r(CYCLE_n) - ts_ref(CYCLE_n) — the
+    median keeps one skewed step from biasing the whole alignment.
+    `wall` trusts the raw clocks (offset 0)."""
+    ranks = sorted(traces)
+    offsets = {r: 0.0 for r in ranks}
+    if align != "cycle" or not ranks:
+        return offsets
+    ref = ranks[0]
+    ref_cycles = cycle_arrivals(traces[ref])
+    for r in ranks[1:]:
+        cyc = cycle_arrivals(traces[r])
+        common = sorted(set(cyc) & set(ref_cycles))
+        if common:
+            offsets[r] = statistics.median(
+                cyc[n] - ref_cycles[n] for n in common)
+    return offsets
+
+
+def _aligned(traces: Traces, offsets: Dict[int, float]) -> Traces:
+    out: Traces = {}
+    for r, events in traces.items():
+        off = offsets.get(r, 0.0)
+        shifted = []
+        for ev in events:
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) - off, 1)
+            ev["pid"] = r
+            shifted.append(ev)
+        out[r] = shifted
+    return out
+
+
+def _flow_groups(traces: Traces) -> Dict[tuple, List[dict]]:
+    """Group events representing the SAME logical operation across
+    ranks.  Collective spans match on (step, name, tid); the trace-time
+    instants (wire/guard/fused buckets) and the CYCLE_n barriers match
+    on name alone."""
+    groups: Dict[tuple, List[dict]] = {}
+    for r, events in traces.items():
+        for ev in events:
+            name = str(ev.get("name", ""))
+            cat = str(ev.get("cat", ""))
+            if ev.get("ph") == "X" and cat == "collective":
+                key = ("coll", ev.get("step"), name, str(ev.get("tid", "")))
+            elif ev.get("ph") == "i" and (cat in _STATIC_LINK_CATS
+                                          or _CYCLE_RE.match(name)):
+                key = ("instant", cat, name)
+            else:
+                continue
+            groups.setdefault(key, []).append(ev)
+    return groups
+
+
+def _flow_events(traces: Traces) -> List[dict]:
+    flows: List[dict] = []
+    next_id = 1
+    for key, evs in sorted(_flow_groups(traces).items(),
+                           key=lambda kv: str(kv[0])):
+        if len({ev["pid"] for ev in evs}) < 2:
+            continue
+        evs = sorted(evs, key=lambda ev: float(ev.get("ts", 0.0)))
+        for i, ev in enumerate(evs):
+            ts = float(ev.get("ts", 0.0))
+            if ev.get("ph") == "X":
+                # Bind the flow inside the slice, not at its left edge.
+                ts += float(ev.get("dur", 0.0)) / 2.0
+            ph = "s" if i == 0 else ("f" if i == len(evs) - 1 else "t")
+            flow = {
+                "name": f"xrank {ev.get('name', '')}",
+                "cat": "xrank",
+                "ph": ph,
+                "id": next_id,
+                "ts": round(ts, 1),
+                "pid": ev["pid"],
+                "tid": ev.get("tid", ""),
+            }
+            if ph == "f":
+                flow["bp"] = "e"
+            flows.append(flow)
+        next_id += 1
+    return flows
+
+
+def merge(traces_or_paths: Union[Traces, Sequence[str]],
+          align: Optional[str] = None,
+          flow: Optional[bool] = None) -> dict:
+    """Join all ranks' timelines into one Perfetto-compatible trace.
+
+    Returns the Chrome-trace "JSON Object Format": {"traceEvents": [...],
+    "metadata": {...}} with pid = rank (process_name metadata included)
+    and, when `flow`, s/t/f flow events linking the same collective
+    across ranks.
+    """
+    if align is None:
+        align = os.environ.get("HOROVOD_TRACE_ALIGN", "cycle")
+    if flow is None:
+        flow = _env_true("HOROVOD_TRACE_FLOW_EVENTS", "1")
+    traces = (traces_or_paths if isinstance(traces_or_paths, dict)
+              else load_rank_traces(traces_or_paths))
+    offsets = clock_offsets(traces, align=align)
+    aligned = _aligned(traces, offsets)
+
+    events: List[dict] = []
+    for r in sorted(aligned):
+        events.append({"name": "process_name", "ph": "M", "pid": r,
+                       "args": {"name": f"hvd rank {r}"}})
+        events.append({"name": "process_sort_index", "ph": "M", "pid": r,
+                       "args": {"sort_index": r}})
+        events.extend(aligned[r])
+    flows = _flow_events(aligned) if flow else []
+    events.extend(flows)
+    return {
+        "traceEvents": events,
+        "metadata": {
+            "align": align,
+            "ranks": sorted(traces),
+            "clock_offsets_us": {str(r): round(o, 1)
+                                 for r, o in offsets.items()},
+            "flow_events": len(flows),
+        },
+    }
+
+
+def write_merged(merged: dict, out_path: str) -> None:
+    with open(out_path, "w") as f:
+        json.dump(merged, f, default=str)
+
+
+# ---------------------------------------------------------------------------
+# Analysis
+# ---------------------------------------------------------------------------
+
+def _bucket_window(ev: dict, cycles: Dict[int, float]) -> Optional[int]:
+    """The step a collective span belongs to.  The timeline stamps the
+    number of COMPLETED cycles at bracket start, so a span issued during
+    step n carries step=n-1; fall back to the ts window for records from
+    older traces without the stamp."""
+    if "step" in ev:
+        return int(ev["step"]) + 1
+    ts = float(ev.get("ts", 0.0))
+    for n in sorted(cycles):
+        if (n - 1) in cycles and cycles[n - 1] <= ts < cycles[n]:
+            return n
+    return None
+
+
+def analyze(traces_or_paths: Union[Traces, Sequence[str]],
+            align: Optional[str] = None) -> dict:
+    """Per-step critical path + straggler attribution (see module
+    docstring for the formulas).  Returns a JSON-serializable report."""
+    if align is None:
+        align = os.environ.get("HOROVOD_TRACE_ALIGN", "cycle")
+    traces = (traces_or_paths if isinstance(traces_or_paths, dict)
+              else load_rank_traces(traces_or_paths))
+    offsets = clock_offsets(traces, align=align)
+    aligned = _aligned(traces, offsets)
+    ranks = sorted(aligned)
+    cycles = {r: cycle_arrivals(aligned[r]) for r in ranks}
+    common_set = (set.intersection(*(set(c) for c in cycles.values()))
+                  if cycles else set())
+    common = sorted(common_set)
+
+    # Collective spans per (step, name, tid) across ranks.
+    coll: Dict[tuple, List[tuple]] = {}
+    for r in ranks:
+        for ev in aligned[r]:
+            if ev.get("ph") != "X" or ev.get("cat") != "collective":
+                continue
+            n = _bucket_window(ev, cycles[r])
+            if n is None:
+                continue
+            key = (n, str(ev.get("name", "")), str(ev.get("tid", "")))
+            start = float(ev.get("ts", 0.0))
+            coll.setdefault(key, []).append(
+                (r, start, start + float(ev.get("dur", 0.0))))
+
+    steps: List[dict] = []
+    straggler_votes: Dict[int, int] = {}
+    cp_total = wait_total = wire_total = 0.0
+    for n in common:
+        arr = {r: cycles[r][n] for r in ranks}
+        last = max(ranks, key=lambda r: arr[r])
+        skew_ms = (max(arr.values()) - min(arr.values())) / 1e3
+        cp_ms = None
+        if (n - 1) in common_set:
+            cp_ms = (max(arr.values())
+                     - min(cycles[r][n - 1] for r in ranks)) / 1e3
+        buckets = []
+        step_wait = step_wire = 0.0
+        for (bn, name, tid), entries in sorted(coll.items()):
+            if bn != n:
+                continue
+            starts = {r: s for r, s, _ in entries}
+            ends = {r: e for r, _, e in entries}
+            if len(entries) >= 2:
+                wait_ms = (max(starts.values()) - min(starts.values())) / 1e3
+                wire_ms = max(0.0, (max(ends.values())
+                                    - max(starts.values())) / 1e3)
+                blamed = max(starts, key=lambda r: starts[r])
+            else:
+                only_r, s, e = entries[0]
+                wait_ms, wire_ms, blamed = 0.0, (e - s) / 1e3, None
+            step_wait += wait_ms
+            step_wire += wire_ms
+            buckets.append({
+                "name": name, "tid": tid, "ranks": len(entries),
+                "wait_ms": round(wait_ms, 3), "wire_ms": round(wire_ms, 3),
+                "blamed_rank": blamed,
+            })
+        compute_ms = (max(0.0, cp_ms - step_wait - step_wire)
+                      if cp_ms is not None else None)
+        if skew_ms > 0:
+            straggler_votes[last] = straggler_votes.get(last, 0) + 1
+        if cp_ms is not None:
+            cp_total += cp_ms
+            wait_total += step_wait
+            wire_total += step_wire
+        steps.append({
+            "step": n,
+            "skew_ms": round(skew_ms, 3),
+            "straggler_rank": last if skew_ms > 0 else None,
+            "critical_path_ms": (round(cp_ms, 3)
+                                 if cp_ms is not None else None),
+            "compute_ms": (round(compute_ms, 3)
+                           if compute_ms is not None else None),
+            "wait_ms": round(step_wait, 3),
+            "wire_ms": round(step_wire, 3),
+            "buckets": buckets,
+        })
+
+    skews = [s["skew_ms"] for s in steps]
+    cps = [s["critical_path_ms"] for s in steps
+           if s["critical_path_ms"] is not None]
+    straggler = (max(sorted(straggler_votes), key=straggler_votes.get)
+                 if straggler_votes else -1)
+    summary = {
+        "ranks": ranks,
+        "steps_analyzed": len(steps),
+        "step_skew_ms_median": round(statistics.median(skews), 3)
+        if skews else 0.0,
+        "step_skew_ms_max": round(max(skews), 3) if skews else 0.0,
+        "critical_path_ms_median": round(statistics.median(cps), 3)
+        if cps else 0.0,
+        "straggler_rank": straggler,
+        "skew_share": round(wait_total / cp_total, 4) if cp_total else 0.0,
+        "wire_share": round(wire_total / cp_total, 4) if cp_total else 0.0,
+        "collective_share_measured": (
+            round((wait_total + wire_total) / cp_total, 4)
+            if cp_total else 0.0),
+    }
+    return {
+        "align": align,
+        "clock_offsets_us": {str(r): round(o, 1)
+                             for r, o in offsets.items()},
+        "steps": steps,
+        "summary": summary,
+    }

@@ -245,6 +245,28 @@ class ParameterManager:
         with self._lock:
             self._record_sample_locked(rate)
 
+    def record_trace(self, step_ms: float, items_per_step: float = 1.0,
+                     bucket_ms: Optional[dict] = None) -> None:
+        """Measured-objective hook for the fleet tracer (docs/TRACE.md):
+        a trace-derived per-step critical path replaces the wall-clock
+        sampling of `record_step` — the objective the GP observes is the
+        measured step, not dispatch-loop time.  `bucket_ms` (per-bucket
+        collective milliseconds from `trace analyze`) is appended to the
+        autotune log so proposals can be audited against the per-bucket
+        timings they changed."""
+        if step_ms <= 0:
+            return
+        if bucket_ms and self._log_file:
+            try:
+                with open(self._log_file, "a") as f:
+                    per = ";".join(f"{k}={v:.3f}"
+                                   for k, v in sorted(bucket_ms.items()))
+                    f.write(f"{time.time():.3f},trace_buckets,{per}\n")
+            except OSError:
+                pass
+        with self._lock:
+            self._record_sample_locked(items_per_step / (step_ms / 1e3))
+
     def _record_sample_locked(self, rate: float) -> None:
         if self._frozen or self._bo is None:
             return

@@ -235,6 +235,7 @@ class StallInspector:
             if age >= self.warn_time and key not in self._warned:
                 self._warned.add(key)
                 warned_now.append(desc)
+                lag = []
                 if self._reporter is not None:
                     with self._lock:
                         my_seq = self._next_key
@@ -260,6 +261,9 @@ class StallInspector:
                 _m = _metrics()
                 if _m.enabled():
                     _m.stall_warnings.inc()
+                    # Stalls and stragglers tell one story: the fleet
+                    # view pairs this with hvd_straggler_rank/step skew.
+                    _m.stall_laggards.set(len(lag))
             if worst is None or age > worst[1]:
                 worst = (desc, age)
         if (

@@ -178,12 +178,32 @@ class Timeline:
     def _now_us(self) -> float:
         return (time.perf_counter() - self._t0) * 1e6
 
+    def now_us(self) -> float:
+        """Timeline-clock timestamp, for `complete()` callers bracketing
+        their own spans (e.g. the per-step span in data_parallel)."""
+        return self._now_us()
+
+    @property
+    def current_cycle(self) -> int:
+        """Cycles marked so far (= completed steps when the pipeline marks
+        one cycle per step)."""
+        return self._cycle
+
+    def _step_stamp(self) -> dict:
+        # Stable step ID for the cross-rank merger (horovod_tpu/trace):
+        # the number of completed cycles when the event fired.  Emitted as
+        # a TOP-LEVEL key — chrome://tracing ignores unknown keys and the
+        # native writer round-trips them via extra_json — so event `args`
+        # stay exactly what the call site passed.
+        return {"step": self._cycle} if self._mark_cycles else {}
+
     # -- per-tensor activities (reference: ActivityStart/ActivityEnd) -----
     def activity_start(self, tensor_name: str, activity: str) -> int:
         with self._lock:
             token = self._next_token
             self._next_token += 1
-            self._starts[token] = (tensor_name, activity, self._now_us())
+            self._starts[token] = (tensor_name, activity, self._now_us(),
+                                   self._cycle)
         return token
 
     def activity_end(self, token: int) -> None:
@@ -192,7 +212,7 @@ class Timeline:
             entry = self._starts.pop(token, None)
         if entry is None:
             return
-        tensor_name, activity, start = entry
+        tensor_name, activity, start, cycle = entry
         self._writer.enqueue({
             "name": activity,
             "cat": "collective",
@@ -201,6 +221,9 @@ class Timeline:
             "dur": round(now - start, 1),
             "pid": self._rank,
             "tid": tensor_name,
+            # Stamp the step the collective STARTED in, so a bracket that
+            # straddles a cycle mark stays attributed to its issue step.
+            **({"step": cycle} if self._mark_cycles else {}),
         })
 
     # -- instant events ---------------------------------------------------
@@ -214,6 +237,26 @@ class Timeline:
             "ts": round(self._now_us(), 1),
             "pid": self._rank,
             "tid": category,
+            **self._step_stamp(),
+            **({"args": args} if args else {}),
+        })
+
+    # -- complete spans with caller-held start (trace span model) ---------
+    def complete(self, name: str, category: str, start_us: float,
+                 args: Optional[dict] = None) -> None:
+        """Emit a `ph="X"` span from a caller-captured `now_us()` start to
+        now — the per-step host span the fleet tracer's critical-path
+        analysis consumes (tid = category, unlike per-tensor activities)."""
+        now = self._now_us()
+        self._writer.enqueue({
+            "name": name,
+            "cat": category,
+            "ph": "X",
+            "ts": round(start_us, 1),
+            "dur": round(now - start_us, 1),
+            "pid": self._rank,
+            "tid": category,
+            **self._step_stamp(),
             **({"args": args} if args else {}),
         })
 

@@ -13,6 +13,7 @@ from .excepts import ExceptionDiscipline
 from .locks import LockDiscipline
 from .pallas import PallasGuard
 from .purity import JitPurity
+from .timeline_cat import TimelineCatalog
 from .wires import WireRegistry
 
 #: The suite, in the order lint_all runs it.  Adding an analyzer =
@@ -26,9 +27,10 @@ ALL = [
     FaultPoints(),
     WireRegistry(),
     PallasGuard(),
+    TimelineCatalog(),
 ]
 
 __all__ = ["Analyzer", "Finding", "Project", "run_all", "ALL",
            "LockDiscipline", "JitPurity", "EnvVarRegistry",
            "ExceptionDiscipline", "MetricsCatalog", "FaultPoints",
-           "WireRegistry", "PallasGuard"]
+           "WireRegistry", "PallasGuard", "TimelineCatalog"]

@@ -1,0 +1,81 @@
+"""Worker main for the metrics fleet-view test (docs/METRICS.md).
+
+Each worker binds an EPHEMERAL Prometheus endpoint
+(HOROVOD_METRICS_PORT=0), scrapes itself over HTTP, publishes its
+snapshot to the rendezvous KV, then reads BOTH ranks' snapshots back
+and renders the merged fleet view — the cross-process half the
+single-process metrics suite cannot cover.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.metrics import catalog as met_catalog  # noqa: E402
+from horovod_tpu.metrics import exposition, fleet  # noqa: E402
+from horovod_tpu.runner.elastic_worker import client_from_env  # noqa: E402
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+
+    # Move some metrics before scraping/publishing.
+    out = np.asarray(hvd.allreduce(jnp.ones((4,)), name="grad"))
+    assert out[0] == 1.0  # default op is Average
+    met_catalog.critical_path_ms.set(1.5 + rank)
+
+    # HOROVOD_METRICS_PORT=0 -> each worker got its own ephemeral port.
+    port = exposition.server_port()
+    assert port, "metrics endpoint did not bind an ephemeral port"
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+
+    client = client_from_env()
+    fleet.publish(client, rank=rank)
+
+    # Wait for the OTHER rank's snapshot to land in the KV.
+    snaps = []
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        snaps = fleet.read_fleet(client)
+        if len(snaps) >= hvd.size():
+            break
+        time.sleep(0.2)
+
+    agg = fleet.aggregate(snaps)
+    rendered = fleet.render_fleet(snaps)
+    result = {
+        "rank": rank,
+        "port": port,
+        "scrape_has_calls": "hvd_collective_calls_total" in body,
+        "scrape_has_help": "# HELP" in body,
+        "fleet_ranks": [s.get("rank") for s in snaps],
+        "calls_total": sum(
+            agg["hvd_collective_calls_total"]["samples"].values()),
+        "cp_by_rank": agg["hvd_critical_path_ms"]["samples"].get(
+            (), {}),
+        "render": rendered,
+    }
+    out_dir = os.environ["HVD_TEST_OUT"]
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(result, f)
+    hvd.shutdown()
+    print(f"rank {rank} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Worker main for the fleet-tracer end-to-end test.
+
+Both ranks run with HOROVOD_TIMELINE + ALL_RANKS + MARK_CYCLES armed by
+the driver: each step is one eager allreduce followed by a cycle mark,
+so the per-rank timelines carry the CYCLE_n barrier instants and
+step-stamped collective spans `python -m horovod_tpu.trace` merges and
+attributes (docs/TRACE.md).
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.utils import timeline as tl_mod  # noqa: E402
+
+
+def main():
+    hvd.init()
+    rank = hvd.rank()
+    tl = tl_mod.get_timeline()
+    assert tl is not None, "HOROVOD_TIMELINE did not arm the timeline"
+
+    sums = []
+    for step in range(3):
+        out = np.asarray(hvd.allreduce(
+            jnp.full((4,), float(rank + 1)), name="grad.w"))
+        sums.append(float(out[0]))
+        tl.mark_cycle()
+
+    result = {"rank": rank, "size": hvd.size(), "sums": sums,
+              "cycles": tl.current_cycle}
+    out_dir = os.environ["HVD_TEST_OUT"]
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(result, f)
+    hvd.shutdown()  # closes the timeline (emits the closing bracket)
+    print(f"rank {rank} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

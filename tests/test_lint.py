@@ -841,3 +841,111 @@ def test_pallas_guard_pragma_suppresses(tmp_path):
                                   interpret=True)(x)
         """})
     assert PallasGuard().run(proj) == []
+
+
+# ---------------------------------------------------------------------------
+# timeline-catalog (fleet tracer, scripts/hvdlint/timeline_cat.py)
+# ---------------------------------------------------------------------------
+
+from hvdlint import TimelineCatalog  # noqa: E402
+
+TRACE_INSTANT_ROWS = ("CYCLE_n", "guard_bucket_k", "wire_bucket_k",
+                      "fused_bucket_k", "PROFILER_TRACE_START")
+
+
+def _timeline_doc(rows):
+    table = "\n".join(f"| `{r}` | somewhere | something |" for r in rows)
+    return ("# Timeline\n\n<!-- instant-catalog:start -->\n"
+            "| Instant | Emitted by | Meaning |\n|---|---|---|\n"
+            f"{table}\n<!-- instant-catalog:end -->\n")
+
+
+def test_timeline_catalog_clean_fixture(tmp_path):
+    proj = make_project(tmp_path, {
+        "horovod_tpu/a.py": '''\
+            MARKER = "PROFILER_TRACE_START"
+
+            def f(tl, k):
+                tl.instant(f"wire_bucket_{k}", category="wire")
+                tl.instant(MARKER, category="profiler")
+            ''',
+        "docs/TIMELINE.md": _timeline_doc(
+            ("wire_bucket_k", "PROFILER_TRACE_START")),
+    })
+    assert TimelineCatalog().run(proj) == []
+
+
+def test_timeline_catalog_undocumented_instant(tmp_path):
+    proj = make_project(tmp_path, {
+        "horovod_tpu/a.py": '''\
+            def f(tl, n):
+                tl.instant(f"CYCLE_{n}", category="cycle")
+                tl.instant("surprise_marker", category="event")
+            ''',
+        "docs/TIMELINE.md": _timeline_doc(("CYCLE_n",)),
+    })
+    findings = TimelineCatalog().run(proj)
+    assert [(f.rule, "surprise_marker" in f.message) for f in findings] \
+        == [("undocumented-instant", True)]
+    assert findings[0].path == "horovod_tpu/a.py"
+
+
+def test_timeline_catalog_stale_doc_entry(tmp_path):
+    proj = make_project(tmp_path, {
+        "horovod_tpu/a.py": '''\
+            def f(tl, n):
+                tl.instant(f"CYCLE_{n}", category="cycle")
+            ''',
+        "docs/TIMELINE.md": _timeline_doc(("CYCLE_n", "ghost_marker")),
+    })
+    findings = TimelineCatalog().run(proj)
+    assert [(f.rule, "ghost_marker" in f.message) for f in findings] \
+        == [("stale-doc-entry", True)]
+    assert findings[0].path == "docs/TIMELINE.md"
+
+
+def test_timeline_catalog_missing_section_is_error(tmp_path):
+    proj = make_project(tmp_path, {
+        "horovod_tpu/a.py": '''\
+            def f(tl):
+                tl.instant("evt")
+            ''',
+        "docs/TIMELINE.md": "# Timeline\n\nno catalog table here\n",
+    })
+    findings = TimelineCatalog().run(proj)
+    assert [f.rule for f in findings] == ["error"]
+    assert "instant-catalog" in findings[0].message
+
+
+def test_trace_instants_emitted_and_documented():
+    """Every fleet-tracer instant family must exist on BOTH sides the
+    timeline-catalog analyzer diffs — emitted in the package and rowed
+    in docs/TIMELINE.md — so deleting either side is a tier-1 failure."""
+    from hvdlint.timeline_cat import _doc_rows
+    rows = set(_doc_rows(_repo_text("docs/TIMELINE.md")))
+    for name in TRACE_INSTANT_ROWS:
+        assert name in rows, name
+    assert TimelineCatalog().run(Project(REPO)) == []
+
+
+def test_trace_gauges_registered_and_documented():
+    """The tracer's continuous surface (docs/TRACE.md) in the metrics
+    catalog and docs/METRICS.md, both directions."""
+    declared = set(_REG_RE.findall(
+        _repo_text("horovod_tpu/metrics/catalog.py")))
+    documented = set(_DOC_ROW_RE.findall(_repo_text("docs/METRICS.md")))
+    for gauge in ("hvd_critical_path_ms", "hvd_step_skew_ms",
+                  "hvd_straggler_rank", "hvd_stall_laggards"):
+        assert gauge in declared, gauge
+        assert gauge in documented, gauge
+
+
+def test_trace_env_vars_cataloged_and_documented():
+    declared = set(_ENV_DECL_RE.findall(
+        _repo_text("horovod_tpu/common/env_catalog.py")))
+    documented = set(_ENV_DOC_ROW_RE.findall(
+        _repo_text("docs/ENV_VARS.md")))
+    for var in ("HOROVOD_TRACE_STEP_SPANS", "HOROVOD_TRACE_ALIGN",
+                "HOROVOD_TRACE_FLOW_EVENTS"):
+        assert var in declared, var
+        assert var in documented, var

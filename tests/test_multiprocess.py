@@ -239,6 +239,124 @@ class TestStallInspectorNamesRanks:
         assert "Ranks behind: rank 0" in out, out
 
 
+TRACE_WORKER = os.path.join(REPO_ROOT, "tests", "data",
+                            "trace_timeline_main.py")
+
+
+@pytest.mark.integration
+class TestFleetTracerCrossProcess:
+    """End-to-end fleet tracer (docs/TRACE.md): two real ranks write
+    cycle-marked timelines; `python -m horovod_tpu.trace merge` joins
+    them into one Perfetto trace with cross-rank flow events and
+    `analyze` attributes the steps."""
+
+    def test_merge_and_analyze_real_rank_timelines(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        env["HVD_TEST_OUT"] = str(tmp_path)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["HOROVOD_TIMELINE"] = str(tmp_path / "tl.json")
+        env["HOROVOD_TIMELINE_ALL_RANKS"] = "1"
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             "python", TRACE_WORKER],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=REPO_ROOT)
+        assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+        for rank in (0, 1):
+            res = json.loads((tmp_path / f"rank{rank}.json").read_text())
+            assert res["cycles"] == 3
+            assert res["sums"] == [1.5, 1.5, 1.5]  # avg(1, 2) each step
+        rank_files = [str(tmp_path / "tl.json"),
+                      str(tmp_path / "tl.rank1.json")]
+        for p in rank_files:
+            assert os.path.exists(p), f"missing rank timeline {p}"
+
+        # Merge through the real CLI.
+        merged_path = tmp_path / "fleet_trace.json"
+        m = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.trace", "merge",
+             *rank_files, "-o", str(merged_path)],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO_ROOT)
+        assert m.returncode == 0, f"merge failed:\n{m.stdout}\n{m.stderr}"
+        doc = json.loads(merged_path.read_text())
+        events = doc["traceEvents"]
+        assert doc["metadata"]["ranks"] == [0, 1]
+        assert {e["pid"] for e in events} == {0, 1}
+        # The three CYCLE_n barriers each link the two ranks.
+        starts = [e for e in events if e["ph"] == "s"]
+        finishes = [e for e in events if e["ph"] == "f"]
+        assert len(starts) >= 3 and len(starts) == len(finishes)
+        assert doc["metadata"]["flow_events"] == len(starts) * 2
+        cycle_names = {e["name"] for e in events if e["ph"] == "i"}
+        assert {"CYCLE_1", "CYCLE_2", "CYCLE_3"} <= cycle_names
+
+        # Analyze through the real CLI.
+        a = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.trace", "analyze",
+             *rank_files],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO_ROOT)
+        assert a.returncode == 0, f"analyze failed:\n{a.stdout}\n{a.stderr}"
+        report = json.loads(a.stdout)
+        assert report["summary"]["ranks"] == [0, 1]
+        assert report["summary"]["steps_analyzed"] == 3
+        assert all(s["skew_ms"] >= 0 for s in report["steps"])
+        # The eager allreduces appear as attributed collective buckets.
+        assert any(s["buckets"] for s in report["steps"]), report
+
+
+FLEET_WORKER = os.path.join(REPO_ROOT, "tests", "data",
+                            "fleet_metrics_main.py")
+
+
+@pytest.mark.integration
+class TestMetricsFleetViewCrossProcess:
+    """Metrics fleet view under real processes (docs/METRICS.md): each
+    worker binds an ephemeral scrape endpoint (HOROVOD_METRICS_PORT=0),
+    publishes its snapshot to the rendezvous KV, and merges BOTH ranks'
+    snapshots into the rendered cluster view."""
+
+    def test_kv_merge_and_ephemeral_exposition(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        env["HVD_TEST_OUT"] = str(tmp_path)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["HOROVOD_METRICS_PORT"] = "0"
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             "python", FLEET_WORKER],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=REPO_ROOT)
+        assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+        results = {}
+        for rank in (0, 1):
+            path = tmp_path / f"rank{rank}.json"
+            assert path.exists(), \
+                f"rank {rank} wrote no result:\n{r.stdout}\n{r.stderr}"
+            results[rank] = json.loads(path.read_text())
+        # Ephemeral ports bound and distinct; scrape served Prometheus.
+        assert results[0]["port"] != results[1]["port"]
+        for rank, res in results.items():
+            assert res["port"] > 0
+            assert res["scrape_has_calls"] and res["scrape_has_help"]
+            # KV fleet merge saw BOTH ranks' snapshots.
+            assert sorted(res["fleet_ranks"]) == [0, 1]
+            # Counters summed across ranks: each rank did >= 1 collective.
+            assert res["calls_total"] >= 2
+            # Gauges stay per-rank in the merge.
+            assert res["cp_by_rank"] == {"0": 1.5, "1": 2.5}
+            assert res["render"].startswith("fleet view: 2 rank(s)")
+            assert "step critical path (ms): rank0=1.5  rank1=2.5" in (
+                res["render"])
+
+
 CC_WORKER = os.path.join(REPO_ROOT, "tests", "data", "consistency_main.py")
 
 

@@ -68,6 +68,68 @@ def test_instant_scope_and_args(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Step stamps + caller-bracketed spans (the fleet tracer's span model,
+# docs/TRACE.md: top-level "step" = completed cycles at emit time)
+# ---------------------------------------------------------------------------
+
+def test_step_stamps_when_marking_cycles(tmp_path):
+    f = tmp_path / "tl.json"
+    tl = tl_mod.Timeline(str(f), rank=0, mark_cycles=True)
+    tl.mark_cycle()                       # CYCLE_1
+    tl.instant("evt", category="wire")    # fired during step 2
+    tok = tl.activity_start("grad.w", "ALLREDUCE")
+    tl.mark_cycle()                       # CYCLE_2 — bracket straddles it
+    tl.activity_end(tok)
+    tl.close()
+    events = {e["name"]: e for e in _read_trace(f)}
+    assert events["CYCLE_1"]["step"] == 1
+    assert events["CYCLE_2"]["step"] == 2
+    assert events["evt"]["step"] == 1
+    # The collective is attributed to the step it STARTED in, even
+    # though it ended after the next cycle mark.
+    assert events["ALLREDUCE"]["step"] == 1
+    # Stamps are top-level keys: args stay exactly what callers passed.
+    assert "args" not in events["evt"]
+
+
+def test_no_step_stamps_without_mark_cycles(tmp_path):
+    f = tmp_path / "tl.json"
+    tl = tl_mod.Timeline(str(f), rank=0, mark_cycles=False)
+    tl.instant("evt")
+    tok = tl.activity_start("t", "ALLGATHER")
+    tl.activity_end(tok)
+    tl.close()
+    for e in _read_trace(f):
+        assert "step" not in e
+
+
+def test_complete_span_from_caller_start(tmp_path):
+    f = tmp_path / "tl.json"
+    tl = tl_mod.Timeline(str(f), rank=3, mark_cycles=True)
+    start = tl.now_us()
+    time.sleep(0.002)
+    tl.mark_cycle()
+    tl.complete("step", category="step", start_us=start)
+    tl.close()
+    span = [e for e in _read_trace(f) if e["name"] == "step"][0]
+    assert span["ph"] == "X"
+    assert span["pid"] == 3 and span["tid"] == "step"
+    assert span["ts"] == round(start, 1)
+    assert span["dur"] >= 2000  # at least the 2 ms we slept
+    assert span["step"] == 1    # emitted after the cycle mark
+
+
+def test_current_cycle_property(tmp_path):
+    tl = tl_mod.Timeline(str(tmp_path / "tl.json"), rank=0,
+                         mark_cycles=True)
+    assert tl.current_cycle == 0
+    tl.mark_cycle()
+    tl.mark_cycle()
+    assert tl.current_cycle == 2
+    tl.close()
+
+
+# ---------------------------------------------------------------------------
 # Writer selection / fallback
 # ---------------------------------------------------------------------------
 

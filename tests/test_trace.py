@@ -1,0 +1,368 @@
+"""Fleet tracer (horovod_tpu/trace): clock alignment, cross-rank merge
+with flow events, critical-path / straggler attribution, the
+TraceMeasurements feedback loop, the CLI, and the fleet-view rendering
+of the trace gauges (docs/TRACE.md).
+
+The synthetic two-rank fixture is hand-computed: rank 1's wall clock
+runs 500 ms ahead and it straggles into step 2 by 0.4 ms, so every
+expected number below is derivable with pencil and paper from the
+formulas in trace/core.py's docstring.
+"""
+
+import json
+import time
+
+import pytest
+
+from horovod_tpu.metrics import catalog as met_catalog
+from horovod_tpu.metrics import fleet
+from horovod_tpu.trace import (TraceMeasurements, analyze, clock_offsets,
+                               load_events, load_rank_traces, merge,
+                               write_merged)
+from horovod_tpu.trace.__main__ import main as trace_cli
+
+OFFSET_US = 500000.0  # rank 1's clock runs 500 ms ahead of rank 0's
+
+
+def _cycle(n, ts, rank):
+    return {"name": f"CYCLE_{n}", "cat": "cycle", "ph": "i", "s": "p",
+            "ts": ts, "pid": rank, "tid": "cycle", "step": n}
+
+
+def _coll(ts, dur, rank, step, name="allreduce.b0"):
+    return {"name": name, "cat": "collective", "ph": "X", "ts": ts,
+            "dur": dur, "pid": rank, "tid": "grad.w", "step": step}
+
+
+def _fixture():
+    """Two ranks, three cycles.  Aligned-clock story (us, rank0 frame):
+
+      rank0: CYCLE_1@1000  coll[1200..1900]   CYCLE_2@2400
+             coll[2500..3100]                 CYCLE_3@3500
+      rank1: CYCLE_1@1000  coll[1600..2350]   CYCLE_2@2800
+             coll[2500..3100]                 CYCLE_3@3500
+
+    Collectives are stamped with the COMPLETED cycle count at issue
+    (step n-1 for a step-n collective), so both carry step=1 / step=2.
+    Rank 1's raw timestamps are all shifted by +OFFSET_US.
+    """
+    r0 = [
+        _cycle(1, 1000.0, 0),
+        _coll(1200.0, 700.0, 0, step=1),
+        _cycle(2, 2400.0, 0),
+        _coll(2500.0, 600.0, 0, step=2),
+        _cycle(3, 3500.0, 0),
+    ]
+    r1 = [
+        _cycle(1, 1000.0 + OFFSET_US, 1),
+        _coll(1600.0 + OFFSET_US, 750.0, 1, step=1),
+        _cycle(2, 2800.0 + OFFSET_US, 1),
+        _coll(2500.0 + OFFSET_US, 600.0, 1, step=2),
+        _cycle(3, 3500.0 + OFFSET_US, 1),
+    ]
+    return {0: r0, 1: r1}
+
+
+# ---------------------------------------------------------------------------
+# Clock alignment
+# ---------------------------------------------------------------------------
+
+def test_clock_offsets_median_recovers_skewed_clock():
+    # Per-cycle deltas are 500000 / 500400 / 500000 us; the median kills
+    # the one skewed step, recovering the true offset exactly.
+    assert clock_offsets(_fixture()) == {0: 0.0, 1: OFFSET_US}
+
+
+def test_clock_offsets_wall_mode_trusts_raw_clocks():
+    assert clock_offsets(_fixture(), align="wall") == {0: 0.0, 1: 0.0}
+
+
+# ---------------------------------------------------------------------------
+# Attribution (hand-computed expectations)
+# ---------------------------------------------------------------------------
+
+def test_analyze_per_step_attribution():
+    report = analyze(_fixture(), align="cycle")
+    assert report["clock_offsets_us"] == {"0": 0.0, "1": OFFSET_US}
+    by_step = {s["step"]: s for s in report["steps"]}
+    assert sorted(by_step) == [1, 2, 3]
+
+    # Step 1: both ranks arrive together; no step-0 marker, so no
+    # critical path; its collectives belong to step 2's window.
+    s1 = by_step[1]
+    assert s1["skew_ms"] == 0.0
+    assert s1["straggler_rank"] is None
+    assert s1["critical_path_ms"] is None
+    assert s1["buckets"] == []
+
+    # Step 2: rank 1 is 0.4 ms late to the barrier (2800 vs 2400) and
+    # 0.4 ms late into the collective (1600 vs 1200).
+    s2 = by_step[2]
+    assert s2["skew_ms"] == 0.4
+    assert s2["straggler_rank"] == 1
+    assert s2["critical_path_ms"] == 1.8   # 2800 - 1000
+    assert s2["wait_ms"] == 0.4            # 1600 - 1200
+    assert s2["wire_ms"] == 0.75           # 2350 - 1600
+    assert s2["compute_ms"] == 0.65        # 1.8 - 0.4 - 0.75
+    (b,) = s2["buckets"]
+    assert b["name"] == "allreduce.b0" and b["tid"] == "grad.w"
+    assert b["ranks"] == 2 and b["blamed_rank"] == 1
+    assert b["wait_ms"] == 0.4 and b["wire_ms"] == 0.75
+
+    # Step 3: perfectly converged step.
+    s3 = by_step[3]
+    assert s3["skew_ms"] == 0.0
+    assert s3["straggler_rank"] is None
+    assert s3["critical_path_ms"] == 1.1   # 3500 - 2400
+    assert s3["wait_ms"] == 0.0
+    assert s3["wire_ms"] == 0.6            # 3100 - 2500
+    assert s3["compute_ms"] == 0.5
+
+    summary = report["summary"]
+    assert summary["ranks"] == [0, 1]
+    assert summary["steps_analyzed"] == 3
+    assert summary["step_skew_ms_median"] == 0.0
+    assert summary["step_skew_ms_max"] == 0.4
+    assert summary["critical_path_ms_median"] == 1.45
+    assert summary["straggler_rank"] == 1
+    # cp total 2.9 ms, wait total 0.4, wire total 1.35.
+    assert summary["skew_share"] == pytest.approx(0.4 / 2.9, abs=1e-4)
+    assert summary["wire_share"] == pytest.approx(1.35 / 2.9, abs=1e-4)
+    assert summary["collective_share_measured"] == pytest.approx(
+        1.75 / 2.9, abs=1e-4)
+
+
+def test_analyze_wall_alignment_sees_the_clock_skew():
+    # Without barrier alignment the 500 ms clock offset masquerades as
+    # per-step skew — the reason `cycle` is the default.
+    report = analyze(_fixture(), align="wall")
+    assert report["summary"]["step_skew_ms_max"] >= OFFSET_US / 1e3
+
+
+def test_analyze_single_rank_degrades_gracefully():
+    traces = {0: _fixture()[0]}
+    report = analyze(traces)
+    s2 = next(s for s in report["steps"] if s["step"] == 2)
+    assert s2["skew_ms"] == 0.0
+    # One-rank collectives: no wait attribution, duration counts as wire.
+    assert s2["wait_ms"] == 0.0 and s2["wire_ms"] == 0.7
+    assert s2["buckets"][0]["blamed_rank"] is None
+    assert report["summary"]["straggler_rank"] == -1
+
+
+# ---------------------------------------------------------------------------
+# Merge: one Perfetto trace, flow events, metadata
+# ---------------------------------------------------------------------------
+
+def test_merge_aligns_and_links_ranks():
+    merged = merge(_fixture(), align="cycle", flow=True)
+    md = merged["metadata"]
+    assert md["ranks"] == [0, 1]
+    assert md["align"] == "cycle"
+    assert md["clock_offsets_us"] == {"0": 0.0, "1": OFFSET_US}
+
+    events = merged["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {(e["name"], e["pid"]) for e in meta} >= {
+        ("process_name", 0), ("process_name", 1)}
+
+    # Rank 1's events land on rank 0's clock after alignment.
+    r1_cycles = {e["name"]: e["ts"] for e in events
+                 if e["ph"] == "i" and e["pid"] == 1}
+    assert r1_cycles["CYCLE_1"] == 1000.0
+    assert r1_cycles["CYCLE_2"] == 2800.0
+
+    # Five cross-rank groups (3 cycles + 2 stepped collectives), each an
+    # s->f pair binding both ranks.
+    starts = [e for e in events if e["ph"] == "s"]
+    finishes = [e for e in events if e["ph"] == "f"]
+    assert len(starts) == len(finishes) == 5 == md["flow_events"] // 2
+    assert all(e["cat"] == "xrank" for e in starts + finishes)
+    assert all(e.get("bp") == "e" for e in finishes)
+    assert sorted(e["id"] for e in starts) == sorted(
+        e["id"] for e in finishes)
+    # The step-2 collective flow starts at the first-arriving rank (0)
+    # and finishes at the straggler (1), bound mid-slice.
+    coll_flows = sorted((e for e in starts + finishes
+                         if "allreduce.b0" in e["name"] and e["pid"] == 1),
+                        key=lambda e: e["ts"])
+    assert coll_flows[0]["ph"] == "f"
+
+
+def test_merge_without_flow_events():
+    merged = merge(_fixture(), align="cycle", flow=False)
+    assert merged["metadata"]["flow_events"] == 0
+    assert not [e for e in merged["traceEvents"] if e["ph"] in "stf"]
+
+
+def test_merged_file_is_valid_perfetto_json(tmp_path):
+    out = tmp_path / "fleet_trace.json"
+    write_merged(merge(_fixture(), align="cycle", flow=True), str(out))
+    doc = json.loads(out.read_text())
+    assert isinstance(doc["traceEvents"], list)
+    assert doc["metadata"]["ranks"] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# Loaders
+# ---------------------------------------------------------------------------
+
+def _write_rank_files(tmp_path, traces=None):
+    paths = []
+    for r, events in sorted((traces or _fixture()).items()):
+        p = tmp_path / f"tl.rank{r}.json"
+        p.write_text(json.dumps(events))
+        paths.append(str(p))
+    return paths
+
+
+def test_load_events_tolerates_truncated_writer_output(tmp_path):
+    # The writer's crash-safe array format: no closing bracket, trailing
+    # comma (chrome://tracing accepts it; so must we).
+    p = tmp_path / "t.rank0.json"
+    body = json.dumps(_fixture()[0])[1:-1]
+    p.write_text("[" + body + ",")
+    assert len(load_events(str(p))) == len(_fixture()[0])
+
+
+def test_load_events_accepts_object_form(tmp_path):
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps({"traceEvents": _fixture()[0]}))
+    assert len(load_events(str(p))) == len(_fixture()[0])
+
+
+def test_rank_falls_back_to_filename(tmp_path):
+    p = tmp_path / "t.rank3.json"
+    p.write_text(json.dumps([{"name": "CYCLE_1", "ph": "i", "ts": 1.0}]))
+    assert sorted(load_rank_traces([str(p)])) == [3]
+
+
+def test_duplicate_rank_rejected(tmp_path):
+    paths = _write_rank_files(tmp_path)
+    with pytest.raises(ValueError, match="already loaded"):
+        load_rank_traces([paths[0], paths[0]])
+
+
+# ---------------------------------------------------------------------------
+# CLI (python -m horovod_tpu.trace)
+# ---------------------------------------------------------------------------
+
+def test_cli_merge_and_analyze(tmp_path, capsys):
+    paths = _write_rank_files(tmp_path)
+    out = tmp_path / "fleet_trace.json"
+    assert trace_cli(["merge", *paths, "-o", str(out)]) == 0
+    assert "ranks [0, 1]" in capsys.readouterr().out
+    doc = json.loads(out.read_text())
+    assert doc["metadata"]["flow_events"] == 10
+
+    rep_path = tmp_path / "report.json"
+    assert trace_cli(["analyze", *paths, "-o", str(rep_path)]) == 0
+    printed = json.loads(capsys.readouterr().out)
+    assert printed == json.loads(rep_path.read_text())
+    assert printed["summary"]["straggler_rank"] == 1
+
+
+# ---------------------------------------------------------------------------
+# TraceMeasurements: report -> metrics / autotune
+# ---------------------------------------------------------------------------
+
+def test_trace_measurements_from_report():
+    tm = TraceMeasurements.from_report(analyze(_fixture()))
+    assert tm.critical_path_ms == 1.45
+    assert tm.step_skew_ms == 0.0          # median over [0, 0.4, 0]
+    assert tm.straggler_rank == 1
+    assert tm.collective_share_measured == pytest.approx(1.75 / 2.9,
+                                                         abs=1e-4)
+    # Per-bucket wait+wire: 1.15 ms (step 2) and 0.6 ms (step 3).
+    assert tm.bucket_ms == {"allreduce.b0/grad.w": 0.875}
+
+
+def test_trace_measurements_apply_to_metrics():
+    tm = TraceMeasurements.from_report(analyze(_fixture()))
+    met_catalog.set_enabled(True)
+    try:
+        assert tm.apply_to_metrics()
+    finally:
+        pass
+    assert met_catalog.critical_path_ms.labels().get() == 1.45
+    assert met_catalog.step_skew_ms.labels().get() == 0.0
+    assert met_catalog.straggler_rank.labels().get() == 1
+
+    met_catalog.set_enabled(False)
+    try:
+        assert not tm.apply_to_metrics()
+    finally:
+        met_catalog.set_enabled(True)
+
+
+def test_trace_measurements_feed_autotune():
+    class FakePM:
+        def record_trace(self, step_ms, items_per_step=1.0, bucket_ms=None):
+            self.call = (step_ms, items_per_step, bucket_ms)
+
+    tm = TraceMeasurements.from_report(analyze(_fixture()))
+    pm = FakePM()
+    assert tm.feed_autotune(pm=pm, items_per_step=32.0)
+    assert pm.call == (1.45, 32.0, {"allreduce.b0/grad.w": 0.875})
+    # Nothing to feed -> refuse rather than inject a zero-rate sample.
+    assert not TraceMeasurements().feed_autotune(pm=pm)
+
+
+def test_autotune_record_trace_converts_to_rate(tmp_path):
+    from horovod_tpu.utils.autotune import ParameterManager
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(warmup_samples=0, log_file=str(log))
+    pm.register("fusion_threshold", 1 << 20, 256 << 20, log_scale=True)
+    pm.record_trace(2.0, items_per_step=4.0,
+                    bucket_ms={"b/t": 0.5, "a/t": 0.25})
+    text = log.read_text()
+    # 4 items / 2 ms -> 2000 items/s scored as a regular sample, with
+    # the per-bucket timings logged for audit.
+    assert ",sample,2000.000," in text
+    assert "trace_buckets,a/t=0.250;b/t=0.500" in text
+    pm.record_trace(0.0)  # ignored, not a divide-by-zero
+    assert log.read_text().count(",sample,") == 1
+
+
+# ---------------------------------------------------------------------------
+# Fleet view rendering of the trace gauges
+# ---------------------------------------------------------------------------
+
+def _gauge_sample(value):
+    return {"kind": "gauge", "labelnames": [], "samples": [[[], value]]}
+
+
+def _snap(rank, metrics):
+    return {"rank": rank, "ts": time.time(), "metrics": metrics}
+
+
+def test_render_fleet_trace_section():
+    snaps = [
+        _snap(0, {"hvd_critical_path_ms": _gauge_sample(1.45),
+                  "hvd_step_skew_ms": _gauge_sample(0.4),
+                  "hvd_straggler_rank": _gauge_sample(1),
+                  "hvd_stall_laggards": _gauge_sample(1)}),
+        _snap(1, {"hvd_critical_path_ms": _gauge_sample(1.5)}),
+    ]
+    text = fleet.render_fleet(snaps)
+    assert "step critical path (ms): rank0=1.4  rank1=1.5" in text
+    assert "step barrier skew (ms): rank0=0.4" in text
+    assert "blamed straggler (rank 0's analysis): rank 1" in text
+    assert "stall laggards (last warning): rank0=1" in text
+
+
+def test_render_fleet_can_blame_rank_zero():
+    # A straggler gauge of 0 means "rank 0 is to blame", not "unset" —
+    # the skew gauge on the same rank disambiguates.
+    snaps = [_snap(1, {"hvd_step_skew_ms": _gauge_sample(0.2),
+                       "hvd_straggler_rank": _gauge_sample(0)})]
+    assert "blamed straggler (rank 1's analysis): rank 0" in (
+        fleet.render_fleet(snaps))
+
+
+def test_render_fleet_without_trace_gauges_has_no_section():
+    snaps = [_snap(0, {"hvd_steps_total": {
+        "kind": "counter", "labelnames": [], "samples": [[[], 3]]}})]
+    text = fleet.render_fleet(snaps)
+    assert "critical path" not in text
+    assert "straggler" not in text
