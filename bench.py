@@ -57,6 +57,14 @@ PROBE_RETRIES = 2
 # bound).
 PROBE_WINDOW = float(os.environ.get("HOROVOD_BENCH_PROBE_WINDOW", "900"))
 
+# Freshness window for reusing the cached on-chip record when the
+# accelerator is unreachable: within it the reuse is a quiet note;
+# beyond it the record is marked stale=True with a loud warning
+# (instead of the old unconditional "(28.7 h old)" banner on every
+# run silently reusing an arbitrarily old record).
+CACHE_MAX_AGE_H = float(
+    os.environ.get("HOROVOD_BENCH_CACHE_MAX_AGE_H", "24"))
+
 # Last-known-good ON-CHIP results, refreshed every time the bench runs
 # live on the accelerator.  Committed so a wedged-chip round still
 # carries an on-chip record (provenance-marked).
@@ -357,6 +365,76 @@ def run_sim_child(n_devices: int, distributed: bool = True) -> None:
         hvd.stop_timeline()
         record["trace_steps"] = trace_iters
     print(json.dumps(record))
+
+
+def run_zero_bytes_child(n_devices: int) -> None:
+    """Child mode: ZeRO ladder memory accounting on an n-device virtual
+    CPU mesh — per-chip resident bytes of the gradient accumulator
+    (stage 1 vs stage 2, backward_passes_per_step=2) and of the
+    parameters (replicated vs stage-3 at-rest shards).  Prints one JSON
+    line (docs/SHARDED_OPTIMIZER.md memory model)."""
+    from horovod_tpu.common.util import force_cpu_platform
+    force_cpu_platform(n_devices)
+    import jax
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import resnet_init
+
+    hvd.init()
+    assert hvd.size() == n_devices
+    params = resnet_init(jax.random.PRNGKey(0), 18, num_classes=100)
+    base = optax.sgd(0.01, momentum=0.9)
+    o1 = hvd.DistributedOptimizer(base, backward_passes_per_step=2,
+                                  early_reduction=True, zero_stage=1)
+    o2 = hvd.DistributedOptimizer(base, backward_passes_per_step=2,
+                                  zero_stage=2)
+    s1 = o1.init(params)
+    s2 = o2.init(params)
+    g1 = hvd.grad_accum_bytes(s1)
+    g2 = hvd.grad_accum_bytes(s2)
+    pl = hvd.zero3_placement(params)
+    emit({
+        "n": n_devices,
+        "grad_accum_bytes_stage1": g1,
+        "grad_accum_bytes_stage2": g2,
+        "grad_accum_reduction": round(g1 / max(1, g2), 4),
+        "param_bytes_replicated": pl.full_bytes,
+        "param_bytes_resident_stage3": pl.resident_bytes(),
+        "param_resident_reduction": round(
+            pl.full_bytes / max(1, pl.resident_bytes()), 4),
+        "opt_state_bytes_stage1": hvd.optimizer_state_bytes(s1),
+    })
+
+
+def zero_memory_report(timeout: float = 600.0) -> dict:
+    """ZeRO ladder memory pipeline: the gradient-accumulator claim at
+    n=2 (stage 2 halves it exactly with backward_passes_per_step >= 2)
+    and the parameter-residency claim at n=8 (stage 3 keeps ~1/N
+    resident outside the live bucket window), each measured in a child
+    process on its own virtual mesh."""
+    out = {}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for n in (2, 8):
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--zero-bytes-child", str(n)],
+            capture_output=True, text=True, timeout=timeout, env=env)
+        if r.returncode != 0:
+            log(f"zero-bytes child n={n} rc={r.returncode} "
+                f"stderr tail: {r.stderr[-1000:]}")
+            continue
+        rec = json.loads(r.stdout.strip().splitlines()[-1])
+        out[f"n{n}"] = rec
+        log(f"zero bytes n={n}: grad accum "
+            f"{rec['grad_accum_bytes_stage1']} -> "
+            f"{rec['grad_accum_bytes_stage2']} "
+            f"({rec['grad_accum_reduction']}x, stage 2); params "
+            f"{rec['param_bytes_replicated']} -> "
+            f"{rec['param_bytes_resident_stage3']} resident "
+            f"({rec['param_resident_reduction']}x, stage 3)")
+    return out
 
 
 def _load_trace_core():
@@ -993,9 +1071,19 @@ def main():
             age_h = (time.time() - cached.get(
                 "captured_unix", time.time())) / 3600.0
             result["stale_hours"] = round(age_h, 1)
-            log(f"accelerator unreachable: emitting last-known-good "
-                f"on-chip record from {cached.get('captured_utc')} "
-                f"({age_h:.1f} h old)")
+            if age_h > CACHE_MAX_AGE_H:
+                result["stale"] = True
+                log(f"WARNING: cached on-chip record is STALE "
+                    f"({age_h:.1f} h old > "
+                    f"HOROVOD_BENCH_CACHE_MAX_AGE_H="
+                    f"{CACHE_MAX_AGE_H:g} h); captured "
+                    f"{cached.get('captured_utc')} — re-run on the "
+                    "accelerator to refresh")
+            else:
+                log(f"accelerator unreachable: reusing on-chip record "
+                    f"from {cached.get('captured_utc')} ({age_h:.1f} h "
+                    f"old, within the {CACHE_MAX_AGE_H:g} h freshness "
+                    "window)")
             result["live_cpu_img_sec_per_chip"] = live_cpu.get("value")
         else:
             result["provenance"] = "live"
@@ -1027,11 +1115,22 @@ def main():
             # (default) and the legacy barriered pipeline (before/after).
             result["sim8_collective_share"] = extras
 
+    # ZeRO ladder memory accounting (chip-independent, analytic).
+    try:
+        zb = zero_memory_report()
+    except Exception as e:  # noqa: BLE001
+        log(f"zero bytes report failed: {type(e).__name__}: {e}")
+        zb = None
+    if zb:
+        result["zero_bytes"] = zb
+
     emit(result)
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 3 and sys.argv[1] == "--bench-child":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--zero-bytes-child":
+        run_zero_bytes_child(int(sys.argv[2]))
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--bench-child":
         emit(run_bench(sys.argv[2]))
     else:
         main()

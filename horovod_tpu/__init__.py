@@ -136,8 +136,14 @@ from .ops.functions import (  # noqa: F401
 from .parallel.optimizer import (  # noqa: F401
     DistributedOptimizer,
     DistributedGradientTransformation,
+    grad_accum_bytes,
     optimizer_state_bytes,
     sharded_state_specs,
+)
+
+from .parallel.zero3 import (  # noqa: F401
+    ZeroParamPlacement,
+    zero3_placement,
 )
 
 from .parallel.data_parallel import (  # noqa: F401

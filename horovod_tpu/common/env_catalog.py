@@ -299,6 +299,14 @@ CATALOG: Tuple[EnvVar, ...] = (
        "Low-precision wire of the sharded param allgather: any "
        "registered codec (fp32 masters stay exact on the owner).",
        "SHARDED_OPTIMIZER.md"),
+    _v("HOROVOD_ZERO_STAGE", "0 (1 if HOROVOD_SHARD_OPTIMIZER)", "ops",
+       "ZeRO ladder rung 0..3: 1 shards optimizer state, 2 adds "
+       "gradient-sharded accumulation, 3 adds parameter sharding via "
+       "zero3_placement (autotunable).", "SHARDED_OPTIMIZER.md"),
+    _v("HOROVOD_ZERO_GATHER_WIRE", "(exact)", "ops",
+       "Wire format of the ZeRO-3 just-in-time param bucket allgather: "
+       "any registered codec (shards at rest stay exact).",
+       "SHARDED_OPTIMIZER.md"),
     _v("HOROVOD_COLLECTIVE_CONSISTENCY_CHECK", "0", "ops",
        "1 enables the cross-rank shape/dtype/generation consistency "
        "guard around collectives.", "FAULT_TOLERANCE.md"),
@@ -364,6 +372,10 @@ CATALOG: Tuple[EnvVar, ...] = (
        "BENCHMARKS.md"),
     _v("HOROVOD_BENCH_XLA_FLAGS", "(unset)", "bench",
        "Extra XLA_FLAGS appended for bench.py child processes.",
+       "BENCHMARKS.md"),
+    _v("HOROVOD_BENCH_CACHE_MAX_AGE_H", "24", "bench",
+       "Hours before bench.py's cached last-known-good on-chip record "
+       "is reported as stale instead of silently reused.",
        "BENCHMARKS.md"),
 )
 

@@ -97,6 +97,16 @@ param_ag_bytes = _REG.gauge(
     "hvd_param_ag_bytes",
     "Static bytes entering the sharded-optimizer param allgather per "
     "step, at wire width (trace time; multiply by hvd_steps_total).")
+grad_shard_bytes = _REG.gauge(
+    "hvd_grad_shard_bytes",
+    "Per-chip resident gradient-accumulator bytes across the "
+    "backward_passes_per_step window (recorded at init; ZeRO-2 counts "
+    "its 1/N shard — the stage-2 denominator).")
+param_resident_bytes = _REG.gauge(
+    "hvd_param_resident_bytes",
+    "Per-chip resident parameter bytes outside the live bucket window "
+    "under ZeRO-3 (zero3_placement; recorded at trace time — the full "
+    "replicated bytes are the numerator, see docs/SHARDED_OPTIMIZER.md).")
 fused_steps = _REG.counter(
     "hvd_fused_steps",
     "Compiled steps executed with the fused computation-collective "

@@ -147,6 +147,31 @@ def gradient_bucket_partition(
             _buckets_by_nbytes(nbytes, _cap(nbytes), bucket_order) if b]
 
 
+def shard_group_partition(
+    leaves: Sequence[Any],
+    compression=Compression.none,
+    fusion_threshold_bytes: Optional[int] = None,
+    bucket_order=None,
+) -> list:
+    """The ZeRO shard-group partition: the reduction buckets of
+    `gradient_bucket_partition` split further by dtype (a flat shard
+    buffer cannot mix dtypes).  Shared by
+    `DistributedOptimizer(shard_optimizer_states=True)` state init /
+    update AND the stage-3 `zero3_placement` so gradient shards,
+    optimizer-state rows, and parameter rows all cover the same
+    groups and can never diverge bit-for-bit."""
+    groups = []
+    for idxs in gradient_bucket_partition(
+            leaves, compression=compression,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            bucket_order=bucket_order):
+        by_dt = {}
+        for i in idxs:
+            by_dt.setdefault(jnp.result_type(leaves[i]), []).append(i)
+        groups.extend(by_dt.values())
+    return groups
+
+
 def active_wire_policy(compression=Compression.none,
                        process_set: Optional[ProcessSet] = None):
     """The per-bucket wire policy the gradient reduction will apply, or
@@ -817,9 +842,19 @@ def data_parallel(
         # the traced program, so a flip between steps must retrace (the
         # knob-tuned values ride pm.values() below; these cover the
         # env-only case with no tuner attached).
+        # The wire error-feedback generation joins the key so a
+        # reset_error_feedback() (elastic reset, guard rollback) forces
+        # a retrace: the sharded-optimizer EF path bakes the generation
+        # it saw at trace time and zeroes any residual stamped with an
+        # older one — without the retrace the stale residual would
+        # bleed its pre-recovery correction into the first new step.
+        # Generation 0 maps to None so the no-envs fast path survives.
         env_part = (wire_spec, util.getenv("WIRE_BIG_FORMAT"),
                     util.getenv("FUSED_COLLECTIVES"),
-                    util.getenv("FUSED_CHUNK_BYTES"))
+                    util.getenv("FUSED_CHUNK_BYTES"),
+                    util.getenv("ZERO_STAGE"),
+                    util.getenv("ZERO_GATHER_WIRE"),
+                    _wire.error_feedback_generation() or None)
         pm = _at.get_manager()
         if pm is None:
             return env_part if any(env_part) else None

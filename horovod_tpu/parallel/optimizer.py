@@ -31,10 +31,13 @@ from ..metrics import catalog as _met
 from ..ops import collectives as C
 from ..ops import wire as _wire
 from ..ops.compression import Compression, _CooperativeCompressor
-from ..ops.quantized import quantized_allgather_shard
+from ..ops.quantized import (quantized_allgather_shard,
+                             quantized_reducescatter_shard)
 from . import hierarchical as _hier
-from .data_parallel import (allreduce_gradients, gradient_bucket_partition,
-                            reduce_gradient_buckets)
+from .data_parallel import (active_wire_policy, allreduce_gradients,
+                            gradient_bucket_partition,
+                            reduce_gradient_buckets,
+                            shard_group_partition)
 
 # Wire formats whose scatter/gather collectives reduce in the wire dtype
 # directly — derived from the ops/wire.py registry, not restated here.
@@ -48,10 +51,40 @@ SHARD_WIRES = _wire.cast_wire_names()
 class DistributedOptState(NamedTuple):
     inner: Any          # inner optax state; per-bucket/-shard tuple when
     #                     fused_apply / shard_optimizer_states
-    accum: Any          # local gradient accumulator
+    accum: Any          # local gradient accumulator; a `_ZeroAccum` of
+    #                     per-group shard rows under zero_stage >= 2
     counter: jnp.ndarray  # passes since last sync
     guard: Any = None   # guard.GuardState when guard= is on (loss scale,
     #                     skip counters, per-bucket sentinel flags)
+    wire_ef: Any = None  # `_WireEF` sender-side reduce-scatter error-
+    #                     feedback residuals (HOROVOD_WIRE_POLICY with a
+    #                     cooperative big codec on the sharded path)
+
+
+class _ZeroAccum(NamedTuple):
+    """ZeRO-2 gradient accumulator: one (n_ranks, shard) array per shard
+    group, stacked over the rank axis exactly like `_ShardSlot.state` —
+    each micro-batch's buckets are reduce-SCATTERED and only the 1/N
+    shard accumulates, so the accumulator is N-fold smaller than the
+    params-shaped ZeRO-1 accumulator once placed with
+    `sharded_state_specs` (compat mode restacks via all_gather)."""
+    rows: Any
+
+
+class _WireEF(NamedTuple):
+    """Per-shard-group sender-side error-feedback residuals of the
+    wire-policy quantized reduce-scatter: `rows[g]` is (n_ranks, padded)
+    f32 (None for groups the policy keeps exact/cast), row r being rank
+    r's residual over the WHOLE group buffer — sender-side EF captures
+    the encode error of our contributions to every peer's segment, so
+    the residual is group-sized, not shard-sized.  `gen` is the
+    ops/wire.py EF generation stamped at the last update: a
+    `reset_error_feedback()` (elastic reset / guard rollback) bumps the
+    live generation, the step retraces (it is part of
+    data_parallel._autotune_key), and the stale-stamped residual is
+    zeroed before use."""
+    rows: Any
+    gen: Any
 
 
 class _ShardSlot(NamedTuple):
@@ -88,20 +121,55 @@ def optimizer_state_bytes(state) -> int:
     return int(total)
 
 
+def grad_accum_bytes(state) -> int:
+    """Per-chip resident bytes of the gradient accumulator (the ZeRO-2
+    denominator).  A `zero_stage >= 2` accumulator's stacked
+    (n_ranks, shard) rows count at 1/n_ranks — each rank materializes
+    only its own row once placed with `sharded_state_specs` — while the
+    ZeRO-1/replicated params-shaped accumulator counts in full, so the
+    stage-1-vs-2 per-chip footprints compare directly."""
+    accum = getattr(state, "accum", state)
+    total = 0
+    if isinstance(accum, _ZeroAccum):
+        for leaf in accum.rows:
+            leaf = jnp.asarray(leaf)
+            lead = leaf.shape[0] if leaf.ndim else 1
+            total += leaf.size * leaf.dtype.itemsize // max(1, lead)
+        return int(total)
+    for leaf in jax.tree_util.tree_leaves(accum):
+        leaf = jnp.asarray(leaf)
+        total += leaf.size * leaf.dtype.itemsize
+    return int(total)
+
+
 def sharded_state_specs(state: DistributedOptState, axis_name=GLOBAL_AXIS):
     """PartitionSpec pytree for a `shard_optimizer_states=True` state:
-    P(axis) on every stacked (n_ranks, ...) inner/master leaf, replicated
-    accumulator/counter.  Feed to `data_parallel(arg_specs={i: specs},
+    P(axis) on every stacked (n_ranks, ...) inner/master leaf — and on
+    the ZeRO-2 accumulator rows and wire error-feedback rows, which
+    stack over the rank axis the same way — replicated counter/guard.
+    Feed to `data_parallel(arg_specs={i: specs},
     out_specs=(..., specs, ...))` so each rank materializes only its own
-    state row (true ZeRO-1 placement).  Without it the stacked state
+    state row (true ZeRO placement).  Without it the stacked state
     stays replicated — numerics identical, HBM savings deferred."""
     axis = tuple(axis_name) if isinstance(axis_name, (tuple, list)) \
         else axis_name
     inner = jax.tree_util.tree_map(
         lambda _: PartitionSpec(axis), state.inner)
-    accum = jax.tree_util.tree_map(lambda _: PartitionSpec(), state.accum)
+    if isinstance(state.accum, _ZeroAccum):
+        accum = jax.tree_util.tree_map(
+            lambda _: PartitionSpec(axis), state.accum)
+    else:
+        accum = jax.tree_util.tree_map(
+            lambda _: PartitionSpec(), state.accum)
     guard = jax.tree_util.tree_map(lambda _: PartitionSpec(), state.guard)
-    return DistributedOptState(inner, accum, PartitionSpec(), guard)
+    wire_ef = None
+    if isinstance(state.wire_ef, _WireEF):
+        wire_ef = _WireEF(
+            tuple(None if r is None else PartitionSpec(axis)
+                  for r in state.wire_ef.rows),
+            PartitionSpec())
+    return DistributedOptState(inner, accum, PartitionSpec(), guard,
+                               wire_ef)
 
 
 def DistributedGradientTransformation(
@@ -119,6 +187,7 @@ def DistributedGradientTransformation(
     shard_optimizer_states: Optional[bool] = None,
     allgather_wire: Optional[str] = None,
     guard: Any = None,
+    zero_stage: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap `optimizer` so updates are computed from cross-rank-reduced
     gradients.  See module docstring for the reference mapping.
@@ -170,6 +239,35 @@ def DistributedGradientTransformation(
     ring spans one named axis, so a 2-tuple hierarchical axis needs a
     cast wire).
 
+    `zero_stage` (env: HOROVOD_ZERO_STAGE, autotunable) picks the ZeRO
+    ladder rung.  0 = replicated; 1 = `shard_optimizer_states` (the two
+    spellings are aliases — either implies the other).  2 adds
+    gradient-sharded accumulation: with `backward_passes_per_step` > 1
+    every micro-batch's buckets are reduce-SCATTERED immediately (the
+    early-reduction schedule, which stage 2 therefore implies) and only
+    the local 1/N shard accumulates — `DistributedOptState.accum`
+    becomes per-group (n_ranks, shard) rows that `sharded_state_specs`
+    places at 1/N, shrinking the accumulator N-fold
+    (`hvd_grad_shard_bytes`).  3 is stage 2 plus parameters sharded at
+    rest via the companion `zero3_placement` (parallel/zero3.py): the
+    optimizer data path is identical to stage 2, while the placement
+    object gathers each param bucket just-in-time in reverse-
+    availability prefetch order.  Stages 2/3 inherit every stage-1
+    contract: in-jit only, loud re-init on partition drift, dual
+    compat/placed state, global process set, no Adasum.
+
+    On the sharded reduce-scatter, `HOROVOD_WIRE_POLICY` (docs/WIRE.md)
+    now engages exactly like the replicated reduction when
+    `compression=` is none and the process set is global: per shard
+    group the policy picks exact/cast/cooperative wire, and cooperative
+    groups carry a SENDER-SIDE error-feedback residual
+    (`DistributedOptState.wire_ef`) through the quantized
+    reduce-scatter so the dropped bits telescope instead of biasing
+    every step.  `wire.reset_error_feedback()` (elastic reset, guard
+    rollback) zeroes the residual at the next trace.  An explicit
+    cooperative `compression=` stays rejected — only the policy path
+    carries the residual.
+
     `guard` (env: HOROVOD_GUARD) arms the training-health guardian
     (docs/GUARD.md): the reduction computes a fused per-bucket
     non-finite sentinel OR-ed across ranks, the incoming gradients are
@@ -204,8 +302,25 @@ def DistributedGradientTransformation(
                 "guard= is incompatible with op=Adasum: Adasum combines "
                 "post-update deltas, so there is no per-bucket "
                 "reduction result for the non-finite sentinel to flag")
+    if zero_stage is None:
+        from ..utils.autotune import current_zero_stage
+        zero_stage = current_zero_stage()
+    zero_stage = int(zero_stage)
+    if zero_stage not in (0, 1, 2, 3):
+        raise ValueError(
+            f"zero_stage must be 0..3, got {zero_stage} (0 replicated, "
+            "1 optimizer-state sharding, 2 + gradient-sharded "
+            "accumulation, 3 + parameter sharding via zero3_placement)")
+    if zero_stage >= 1:
+        if shard_optimizer_states is False:
+            raise ValueError(
+                f"zero_stage={zero_stage} requires the sharded path; "
+                "shard_optimizer_states=False contradicts it")
+        shard_optimizer_states = True
     if shard_optimizer_states is None:
         shard_optimizer_states = util.env_bool("SHARD_OPTIMIZER", False)
+    if shard_optimizer_states and zero_stage == 0:
+        zero_stage = 1
     if allgather_wire is None:
         allgather_wire = util.getenv("SHARD_AG_WIRE") or None
     # Resolve through the unified registry: unknown names raise
@@ -228,9 +343,10 @@ def DistributedGradientTransformation(
                 compression, _CooperativeCompressor):
             raise ValueError(
                 f"Compression.{compression.wire} has no reduce-scatter "
-                "form here (the sharded path carries no error-feedback "
-                "residual, and the lossy ring error would bias every "
-                "step); use Compression.fp16/bf16 with "
+                "form here (only the HOROVOD_WIRE_POLICY path carries "
+                "the sender-side error-feedback residual that keeps "
+                "the lossy ring from biasing every step); use "
+                "Compression.fp16/bf16, or HOROVOD_WIRE_POLICY with "
                 "shard_optimizer_states")
         if (_ag_codec.cooperative
                 and isinstance(axis_name, (tuple, list))
@@ -267,14 +383,51 @@ def DistributedGradientTransformation(
     def _shard_groups(leaves):
         # The reduction buckets split further by dtype (a flat shard
         # buffer cannot mix dtypes).  init and update must agree on this
-        # grouping bit-for-bit, so both call here.
-        groups = []
-        for idxs in _partition(leaves):
-            by_dt = {}
-            for i in idxs:
-                by_dt.setdefault(jnp.result_type(leaves[i]), []).append(i)
-            groups.extend(by_dt.values())
-        return groups
+        # grouping bit-for-bit, so both call the shared partition.
+        return shard_group_partition(
+            leaves, compression=compression,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            bucket_order=bucket_order)
+
+    _hier_axis = (isinstance(axis_name, (tuple, list))
+                  and len(axis_name) == 2)
+
+    def _rs_policy():
+        # The per-bucket wire policy on the sharded reduce-scatter:
+        # same activation rule as the replicated reduction (global
+        # process set, no explicit compression), and flat axis only —
+        # the hierarchical path carries its own DCN wire.
+        if _hier_axis:
+            return None
+        return active_wire_policy(compression, process_set)
+
+    def _group_codec(policy, leaves, idxs):
+        # The wire codec one shard group's reduce-scatter rides under
+        # the policy, or None for the legacy compression path.  Mirrors
+        # wire_policy_plan: raw (pre-wire) bytes and floatness pick the
+        # bucket class.
+        if policy is None:
+            return None
+        dt = jnp.result_type(leaves[idxs[0]])
+        raw = sum(leaves[i].size * jnp.dtype(jnp.result_type(
+            leaves[i])).itemsize for i in idxs)
+        codec = _wire.get_codec(
+            policy.codec_for(raw, jnp.issubdtype(dt, jnp.floating)))
+        return None if codec.exact else codec
+
+    def _fresh_ef(wef):
+        # Zero residuals stamped with an older EF generation than the
+        # live one: reset_error_feedback() bumped it (and forced this
+        # retrace through data_parallel's autotune key), so the carried
+        # correction belongs to pre-recovery gradients.
+        if not isinstance(wef, _WireEF):
+            return wef
+        cur = jnp.asarray(_wire.error_feedback_generation(), jnp.int32)
+        keep = wef.gen == cur
+        rows = tuple(None if r is None else
+                     jnp.where(keep, r, jnp.zeros_like(r))
+                     for r in wef.rows)
+        return _WireEF(rows, cur)
 
     def _world():
         return (process_set.size() if process_set is not None
@@ -310,10 +463,20 @@ def DistributedGradientTransformation(
                 jnp.result_type(g)), tree)
 
     def init_fn(params):
+        wire_ef = None
+        accum = None
         if shard_optimizer_states:
             leaves, _ = jax.tree_util.tree_flatten(params)
             n = _world()
+            # No EF rows when the reduce-scatter never runs: ZeRO-1
+            # early-reduction accumulation applies pre-reduced slices
+            # only (stage 2 scatters every pass instead).
+            _no_rs = (early_reduction and backward_passes_per_step > 1
+                      and zero_stage < 2)
+            policy = None if _no_rs else _rs_policy()
             slots = []
+            ef_rows = []
+            accum_rows = []
             for idxs in _shard_groups(leaves):
                 dt = jnp.result_type(leaves[idxs[0]])
                 flat = _group_flat(leaves, idxs, dt)
@@ -335,7 +498,19 @@ def DistributedGradientTransformation(
                 st = jax.vmap(optimizer.init)(
                     master if allgather_wire else rows)
                 slots.append(_ShardSlot(st, master))
+                codec = _group_codec(policy, leaves, idxs)
+                ef_rows.append(
+                    jnp.zeros((n, flat.size), jnp.float32)
+                    if codec is not None and codec.cooperative else None)
+                accum_rows.append(jnp.zeros_like(rows))
             inner = tuple(slots)
+            if any(r is not None for r in ef_rows):
+                wire_ef = _WireEF(
+                    tuple(ef_rows),
+                    jnp.asarray(_wire.error_feedback_generation(),
+                                jnp.int32))
+            if zero_stage >= 2 and backward_passes_per_step > 1:
+                accum = _ZeroAccum(tuple(accum_rows))
         elif fused_apply:
             leaves, _ = jax.tree_util.tree_flatten(params)
             inner = tuple(
@@ -343,20 +518,25 @@ def DistributedGradientTransformation(
                 for idxs in _partition(leaves))
         else:
             inner = optimizer.init(params)
+        if accum is None:
+            accum = jax.tree_util.tree_map(jnp.zeros_like, params)
         if _met.enabled():
-            # Static byte count (per-chip resident once placed); safe at
-            # trace time — cf. hvd_grad_bytes_per_step.
+            # Static byte counts (per-chip resident once placed); safe
+            # at trace time — cf. hvd_grad_bytes_per_step.
             _met.opt_state_bytes.set(optimizer_state_bytes(
                 DistributedOptState(inner, None, None)))
-        accum = jax.tree_util.tree_map(jnp.zeros_like, params)
+            if backward_passes_per_step > 1:
+                _met.grad_shard_bytes.set(grad_accum_bytes(
+                    DistributedOptState(None, accum, None)))
         guard_state = None
         if scaler is not None:
             g_leaves = jax.tree_util.tree_flatten(params)[0]
             guard_state = scaler.init(len(_guard_parts(g_leaves)))
         return DistributedOptState(inner, accum, jnp.zeros((), jnp.int32),
-                                   guard_state)
+                                   guard_state, wire_ef)
 
-    def _sharded_update(grads, state, params, pre_reduced):
+    def _sharded_update(grads, state, params, pre_reduced,
+                        scattered=None):
         from ..ops import fused_collectives as _fc
         from ..utils.autotune import current_ag_fusion
 
@@ -398,6 +578,13 @@ def DistributedGradientTransformation(
             gather_axes = ax
         rs_codec = _wire.get_codec(_wire.compressor_wire(compression))
         rs_wire = None if rs_codec.exact else rs_codec.name
+        # The per-bucket wire policy only engages when the reduce-
+        # scatter actually runs here: pre-reduced and pre-scattered
+        # gradients already paid their wire upstream.
+        policy = (_rs_policy()
+                  if scattered is None and not pre_reduced else None)
+        wef = _fresh_ef(state.wire_ef)
+        ef_rows = list(wef.rows) if isinstance(wef, _WireEF) else None
         ag_codec = _wire.get_codec(allgather_wire)
         ag_wt = ag_codec.cast_dtype
         fuse_ag = bool(current_ag_fusion())
@@ -424,6 +611,8 @@ def DistributedGradientTransformation(
             shapes = [jnp.shape(leaves[i]) for i in idxs]
             sizes = [leaves[i].size for i in idxs]
             flat = _group_flat(leaves, idxs, dt)
+            codec = _group_codec(policy, leaves, idxs)
+            coop = codec is not None and codec.cooperative
             # Sentinel input flag: pre-wire, over the whole group (the
             # reduce-scatter leaves each rank only 1/N of the OUTPUT,
             # so the input side must be local).  Only needed when the
@@ -431,8 +620,10 @@ def DistributedGradientTransformation(
             # and cast wires propagate non-finites into some rank's
             # output shard, which the cross-rank flag OR already sees.
             in_flag = (_sent.local_nonfinite([flat])
-                       if scaler is not None and rs_wire is not None
-                       and rs_codec.cast_dtype is None else None)
+                       if scaler is not None and scattered is None
+                       and ((rs_wire is not None
+                             and rs_codec.cast_dtype is None) or coop)
+                       else None)
             padn = (-flat.size) % n_now
             padded = flat.size + padn
             shard_sz = padded // n_now
@@ -472,7 +663,11 @@ def DistributedGradientTransformation(
                     t)
 
             row_state = _row(slot.state)
-            if pre_reduced:
+            if scattered is not None:
+                # ZeRO-2 sync pass: the accumulator already holds the
+                # reduce-scattered local shard — no collective here.
+                g_shard = scattered[gi]
+            elif pre_reduced:
                 # early_reduction / megastep already allreduced: our
                 # shard is a plain slice, no collective here.
                 if padn:
@@ -488,6 +683,41 @@ def DistributedGradientTransformation(
                     g_shard = (g_shard / n_now).astype(dt)
                 rs_bytes += padded * jnp.dtype(
                     rs_codec.cast_dtype or dt).itemsize
+            elif coop:
+                er = ef_rows[gi] if ef_rows is not None else None
+                if er is None or er.shape[-1] != padded:
+                    raise ValueError(
+                        f"HOROVOD_WIRE_POLICY picked a cooperative wire "
+                        f"({codec.name}) for a shard group whose state "
+                        "carries no matching error-feedback residual "
+                        "(policy or partition changed after init?) — "
+                        "re-init the optimizer state after tunables "
+                        "change")
+                if padn:
+                    flat = jnp.concatenate([flat, jnp.zeros((padn,), dt)])
+                # Sender-side error feedback: this rank's residual over
+                # the WHOLE group buffer telescopes into the next step's
+                # encode, so the quantization error stays bounded
+                # instead of biasing every step (docs/WIRE.md).
+                g_shard, resid = quantized_reducescatter_shard(
+                    flat, ax, average=(op is C.Average),
+                    wire=codec.name, error_feedback=_row(er))
+                g_shard = g_shard.astype(dt)
+                ef_rows[gi] = _restack(resid)
+                rs_bytes += codec.wire_nbytes(padded)
+            elif codec is not None:
+                # Policy cast wire: psum-scatter in the cast dtype and
+                # divide on the wire, exactly like the replicated
+                # reduction's cast path.
+                c = flat.astype(codec.cast_dtype)
+                if padn:
+                    c = jnp.concatenate([c, jnp.zeros((padn,), c.dtype)])
+                g_shard = (_fc.pipelined_psum_scatter(c, ax) if fused
+                           else lax.psum_scatter(c, ax, tiled=True))
+                if op is C.Average:
+                    g_shard = (g_shard / n_now).astype(g_shard.dtype)
+                g_shard = g_shard.astype(dt)
+                rs_bytes += padded * jnp.dtype(codec.cast_dtype).itemsize
             else:
                 c, ctx = compression.compress(flat)
                 if padn:
@@ -607,7 +837,7 @@ def DistributedGradientTransformation(
             # Static wire sizes, recorded at trace time like
             # hvd_grad_bytes_per_step (multiply by hvd_steps_total for
             # cumulative traffic).
-            if not pre_reduced:
+            if not pre_reduced and scattered is None:
                 _met.rs_bytes.set(rs_bytes)
             _met.param_ag_bytes.set(ag_bytes)
         flags = None
@@ -616,8 +846,10 @@ def DistributedGradientTransformation(
                    else jnp.zeros((1,), jnp.float32))
             flags = _sent.crossrank_or(vec, axis_name=axis_name,
                                        process_set=process_set)
+        ef_out = (_WireEF(tuple(ef_rows), wef.gen)
+                  if isinstance(wef, _WireEF) else state.wire_ef)
         return (jax.tree_util.tree_unflatten(treedef, out),
-                tuple(new_inner), flags)
+                tuple(new_inner), flags, ef_out)
 
     def _fused_update(grads, state, params, pre_reduced):
         leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -668,8 +900,10 @@ def DistributedGradientTransformation(
         return (jax.tree_util.tree_unflatten(treedef, out),
                 tuple(new_inner), flags)
 
-    def _sync_update(grads, state, params, pre_reduced=False):
+    def _sync_update(grads, state, params, pre_reduced=False,
+                     scattered=None):
         flags = None
+        ef = state.wire_ef
         if op is C.Adasum:
             # Adasum mode: compute the local delta first, then combine
             # deltas with the projection-corrected reduction (reference:
@@ -681,8 +915,8 @@ def DistributedGradientTransformation(
                 updates,
             )
         elif shard_optimizer_states:
-            updates, inner, flags = _sharded_update(grads, state, params,
-                                                    pre_reduced)
+            updates, inner, flags, ef = _sharded_update(
+                grads, state, params, pre_reduced, scattered)
         elif fused_apply:
             updates, inner, flags = _fused_update(grads, state, params,
                                                   pre_reduced)
@@ -707,9 +941,9 @@ def DistributedGradientTransformation(
             # Eager executions only: under jit this body runs once per
             # compile, so counting here would undercount (and mislead).
             _met.optimizer_syncs.inc()
-        return updates, inner, flags
+        return updates, inner, flags, ef
 
-    def _gate(updates, inner, old_inner, gstate, flags):
+    def _gate(updates, inner, old_inner, gstate, flags, ef=None):
         """The coordinated skip-step: every rank holds the identical
         cross-rank `flags`, so this lowers to the same select on every
         replica — zero updates, revert the inner state (masters
@@ -722,17 +956,33 @@ def DistributedGradientTransformation(
             lambda u: jnp.where(bad, jnp.zeros_like(u), u), updates)
         inner = jax.tree_util.tree_map(
             lambda n, o: jnp.where(bad, o, n), inner, old_inner)
-        return updates, inner, new_guard
+        if isinstance(ef, _WireEF):
+            # A flagged step's residual can carry the very non-finites
+            # the sentinel caught (the ring encodes the poisoned
+            # gradient before the cross-rank OR gates the apply — and
+            # under stage 2 earlier window passes already folded theirs
+            # in), so zero it rather than revert: EF is a telescoped
+            # optimization and a zero residual is always safe.
+            ef = _WireEF(
+                tuple(r if r is None else
+                      jnp.where(bad, jnp.zeros_like(r), r)
+                      for r in ef.rows),
+                ef.gen)
+        return updates, inner, new_guard, ef
+
+    _zero_scatter = (shard_optimizer_states and zero_stage >= 2
+                     and backward_passes_per_step > 1)
 
     if backward_passes_per_step == 1:
         def update_fn(grads, state, params=None):
-            updates, inner, flags = _sync_update(grads, state, params)
+            updates, inner, flags, ef = _sync_update(grads, state,
+                                                     params)
             guard_state = state.guard
             if scaler is not None:
-                updates, inner, guard_state = _gate(
-                    updates, inner, state.inner, state.guard, flags)
+                updates, inner, guard_state, ef = _gate(
+                    updates, inner, state.inner, state.guard, flags, ef)
             return updates, DistributedOptState(
-                inner, state.accum, state.counter, guard_state
+                inner, state.accum, state.counter, guard_state, ef
             )
 
         return optax.GradientTransformation(init_fn, update_fn)
@@ -744,7 +994,184 @@ def DistributedGradientTransformation(
     scale = (1.0 / backward_passes_per_step
              if average_aggregated_gradients else 1.0)
 
+    def _zero2_update(grads, state, params):
+        """ZeRO-2: reduce-SCATTER this micro-batch's buckets and
+        accumulate only the local 1/N shard — the early-reduction
+        schedule with an N-fold smaller accumulator.  Rows stay stacked
+        (n, shard) in compat mode (restacked per pass so out_specs P()
+        holds) and (1, shard) once placed via sharded_state_specs."""
+        leaves, _ = jax.tree_util.tree_flatten(grads)
+        if not any(isinstance(l, jax.core.Tracer) for l in leaves):
+            raise HorovodTpuError(
+                "zero_stage >= 2 runs in-jit only (inside "
+                "hvd.data_parallel / shard_map with the mesh axis in "
+                "scope): the per-pass reduce-scatter needs axis_name "
+                "semantics")
+        groups = _shard_groups(leaves)
+        accum = state.accum
+        if (not isinstance(accum, _ZeroAccum)
+                or len(accum.rows) != len(groups)):
+            have = (len(accum.rows) if isinstance(accum, _ZeroAccum)
+                    else "a replicated accumulator")
+            raise ValueError(
+                f"zero_stage >= 2 accumulator does not match the shard "
+                f"partition ({have} vs {len(groups)} shard groups): "
+                "the fusion threshold / bucket order moved under the "
+                "state (autotuner proposal?) or the state predates "
+                "stage 2 — re-init the optimizer state after tunables "
+                "change")
+        from ..ops import fused_collectives as _fc
+        ax = axis_name or GLOBAL_AXIS
+        hier = _hier_axis
+        if hier:
+            dcn_ax, ici_ax = ax
+            n_ici = lax.axis_size(ici_ax)
+            n_now = lax.axis_size(dcn_ax) * n_ici
+            idx = lax.axis_index(dcn_ax) * n_ici + lax.axis_index(ici_ax)
+            gather_axes = (dcn_ax, ici_ax)
+        else:
+            n_now = lax.axis_size(ax)
+            idx = lax.axis_index(ax)
+            gather_axes = ax
+        rs_codec = _wire.get_codec(_wire.compressor_wire(compression))
+        rs_wire = None if rs_codec.exact else rs_codec.name
+        policy = _rs_policy()
+        wef = _fresh_ef(state.wire_ef)
+        ef_rows = list(wef.rows) if isinstance(wef, _WireEF) else None
+        fused = _fc.fused_enabled() and not hier
+        gstate = state.guard
+        if scaler is not None:
+            from ..guard import sentinel as _sent
+        g_flags = []
+        rs_bytes = 0
+        new_rows = []
+        for gi, (idxs, arow) in enumerate(zip(groups, accum.rows)):
+            dt = jnp.result_type(leaves[idxs[0]])
+            flat = _group_flat(leaves, idxs, dt)
+            codec = _group_codec(policy, leaves, idxs)
+            coop = codec is not None and codec.cooperative
+            in_flag = (_sent.local_nonfinite([flat])
+                       if scaler is not None
+                       and ((rs_wire is not None
+                             and rs_codec.cast_dtype is None) or coop)
+                       else None)
+            padn = (-flat.size) % n_now
+            padded = flat.size + padn
+            shard_sz = padded // n_now
+            lead = int(arow.shape[0])
+            if lead not in (1, n_now) or arow.shape[-1] != shard_sz:
+                raise ValueError(
+                    f"zero_stage >= 2 accumulator row {arow.shape} "
+                    f"does not match (n={n_now}, shard={shard_sz}): "
+                    "world size or bucket contents moved since init — "
+                    "re-init the optimizer state after tunables change")
+            if padn:
+                flat = jnp.concatenate([flat, jnp.zeros((padn,), dt)])
+            if hier:
+                g_shard = _hier.hierarchical_reduce_scatter(
+                    flat, dcn_ax, ici_ax, dcn_wire=rs_wire)
+                if op is C.Average:
+                    g_shard = (g_shard / n_now).astype(dt)
+                rs_bytes += padded * jnp.dtype(
+                    rs_codec.cast_dtype or dt).itemsize
+            elif coop:
+                er = ef_rows[gi] if ef_rows is not None else None
+                if er is None or er.shape[-1] != padded:
+                    raise ValueError(
+                        f"HOROVOD_WIRE_POLICY picked a cooperative "
+                        f"wire ({codec.name}) for a shard group whose "
+                        "state carries no matching error-feedback "
+                        "residual (policy or partition changed after "
+                        "init?) — re-init the optimizer state after "
+                        "tunables change")
+                ef_full = (er[0] if lead == 1 else
+                           lax.dynamic_index_in_dim(er, idx, 0,
+                                                    keepdims=False))
+                g_shard, resid = quantized_reducescatter_shard(
+                    flat, ax, average=(op is C.Average),
+                    wire=codec.name, error_feedback=ef_full)
+                g_shard = g_shard.astype(dt)
+                ef_rows[gi] = (resid[None] if lead == 1 else
+                               lax.all_gather(resid, gather_axes,
+                                              tiled=False))
+                rs_bytes += codec.wire_nbytes(padded)
+            elif codec is not None:
+                c = flat.astype(codec.cast_dtype)
+                g_shard = (_fc.pipelined_psum_scatter(c, ax) if fused
+                           else lax.psum_scatter(c, ax, tiled=True))
+                if op is C.Average:
+                    g_shard = (g_shard / n_now).astype(g_shard.dtype)
+                g_shard = g_shard.astype(dt)
+                rs_bytes += padded * jnp.dtype(codec.cast_dtype).itemsize
+            else:
+                c, ctx = compression.compress(flat)
+                g_shard = (_fc.pipelined_psum_scatter(c, ax) if fused
+                           else lax.psum_scatter(c, ax, tiled=True))
+                if op is C.Average:
+                    g_shard = (g_shard / n_now).astype(g_shard.dtype)
+                g_shard = compression.decompress(g_shard, ctx)
+                rs_bytes += padded * jnp.dtype(c.dtype).itemsize
+            if scaler is not None:
+                out_flag = _sent.local_nonfinite([g_shard])
+                g_flags.append(out_flag if in_flag is None
+                               else jnp.maximum(in_flag, out_flag))
+            # Accumulate the local shard: placed mode appends the bare
+            # row; compat mode restacks every rank's shard so the
+            # accumulator stays rank-identical under out_specs P().
+            stacked = (g_shard[None] if lead == 1 else
+                       lax.all_gather(g_shard, gather_axes, tiled=False))
+            new_rows.append(arow + stacked.astype(arow.dtype))
+        if scaler is not None:
+            # Each pass's flags fold into pending_flag now (the
+            # poisoned pass is already inside the accumulator) and
+            # gate the apply on the Nth pass.
+            vec = (jnp.stack(g_flags) if g_flags
+                   else jnp.zeros((1,), jnp.float32))
+            pflags = _sent.crossrank_or(vec, axis_name=axis_name,
+                                        process_set=process_set)
+            gstate = scaler.accumulate(gstate, pflags)
+        if _met.enabled():
+            _met.rs_bytes.set(rs_bytes)
+        ef_out = (_WireEF(tuple(ef_rows), wef.gen)
+                  if isinstance(wef, _WireEF) else state.wire_ef)
+        accum2 = _ZeroAccum(tuple(new_rows))
+        counter = state.counter + 1
+        is_sync = counter >= backward_passes_per_step
+        state2 = state._replace(guard=gstate, wire_ef=ef_out)
+
+        def do_sync(_):
+            agg = []
+            for arow in accum2.rows:
+                row = (arow[0] if arow.shape[0] == 1 else
+                       lax.dynamic_index_in_dim(arow, idx, 0,
+                                                keepdims=False))
+                agg.append((row * scale).astype(row.dtype))
+            updates, inner, flags, ef2 = _sync_update(
+                grads, state2, params, scattered=tuple(agg))
+            guard_state = gstate
+            if scaler is not None:
+                updates, inner, guard_state, ef2 = _gate(
+                    updates, inner, state.inner, gstate, flags, ef2)
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, accum2)
+            return (updates, inner, zeroed, jnp.zeros((), jnp.int32),
+                    guard_state, ef2)
+
+        def skip(_):
+            updates = jax.tree_util.tree_map(jnp.zeros_like, grads)
+            return (updates, state.inner, accum2, counter, gstate,
+                    ef_out)
+
+        if isinstance(is_sync, jax.core.Tracer):
+            res = jax.lax.cond(is_sync, do_sync, skip, operand=None)
+        else:
+            res = do_sync(None) if bool(is_sync) else skip(None)
+        updates, inner, accum3, counter2, guard2, ef3 = res
+        return updates, DistributedOptState(inner, accum3, counter2,
+                                            guard2, ef3)
+
     def update_fn(grads, state, params=None):
+        if _zero_scatter:
+            return _zero2_update(grads, state, params)
         gstate = state.guard
         if early_reduction:
             if scaler is not None:
@@ -766,30 +1193,31 @@ def DistributedGradientTransformation(
             agg = jax.tree_util.tree_map(
                 lambda a: (a * scale).astype(a.dtype), accum
             )
-            updates, inner, flags = _sync_update(
+            updates, inner, flags, ef = _sync_update(
                 agg, state2, params, pre_reduced=early_reduction)
             guard_state = gstate
             if scaler is not None:
-                updates, inner, guard_state = _gate(
-                    updates, inner, state.inner, gstate, flags)
+                updates, inner, guard_state, ef = _gate(
+                    updates, inner, state.inner, gstate, flags, ef)
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, accum)
             return (updates, inner, zeroed, jnp.zeros((), jnp.int32),
-                    guard_state)
+                    guard_state, ef)
 
         def skip(_):
             updates = jax.tree_util.tree_map(jnp.zeros_like, grads)
-            return updates, state.inner, accum, counter, gstate
+            return (updates, state.inner, accum, counter, gstate,
+                    state.wire_ef)
 
         if isinstance(is_sync, jax.core.Tracer):
-            updates, inner, accum2, counter2, guard2 = jax.lax.cond(
+            updates, inner, accum2, counter2, guard2, ef2 = jax.lax.cond(
                 is_sync, do_sync, skip, operand=None
             )
         else:
-            updates, inner, accum2, counter2, guard2 = (
+            updates, inner, accum2, counter2, guard2, ef2 = (
                 do_sync(None) if bool(is_sync) else skip(None)
             )
         return updates, DistributedOptState(inner, accum2, counter2,
-                                            guard2)
+                                            guard2, ef2)
 
     return optax.GradientTransformation(init_fn, update_fn)
 

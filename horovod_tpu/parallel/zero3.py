@@ -1,0 +1,400 @@
+"""ZeRO-3: parameters sharded at rest, gathered just-in-time per bucket.
+
+`zero3_placement` is the companion object to
+`DistributedGradientTransformation(zero_stage=3)`: the optimizer data
+path (reduce-scattered gradients, shard-local state and masters) is
+identical to stage 2, while this placement keeps the PARAMETERS
+themselves resident as 1/N flat shards over the same
+`shard_group_partition` the optimizer uses, and allgathers each bucket
+just-in-time for the forward/backward that touches it.
+
+The prefetch schedule is the reverse-availability bucket order: the
+partition's first bucket holds the LAST layers (backward-availability
+order, `HOROVOD_BUCKET_ORDER=reverse` default), so the FORWARD consumes
+buckets back-to-front — `gather` therefore issues group gathers in
+`prefetch_order` (the reversed partition order, whatever traversal or
+explicit permutation formed it), letting XLA start the first consuming
+matmul while later buckets' gathers are still in flight.  Routing:
+
+  - `HOROVOD_FUSED_COLLECTIVES=1` → `pipelined_allgather_shard` (chunked
+    consumption-order gather, bitwise-equal to the whole-buffer gather);
+  - a cooperative `gather_wire` (int8/int4/fp8_*) → the block-scaled
+    payload gather (`quantized_allgather_shard`), where every rank
+    decodes the SAME payload, so gathered params stay bitwise-identical
+    across ranks and within wire tolerance of the exact values;
+  - a cast wire (bf16/fp16) → `lax.all_gather` in the cast dtype;
+  - exact (default) → `lax.all_gather(tiled=True)`, bitwise.
+
+`gather_matmul` additionally routes a single-2D-leaf group through
+`fused_allgather_matmul` so the gather hides behind the first consuming
+matmul (docs/FUSED_COLLECTIVES.md).
+
+Like the optimizer state, shards live in dual placement: compat mode
+keeps the full (n, shard) stack on every rank (out_specs P() friendly),
+true sharding places dim 0 with `specs()` so each chip holds (1, shard)
+— `hvd_param_resident_bytes` then reads ~1/N of the replicated bytes
+outside the live bucket window.  The group partition is baked at
+construction; `gather`/`apply_updates` raise loudly on partition drift
+exactly like the optimizer (re-init after tunables change).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from ..common import basics, util
+from ..common.basics import GLOBAL_AXIS, ProcessSet
+from ..common.exceptions import HorovodTpuError
+from ..metrics import catalog as _met
+from ..ops import wire as _wire
+from ..ops.compression import Compression
+from ..ops.quantized import quantized_allgather_shard
+from . import hierarchical as _hier
+from .data_parallel import shard_group_partition
+
+
+class _GroupMeta(NamedTuple):
+    idxs: Tuple[int, ...]
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    dtype: Any
+    padded: int
+    shard_sz: int
+
+
+def _is_tracer(tree) -> bool:
+    return any(isinstance(l, jax.core.Tracer)
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+class ZeroParamPlacement:
+    """Parameter residency manager for ZeRO stage 3 (use the
+    `zero3_placement` factory).  Holds the baked shard-group partition
+    and moves params between the sharded at-rest layout (`shard`,
+    `apply_updates`) and the replicated live layout (`gather`,
+    `gather_matmul`)."""
+
+    def __init__(self, params, axis_name=None, process_set=None,
+                 compression=Compression.none,
+                 fusion_threshold_bytes: Optional[int] = None,
+                 bucket_order=None, gather_wire: Optional[str] = None):
+        if gather_wire is None:
+            gather_wire = util.getenv("ZERO_GATHER_WIRE") or None
+        codec = _wire.get_codec(gather_wire)
+        self._codec = codec
+        self.gather_wire = None if codec.exact else codec.name
+        ax = axis_name or GLOBAL_AXIS
+        self.axis_name = ax
+        self._hier = isinstance(ax, (tuple, list)) and len(ax) == 2
+        if codec.cooperative and self._hier:
+            raise ValueError(
+                f"gather_wire={codec.name!r} rides the ring payload "
+                "gather, which spans ONE named axis — with a "
+                "hierarchical 2-tuple axis_name use a cast wire "
+                f"({', '.join(_wire.cast_wire_names())}) instead")
+        if process_set is not None and process_set.process_set_id != 0:
+            raise ValueError(
+                "zero3_placement requires the global process set: "
+                "subset gathers would need group-aware shard ownership")
+        self.n = (process_set.size() if process_set is not None
+                  else basics.size())
+        self._compression = compression
+        self._fusion_threshold_bytes = fusion_threshold_bytes
+        self._bucket_order = bucket_order
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        self._treedef = treedef
+        self._n_leaves = len(leaves)
+        self._leaf_meta = tuple(
+            (tuple(jnp.shape(l)), int(np.prod(jnp.shape(l), dtype=int)),
+             jnp.result_type(l))
+            for l in leaves)
+        self.groups = tuple(
+            self._group_meta(idxs)
+            for idxs in shard_group_partition(
+                leaves, compression=compression,
+                fusion_threshold_bytes=fusion_threshold_bytes,
+                bucket_order=bucket_order))
+        # Reverse-availability prefetch: the partition's first bucket is
+        # the last layers' (backward-availability order), so the forward
+        # consumes — and `gather` issues — groups back-to-front.  Under
+        # an explicit `bucket_order` permutation this is the PERMUTED
+        # reverse order, not the leaf order's.
+        self.prefetch_order = tuple(reversed(range(len(self.groups))))
+
+    def _group_meta(self, idxs) -> _GroupMeta:
+        shapes = tuple(self._leaf_meta[i][0] for i in idxs)
+        sizes = tuple(self._leaf_meta[i][1] for i in idxs)
+        dt = self._leaf_meta[idxs[0]][2]
+        total = sum(sizes)
+        padded = total + (-total) % self.n
+        return _GroupMeta(tuple(idxs), shapes, sizes, dt, padded,
+                          padded // self.n)
+
+    # -- layout ------------------------------------------------------------
+
+    @property
+    def full_bytes(self) -> int:
+        """Replicated parameter bytes (the stage-3 numerator)."""
+        return sum(sz * jnp.dtype(dt).itemsize
+                   for _, sz, dt in self._leaf_meta)
+
+    def resident_bytes(self, rows=None) -> int:
+        """Per-chip at-rest parameter bytes once placed with `specs()`
+        (the stage-3 denominator: ~full_bytes / n outside the live
+        bucket window)."""
+        return sum(g.shard_sz * jnp.dtype(g.dtype).itemsize
+                   for g in self.groups)
+
+    def specs(self, axis_name=None):
+        """One PartitionSpec per group row stack: dim 0 (the rank axis)
+        maps to the mesh axis, placing each chip's (1, shard) row."""
+        ax = axis_name or self.axis_name
+        return tuple(PartitionSpec(ax if not isinstance(ax, list)
+                                   else tuple(ax))
+                     for _ in self.groups)
+
+    def _check_drift(self, rows) -> None:
+        if len(rows) != len(self.groups):
+            raise ValueError(
+                f"zero3 shard rows do not match the baked partition "
+                f"({len(rows)} vs {len(self.groups)} shard groups) — "
+                "re-init the placement (and optimizer state) after "
+                "tunables change")
+        # Recompute the partition with the LIVE tunables over metadata
+        # placeholders: an autotuner proposal that moved the fusion
+        # threshold / bucket order under us must fail loudly, exactly
+        # like the optimizer's re-init contract.
+        fakes = [np.broadcast_to(np.zeros((), dt), shp)
+                 for shp, _, dt in self._leaf_meta]
+        live = shard_group_partition(
+            fakes, compression=self._compression,
+            fusion_threshold_bytes=self._fusion_threshold_bytes,
+            bucket_order=self._bucket_order)
+        if [list(g.idxs) for g in self.groups] != [list(i) for i in live]:
+            raise ValueError(
+                "zero3 shard-group partition changed since construction "
+                "(autotuner proposal moved the fusion threshold / "
+                "bucket order?) — re-init the placement (and optimizer "
+                "state) after tunables change")
+        for g, r in zip(self.groups, rows):
+            if r.ndim != 2 or r.shape[-1] != g.shard_sz or \
+                    r.shape[0] not in (1, self.n):
+                raise ValueError(
+                    f"zero3 shard row {r.shape} does not match "
+                    f"(n={self.n}, shard={g.shard_sz}): world size or "
+                    "bucket contents moved since construction — "
+                    "re-init the placement")
+
+    def shard(self, params) -> Tuple[jax.Array, ...]:
+        """Params → at-rest layout: one (n, shard) stacked row array
+        per shard group (place dim 0 with `specs()` for true 1/N
+        residency).  Pure layout — runs eagerly or in-jit."""
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        if treedef != self._treedef:
+            raise ValueError(
+                "zero3_placement.shard: params tree does not match the "
+                "tree the placement was built from — re-init the "
+                "placement")
+        out = []
+        for g in self.groups:
+            flat = (jnp.ravel(leaves[g.idxs[0]]).astype(g.dtype)
+                    if len(g.idxs) == 1 else
+                    jnp.concatenate([jnp.ravel(leaves[i]).astype(g.dtype)
+                                     for i in g.idxs]))
+            if g.padded != flat.size:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((g.padded - flat.size,), g.dtype)])
+            out.append(flat.reshape(self.n, g.shard_sz))
+        return tuple(out)
+
+    # -- just-in-time gather ----------------------------------------------
+
+    def _own_row(self, r: jax.Array, idx) -> jax.Array:
+        if r.shape[0] == 1:
+            return r[0]
+        return lax.dynamic_index_in_dim(r, idx, 0, keepdims=False)
+
+    def _gather_flat(self, row: jax.Array, g: _GroupMeta) -> jax.Array:
+        """All ranks' segments of one group, rank-major flat."""
+        from ..ops import fused_collectives as _fc
+
+        ax = self.axis_name
+        codec = self._codec
+        if self._hier:
+            dcn_ax, ici_ax = ax
+            send = (row.astype(codec.cast_dtype)
+                    if codec.cast_dtype is not None else row)
+            full = _hier.hierarchical_all_gather(send, dcn_ax, ici_ax)
+            return full.astype(g.dtype)
+        if _fc.fused_enabled():
+            send = (row.astype(codec.cast_dtype)
+                    if codec.cast_dtype is not None else row)
+            full = _fc.pipelined_allgather_shard(
+                send, ax,
+                wire=codec.name if codec.cooperative else None)
+            return full.astype(g.dtype)
+        if codec.cooperative:
+            return quantized_allgather_shard(
+                row, ax, wire=codec.name).astype(g.dtype)
+        if codec.cast_dtype is not None:
+            return lax.all_gather(row.astype(codec.cast_dtype), ax,
+                                  tiled=True).astype(g.dtype)
+        return lax.all_gather(row, ax, tiled=True)
+
+    def gather(self, rows) -> Any:
+        """At-rest shards → the full params pytree, group gathers issued
+        in `prefetch_order` (reverse-availability: the order the
+        forward consumes buckets).  In-jit this is the just-in-time
+        allgather; eagerly it only accepts compat-mode (n, shard) rows
+        and restitches them without a collective."""
+        rows = tuple(rows)
+        self._check_drift(rows)
+        in_jit = _is_tracer(rows)
+        if in_jit:
+            ax = self.axis_name
+            if self._hier:
+                dcn_ax, ici_ax = ax
+                n_ici = lax.axis_size(ici_ax)
+                idx = (lax.axis_index(dcn_ax) * n_ici
+                       + lax.axis_index(ici_ax))
+            else:
+                idx = lax.axis_index(ax)
+        leaves: List[Any] = [None] * self._n_leaves
+        if _met.enabled():
+            # Static residency, recorded at trace time like
+            # hvd_opt_state_bytes: the at-rest per-chip bytes outside
+            # the live bucket window (full_bytes is the numerator).
+            _met.param_resident_bytes.set(self.resident_bytes())
+        for gi in self.prefetch_order:
+            g = self.groups[gi]
+            r = rows[gi]
+            if in_jit:
+                full = self._gather_flat(self._own_row(r, idx), g)
+            else:
+                if r.shape[0] != self.n:
+                    raise HorovodTpuError(
+                        "zero3_placement.gather outside jit needs the "
+                        "compat-mode (n, shard) stacked rows; placed "
+                        "(1, shard) shards can only gather in-jit "
+                        "(inside hvd.data_parallel / shard_map with "
+                        "the mesh axis in scope)")
+                full = r.reshape(-1)
+            off = 0
+            for i, sz, shp in zip(g.idxs, g.sizes, g.shapes):
+                leaves[i] = full[off:off + sz].reshape(shp).astype(
+                    self._leaf_meta[i][2])
+                off += sz
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def gather_matmul(self, x: jax.Array, rows, gi: int) -> jax.Array:
+        """``x @ W.Tᵀ`` for a single-2D-leaf group, the gather fused
+        behind the consuming matmul (`fused_allgather_matmul`): the
+        first output band is ready after one chunk's gather instead of
+        the whole bucket's.  Returns (B, R) — columns in the leaf's row
+        order.  In-jit only."""
+        from ..ops import fused_collectives as _fc
+
+        rows = tuple(rows)
+        self._check_drift(rows)
+        g = self.groups[gi]
+        if len(g.idxs) != 1 or len(g.shapes[0]) != 2:
+            raise ValueError(
+                f"gather_matmul needs a single-2D-leaf shard group; "
+                f"group {gi} holds leaves {g.idxs} of shapes "
+                f"{g.shapes}")
+        rdim, k = g.shapes[0]
+        if g.padded != g.sizes[0]:
+            raise ValueError(
+                f"gather_matmul needs the leaf's rows to divide the "
+                f"rank count evenly (got ({rdim}, {k}) over n={self.n} "
+                "with padding) — gather() the group instead")
+        if self._hier:
+            raise ValueError(
+                "gather_matmul spans ONE named axis (the fused gather "
+                "rides the flat ring) — gather() the group instead")
+        if not _is_tracer(rows):
+            raise HorovodTpuError(
+                "gather_matmul runs in-jit only (inside "
+                "hvd.data_parallel / shard_map with the mesh axis in "
+                "scope): the fused allgather needs axis_name semantics")
+        idx = lax.axis_index(self.axis_name)
+        w_shard = self._own_row(rows[gi], idx).reshape(
+            rdim // self.n, k)
+        return _fc.fused_allgather_matmul(
+            x, w_shard, self.axis_name, wire=self.gather_wire)
+
+    # -- update ------------------------------------------------------------
+
+    def apply_updates(self, rows, updates) -> Tuple[jax.Array, ...]:
+        """Fold a full params-tree of additive updates (the optimizer's
+        output) into the at-rest shards: compat-mode rows add the whole
+        (n, shard) band, placed rows add only this rank's slice.  The
+        update tree is rank-identical (the optimizer allgathers it), so
+        both layouts stay consistent."""
+        rows = tuple(rows)
+        self._check_drift(rows)
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        if treedef != self._treedef:
+            raise ValueError(
+                "zero3_placement.apply_updates: updates tree does not "
+                "match the tree the placement was built from")
+        in_jit = _is_tracer(rows) or _is_tracer(leaves)
+        out = []
+        for gi, g in enumerate(self.groups):
+            r = rows[gi]
+            flat = (jnp.ravel(leaves[g.idxs[0]]).astype(g.dtype)
+                    if len(g.idxs) == 1 else
+                    jnp.concatenate([jnp.ravel(leaves[i]).astype(g.dtype)
+                                     for i in g.idxs]))
+            if g.padded != flat.size:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((g.padded - flat.size,), g.dtype)])
+            if r.shape[0] == 1:
+                if not in_jit:
+                    raise HorovodTpuError(
+                        "zero3_placement.apply_updates on placed "
+                        "(1, shard) rows runs in-jit only (the slice "
+                        "needs axis_name semantics)")
+                ax = self.axis_name
+                if self._hier:
+                    dcn_ax, ici_ax = ax
+                    n_ici = lax.axis_size(ici_ax)
+                    idx = (lax.axis_index(dcn_ax) * n_ici
+                           + lax.axis_index(ici_ax))
+                else:
+                    idx = lax.axis_index(ax)
+                band = lax.dynamic_slice(
+                    flat, (idx * g.shard_sz,), (g.shard_sz,))[None]
+            else:
+                band = flat.reshape(self.n, g.shard_sz)
+            out.append(r + band.astype(r.dtype))
+        return tuple(out)
+
+
+def zero3_placement(params, axis_name=None,
+                    process_set: Optional[ProcessSet] = None,
+                    compression=Compression.none,
+                    fusion_threshold_bytes: Optional[int] = None,
+                    bucket_order=None,
+                    gather_wire: Optional[str] = None
+                    ) -> ZeroParamPlacement:
+    """Build the ZeRO-3 parameter placement over `params` (env:
+    HOROVOD_ZERO_GATHER_WIRE for the gather wire).  Pass the SAME
+    `compression` / `fusion_threshold_bytes` / `bucket_order` as the
+    companion `DistributedGradientTransformation(zero_stage=3)` so both
+    bake the identical shard-group partition.  See module docstring and
+    docs/SHARDED_OPTIMIZER.md."""
+    return ZeroParamPlacement(
+        params, axis_name=axis_name, process_set=process_set,
+        compression=compression,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        bucket_order=bucket_order, gather_wire=gather_wire)
+
+
+__all__ = ["ZeroParamPlacement", "zero3_placement"]

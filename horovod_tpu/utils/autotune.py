@@ -396,6 +396,15 @@ def init_from_env() -> Optional[ParameterManager]:
     pm.register("guard_digest_interval", 10, 10000, log_scale=True,
                 integer=True,
                 initial=util.env_int("GUARD_DIGEST_INTERVAL", 100))
+    # ZeRO ladder rung (docs/SHARDED_OPTIMIZER.md): 0 replicated,
+    # 1 optimizer-state sharding, 2 gradient-sharded accumulation,
+    # 3 parameter sharding via zero3_placement.  Higher rungs trade
+    # collective count for per-chip memory, so the right rung depends
+    # on the model-size/interconnect balance the tuner measures.  Only
+    # consulted by DistributedGradientTransformation when zero_stage=
+    # is not pinned.
+    pm.register("zero_stage", 0, 3, integer=True,
+                initial=_env_zero_stage())
     _manager = pm
     logger.info("autotune enabled: %s", pm.values())
     return pm
@@ -462,6 +471,34 @@ def current_ag_fusion() -> bool:
     (off by default — per-group gathers overlap better), overridden by
     the autotuner when active."""
     return tuned_ag_fusion(util.env_bool("SHARD_AG_FUSION", False))
+
+
+def _env_zero_stage() -> int:
+    # HOROVOD_SHARD_OPTIMIZER=1 without an explicit stage means ZeRO-1
+    # (the two spellings are aliases in
+    # DistributedGradientTransformation).
+    stage = util.env_int(
+        "ZERO_STAGE",
+        1 if util.env_bool("SHARD_OPTIMIZER", False) else 0)
+    if stage not in (0, 1, 2, 3):
+        raise ValueError(
+            f"HOROVOD_ZERO_STAGE must be 0..3, got {stage}")
+    return stage
+
+
+def tuned_zero_stage(default: int) -> int:
+    """ZeRO ladder rung honoring the autotuner when active (see
+    DistributedGradientTransformation zero_stage)."""
+    if _manager is not None and "zero_stage" in _manager._tunables:
+        return int(_manager.value("zero_stage"))
+    return default
+
+
+def current_zero_stage() -> int:
+    """The live ZeRO stage: HOROVOD_ZERO_STAGE (default 0, or 1 when
+    HOROVOD_SHARD_OPTIMIZER is set), overridden by the autotuner when
+    active."""
+    return tuned_zero_stage(_env_zero_stage())
 
 
 def tuned_fusion_threshold(default: int) -> int:

@@ -1,0 +1,145 @@
+"""Worker main for the REAL cross-process ZeRO-2/ZeRO-3 end-to-end test.
+
+Launched by `exec_run` with -np 2: one CPU device per process, so every
+reduce-scatter / allgather of the ZeRO ladder crosses the gloo transport
+— the single-process suites only ever fold the shard exchange into one
+host.  Each rank runs the same two-window training schedule three ways:
+
+  - ZeRO-1 + early_reduction (the reference trajectory);
+  - ZeRO-2 (gradient-sharded accumulation): must match ZeRO-1 BIT FOR
+    BIT on integer-valued f32 grads at the power-of-two world size;
+  - ZeRO-3 (parameters sharded at rest, gathered just-in-time, updates
+    folded back into the shards): same data path as stage 2, so the
+    gathered finals must also be bitwise-equal — plus an int8
+    gather-wire variant whose finals must still be bitwise-identical
+    ACROSS ranks (every rank decodes the same payload) and within wire
+    tolerance of the exact finals.
+
+Results go to $HVD_TEST_OUT/rank{r}.json; the parent asserts the final
+params are bitwise-identical across ranks for every variant.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+K = 2        # backward_passes_per_step
+WINDOWS = 2  # accumulation windows per run
+SHAPES = [(6,), (4, 2)]
+FUSION = 16  # bytes: splits the two leaves into separate shard groups
+
+
+def main():
+    hvd.init()
+    rank, n = hvd.rank(), hvd.size()
+    assert n == 2, n
+    assert jax.process_count() == n, "jax.distributed did not bootstrap"
+
+    shard_map = jax.shard_map
+    mesh = hvd.global_mesh()
+    spec = P(hvd.GLOBAL_AXIS)
+
+    # Same seed everywhere: row r is global rank r's per-pass gradients,
+    # integer-valued so every reduction order is exact.
+    rng = np.random.RandomState(0)
+    data = [np.round(rng.randn(n, K * WINDOWS, *s) * 4).astype(np.float32)
+            for s in SHAPES]
+    garrs = [jax.make_array_from_callback(
+        d.shape, NamedSharding(mesh, spec), lambda idx, d=d: d[idx])
+        for d in data]
+    params = [jnp.zeros(s, jnp.float32) for s in SHAPES]
+
+    def sgd():
+        return optax.sgd(0.25, momentum=0.5)  # dyadic: FMA-proof
+
+    def run_opt(opt):
+        def body(*xs):
+            state = opt.init(list(params))
+            p = list(params)
+            for j in range(K * WINDOWS):
+                g = [x[0, j] for x in xs]
+                u, state = opt.update(g, state, p)
+                p = [pi + ui for pi, ui in zip(p, u)]
+            return p
+
+        sm = shard_map(body, mesh=mesh,
+                       in_specs=tuple(spec for _ in SHAPES),
+                       out_specs=P(), check_vma=False)
+        return [np.asarray(a) for a in jax.jit(sm)(*garrs)]
+
+    kw = dict(backward_passes_per_step=K, fusion_threshold_bytes=FUSION,
+              axis_name=hvd.GLOBAL_AXIS)
+    z1 = run_opt(hvd.DistributedOptimizer(
+        sgd(), early_reduction=True, zero_stage=1, **kw))
+    z2 = run_opt(hvd.DistributedOptimizer(sgd(), zero_stage=2, **kw))
+
+    # ZeRO-3: params live as shards; each window gathers just-in-time,
+    # the stage-2/3 optimizer consumes the gathered tree, and the
+    # updates fold back into the shards.
+    def run_zero3(gather_wire=None):
+        pl = hvd.zero3_placement(params,
+                                 fusion_threshold_bytes=FUSION,
+                                 gather_wire=gather_wire)
+        opt = hvd.DistributedOptimizer(sgd(), zero_stage=3, **kw)
+
+        def body(rows, *xs):
+            rows = tuple(rows)
+            p = pl.gather(rows)
+            state = opt.init(p)
+            for j in range(K * WINDOWS):
+                g = [x[0, j] for x in xs]
+                u, state = opt.update(g, state, p)
+                rows = pl.apply_updates(rows, u)
+                p = pl.gather(rows)
+            return p
+
+        sm = shard_map(body, mesh=mesh,
+                       in_specs=(P(),) + tuple(spec for _ in SHAPES),
+                       out_specs=P(), check_vma=False)
+        rows0 = pl.shard(params)
+        final = [np.asarray(a) for a in jax.jit(sm)(rows0, *garrs)]
+        return final, pl
+
+    z3, pl3 = run_zero3()
+    z3q, _ = run_zero3(gather_wire="int8")
+
+    results = {
+        "rank": rank,
+        "size": n,
+        "z1": [a.tolist() for a in z1],
+        "z2": [a.tolist() for a in z2],
+        "z3": [a.tolist() for a in z3],
+        "z3_int8": [a.tolist() for a in z3q],
+        "z2_bitwise_z1": bool(all(
+            (a == b).all() for a, b in zip(z1, z2))),
+        "z3_bitwise_z1": bool(all(
+            (a == b).all() for a, b in zip(z1, z3))),
+        "z3q_maxerr": float(max(
+            np.abs(a - b).max() for a, b in zip(z1, z3q))),
+        "z1_scale": float(max(np.abs(a).max() for a in z1)),
+        "param_full_bytes": pl3.full_bytes,
+        "param_resident_bytes": pl3.resident_bytes(),
+    }
+    out_dir = os.environ["HVD_TEST_OUT"]
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(results, f)
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

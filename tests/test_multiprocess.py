@@ -210,6 +210,65 @@ class TestHierarchicalCrossProcess:
             assert 0.0 < res["int8_err"] < res["ref_scale"] / 25, res
 
 
+ZERO_WORKER = os.path.join(REPO_ROOT, "tests", "data", "zero_main.py")
+
+
+@pytest.mark.integration
+class TestZeroCrossProcess:
+    """ZeRO-2 and ZeRO-3 end-to-end across a REAL process boundary:
+    np=2 gloo workers run two accumulation windows per stage, so every
+    per-pass reduce-scatter, just-in-time param gather, and update
+    allgather crosses the transport.  The contract under test is the
+    ladder's replica consistency: final params bitwise-identical across
+    ranks for every stage, stage 2 bitwise-equal to stage 1 +
+    early_reduction (integer f32 grads, power-of-two world size), and
+    the int8 gather-wire stage-3 variant still rank-identical with
+    bounded wire error."""
+
+    def test_zero2_zero3_end_to_end(self, tmp_path):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        env["HVD_TEST_OUT"] = str(tmp_path)
+        env["JAX_PLATFORMS"] = "cpu"
+        # One CPU device per process: the shard exchange must cross gloo.
+        env.pop("XLA_FLAGS", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             "python", ZERO_WORKER],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO_ROOT)
+        assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+        res = {}
+        for rank in (0, 1):
+            path = tmp_path / f"rank{rank}.json"
+            assert path.exists(), \
+                f"rank {rank} wrote no result:\n{r.stdout}\n{r.stderr}"
+            res[rank] = json.loads(path.read_text())
+        # Replica consistency: every stage's finals bitwise-identical
+        # across the process boundary (JSON round-trips f32 exactly).
+        for key in ("z1", "z2", "z3", "z3_int8"):
+            assert res[0][key] == res[1][key], key
+        for rank in (0, 1):
+            out = res[rank]
+            assert out["z2_bitwise_z1"], out
+            assert out["z3_bitwise_z1"], out
+            # int8 gather wire engaged: error nonzero but bounded.
+            assert 0.0 < out["z3q_maxerr"] < out["z1_scale"] / 10, out
+            # Stage-3 residency: ~1/2 of the replicated param bytes.
+            assert out["param_resident_bytes"] <= \
+                out["param_full_bytes"] // 2 + 8
+        # Sanity: training moved the params.
+        def _flat(x):
+            if isinstance(x, list):
+                for v in x:
+                    yield from _flat(v)
+            else:
+                yield x
+        assert any(v != 0.0 for leaf in res[0]["z1"]
+                   for v in _flat(leaf))
+
+
 STALL_WORKER = os.path.join(REPO_ROOT, "tests", "data", "stall_main.py")
 
 

@@ -652,6 +652,454 @@ class TestShardedOptimizer:
 
 
 # ---------------------------------------------------------------------------
+# ZeRO-2: gradient-sharded accumulation (zero_stage=2)
+# ---------------------------------------------------------------------------
+
+
+class TestZero2:
+    SHAPES = [(6,), (3, 2)]
+
+    def _run(self, opt, stacked, params):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = hvd.global_mesh()
+        passes = stacked[0].shape[1]
+
+        def body(*xs):
+            state = opt.init(list(params))
+            p = list(params)
+            for j in range(passes):
+                g = [x[0, j] for x in xs]
+                u, state = opt.update(g, state, p)
+                p = [pi + ui for pi, ui in zip(p, u)]
+            return p
+
+        sm = shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P(hvd.GLOBAL_AXIS) for _ in stacked),
+            out_specs=P(), check_vma=False)
+        return jax.jit(sm)(*stacked)
+
+    def _window_grads(self, seed, k, windows=2):
+        rng = np.random.RandomState(seed)
+        return [jnp.asarray(np.round(rng.randn(N, k * windows, *s) * 8),
+                            jnp.float32) for s in self.SHAPES]
+
+    def test_bitwise_matches_zero1_early_reduction(self):
+        """Stage 2 accumulates the SHARD of each pass's reduce-scatter;
+        stage 1 + early_reduction accumulates the full reduced gradient
+        and slices at sync.  Slice of a sum == sum of slices, so on
+        exactly-representable inputs (integer f32 grads, k=4 a power of
+        two, dyadic sgd) the trajectories must agree bit for bit."""
+        k = 4
+        stacked = self._window_grads(11, k)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        kw = dict(backward_passes_per_step=k, fusion_threshold_bytes=16,
+                  axis_name=hvd.GLOBAL_AXIS)
+        z1 = self._run(hvd.DistributedOptimizer(
+            _dyadic_sgd(), early_reduction=True, zero_stage=1, **kw),
+            stacked, params)
+        z2 = self._run(hvd.DistributedOptimizer(
+            _dyadic_sgd(), zero_stage=2, **kw), stacked, params)
+        for a, b in zip(z1, z2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_accum_is_sharded_and_bytes_drop(self):
+        """The stage-2 accumulator is per-group (n, shard) rows, and
+        `grad_accum_bytes` (the hvd_grad_shard_bytes gauge source)
+        counts the 1/N shard — vs the full params-shaped stage-1
+        window accumulator."""
+        from horovod_tpu.parallel.optimizer import _ZeroAccum
+
+        shapes = [(5, 3), (7,), (2, 2, 2), (11,)]
+        params = [jnp.zeros(s, jnp.float32) for s in shapes]
+        kw = dict(backward_passes_per_step=2, fusion_threshold_bytes=64,
+                  axis_name=hvd.GLOBAL_AXIS)
+        s1 = hvd.DistributedOptimizer(
+            _dyadic_sgd(), early_reduction=True, zero_stage=1,
+            **kw).init(params)
+        s2 = hvd.DistributedOptimizer(
+            _dyadic_sgd(), zero_stage=2, **kw).init(params)
+        assert not isinstance(s1.accum, _ZeroAccum)
+        assert isinstance(s2.accum, _ZeroAccum)
+        assert all(r.ndim == 2 and r.shape[0] == N for r in s2.accum.rows)
+        total = sum(int(np.prod(s)) for s in shapes) * 4
+        b1, b2 = hvd.grad_accum_bytes(s1), hvd.grad_accum_bytes(s2)
+        assert b1 == total
+        # 1/N plus at most one pad row per group.
+        assert b2 <= total // N + 4 * len(s2.accum.rows) * 2
+        assert b2 < b1 / 4
+
+    def test_true_sharded_placement_data_parallel(self):
+        """End-to-end stage-2 placement: sharded_state_specs maps the
+        accumulator rows to P(axis) so each rank materializes only its
+        1/N gradient shard across the window — bitwise equal to the
+        compat (replicated-stack) run, since placement is pure layout."""
+        from jax.sharding import PartitionSpec as P
+
+        rng = np.random.RandomState(13)
+        shapes = [(6, 4), (10,)]
+        params = [jnp.asarray(np.round(rng.randn(*s) * 4), jnp.float32)
+                  for s in shapes]
+        xs = jnp.asarray(np.round(rng.randn(N * 2, 4) * 2), jnp.float32)
+
+        def make_step(o):
+            def step(p, opt_state, x):
+                s = jnp.sum(x)
+                g = [jnp.full(pi.shape, s, pi.dtype) for pi in p]
+                u, opt_state = o.update(g, opt_state, p)
+                return [pi + ui for pi, ui in zip(p, u)], opt_state
+            return step
+
+        def make(**kw):
+            return hvd.DistributedOptimizer(
+                _dyadic_sgd(), backward_passes_per_step=2, zero_stage=2,
+                fusion_threshold_bytes=64, axis_name=hvd.GLOBAL_AXIS,
+                **kw)
+
+        sopt = make()
+        st0 = sopt.init(params)
+        specs = hvd.sharded_state_specs(st0)
+        compiled = hvd.data_parallel(
+            make_step(sopt), batch_args=(2,), donate_args=(),
+            arg_specs={1: specs}, out_specs=(P(), specs))
+        batch = hvd.shard_batch(xs)
+        p, st = params, st0
+        for _ in range(4):
+            p, st = compiled(p, st, batch)
+
+        ropt = make()
+        rcompiled = hvd.data_parallel(
+            make_step(ropt), batch_args=(2,), donate_args=())
+        rp, rst = params, ropt.init(params)
+        for _ in range(4):
+            rp, rst = rcompiled(rp, rst, batch)
+
+        for a, b in zip(p, rp):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # Placed accumulator rows carry the rank axis.
+        for r in st.accum.rows:
+            assert r.sharding.spec == P(hvd.GLOBAL_AXIS)
+        total = sum(int(np.prod(s)) for s in shapes) * 4
+        assert hvd.grad_accum_bytes(st) <= total // N + 4 * N
+
+    def test_guard_composes_skip_step(self):
+        """A NaN injected into one rank's pass must gate the whole
+        window's apply in lockstep: the per-pass scatter folds its
+        sentinel flag into the guard's pending window flag, and the
+        sync pass zeroes the updates on every rank."""
+        from horovod_tpu.guard import DynamicLossScale
+
+        k = 2
+        stacked = self._window_grads(14, k, windows=1)
+        # Poison rank 3's second pass in the first leaf.
+        poisoned = np.array(stacked[0])
+        poisoned[3, 1, 0] = np.nan
+        stacked[0] = jnp.asarray(poisoned)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        opt = hvd.DistributedOptimizer(
+            _dyadic_sgd(), backward_passes_per_step=k, zero_stage=2,
+            fusion_threshold_bytes=16, axis_name=hvd.GLOBAL_AXIS,
+            guard=DynamicLossScale(init_scale=1.0, dynamic=False))
+        got = self._run(opt, stacked, params)
+        for g in got:
+            np.testing.assert_array_equal(np.asarray(g),
+                                          np.zeros_like(np.asarray(g)))
+
+    def test_partition_drift_raises(self, monkeypatch):
+        """Stage 2 inherits the loud re-init contract: the accumulator
+        rows are keyed to the shard partition, so an autotuner moving
+        the fusion threshold between init and update must raise."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = hvd.global_mesh()
+        shapes = [(5, 3), (7,), (2, 2, 2), (11,)]
+        params = [jnp.zeros(s, jnp.float32) for s in shapes]
+        stacked = _stacked_grads(15, shapes)
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 26))
+        opt = hvd.DistributedOptimizer(_dyadic_sgd(), zero_stage=2,
+                                       backward_passes_per_step=2,
+                                       axis_name=hvd.GLOBAL_AXIS)
+        state = opt.init(params)
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "16")
+
+        def body(*xs):
+            u, _ = opt.update([x[0] for x in xs], state, list(params))
+            return u
+
+        sm = shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(P(hvd.GLOBAL_AXIS) for _ in shapes),
+            out_specs=P(), check_vma=False)
+        with pytest.raises(ValueError, match="re-init"):
+            jax.jit(sm)(*stacked)
+
+    def test_eager_update_raises(self):
+        from horovod_tpu.common.exceptions import HorovodTpuError
+
+        opt = hvd.DistributedOptimizer(_dyadic_sgd(), zero_stage=2,
+                                       backward_passes_per_step=2,
+                                       fusion_threshold_bytes=64)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        grads = [jnp.ones(s, jnp.float32) for s in self.SHAPES]
+        state = opt.init(params)
+        with pytest.raises(HorovodTpuError, match="in-jit only"):
+            opt.update(grads, state, params)
+
+    def test_env_knob(self, monkeypatch):
+        """HOROVOD_ZERO_STAGE=2 flips the stage without call-site
+        changes: init builds the sharded accumulator."""
+        from horovod_tpu.parallel.optimizer import _ZeroAccum
+
+        monkeypatch.setenv("HOROVOD_ZERO_STAGE", "2")
+        opt = hvd.DistributedOptimizer(_dyadic_sgd(),
+                                       backward_passes_per_step=2,
+                                       fusion_threshold_bytes=64)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        state = opt.init(params)
+        assert isinstance(state.accum, _ZeroAccum)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="0..3"):
+            hvd.DistributedOptimizer(_dyadic_sgd(), zero_stage=4)
+        with pytest.raises(ValueError, match="contradicts"):
+            hvd.DistributedOptimizer(_dyadic_sgd(), zero_stage=2,
+                                     shard_optimizer_states=False)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            hvd.DistributedOptimizer(_dyadic_sgd(), zero_stage=2,
+                                     fused_apply=True)
+
+
+# ---------------------------------------------------------------------------
+# HOROVOD_WIRE_POLICY on the sharded reduce-scatter: shard-local
+# error-feedback residual (DistributedOptState.wire_ef)
+# ---------------------------------------------------------------------------
+
+
+POLICY = "big=int8,small=none,threshold=64"
+
+
+class TestZeroWireEF:
+    # One group above the 64-byte policy threshold (rides int8 + EF),
+    # one below (stays exact) — split by fusion_threshold_bytes=64.
+    SHAPES = [(8, 8), (7,)]
+
+    def _make(self, monkeypatch=None, **kw):
+        if monkeypatch is not None:
+            monkeypatch.setenv("HOROVOD_WIRE_POLICY", POLICY)
+        base = dict(fusion_threshold_bytes=64, axis_name=hvd.GLOBAL_AXIS,
+                    shard_optimizer_states=True)
+        base.update(kw)
+        return hvd.DistributedOptimizer(optax.sgd(1.0), **base)
+
+    def test_policy_structure_and_tolerance(self, monkeypatch):
+        """State carries an EF row only for cooperative-policy groups;
+        the exact group's trajectory stays bitwise, the int8 group's
+        stays within wire tolerance (EF telescopes the drops)."""
+        from horovod_tpu.parallel.optimizer import _WireEF
+
+        stacked = _stacked_grads(21, self.SHAPES, integral=True)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        exact = _per_rank_updates(self._make(), params, stacked)
+        opt = self._make(monkeypatch)
+        state = opt.init(params)
+        assert isinstance(state.wire_ef, _WireEF)
+        kinds = sorted(
+            "ef" if r is not None else "exact" for r in state.wire_ef.rows)
+        assert kinds == ["ef", "exact"]
+        for r in state.wire_ef.rows:
+            if r is not None:
+                assert r.shape[0] == N and r.dtype == jnp.float32
+        got = _per_rank_updates(opt, params, stacked)
+        # Leaf order: the big (8,8) leaf is index 0, the (7,) leaf 1.
+        scale = float(np.abs(np.asarray(exact[0])).max())
+        np.testing.assert_allclose(np.asarray(got[0]),
+                                   np.asarray(exact[0]),
+                                   atol=scale * 5e-2)
+        np.testing.assert_array_equal(np.asarray(got[1]),
+                                      np.asarray(exact[1]))
+
+    def test_zero2_policy_ef_and_tolerance(self, monkeypatch):
+        """Stage 2 threads the SAME shard-local residual through every
+        pass's quantized reduce-scatter: rows present in the state, and
+        the windowed trajectory stays within wire tolerance of the
+        exact stage-2 run."""
+        from horovod_tpu.parallel.optimizer import _WireEF
+
+        k = 2
+        rng = np.random.RandomState(22)
+        stacked = [jnp.asarray(np.round(rng.randn(N, k * 2, *s) * 4),
+                               jnp.float32) for s in self.SHAPES]
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+
+        def run(opt):
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            def body(*xs):
+                state = opt.init(list(params))
+                p = list(params)
+                for j in range(k * 2):
+                    g = [x[0, j] for x in xs]
+                    u, state = opt.update(g, state, p)
+                    p = [pi + ui for pi, ui in zip(p, u)]
+                return p
+
+            sm = shard_map(
+                body, mesh=hvd.global_mesh(),
+                in_specs=tuple(P(hvd.GLOBAL_AXIS) for _ in stacked),
+                out_specs=P(), check_vma=False)
+            return jax.jit(sm)(*stacked)
+
+        exact = run(self._make(zero_stage=2, backward_passes_per_step=k))
+        opt = self._make(monkeypatch, zero_stage=2,
+                         backward_passes_per_step=k)
+        state = opt.init(params)
+        assert isinstance(state.wire_ef, _WireEF)
+        assert any(r is not None for r in state.wire_ef.rows)
+        got = run(opt)
+        scale = float(np.abs(np.asarray(exact[0])).max())
+        np.testing.assert_allclose(np.asarray(got[0]),
+                                   np.asarray(exact[0]),
+                                   atol=scale * 5e-2)
+        np.testing.assert_array_equal(np.asarray(got[1]),
+                                      np.asarray(exact[1]))
+
+    def test_reset_error_feedback_rezeroes(self, monkeypatch):
+        """wire.reset_error_feedback() (elastic reset, guard rollback)
+        invalidates the carried residual: before the reset a zero-grad
+        step still emits the stale correction on the int8 group; after
+        it (next trace) the update is exactly zero."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.ops import wire as wire_mod
+
+        mesh = hvd.global_mesh()
+        opt = self._make(monkeypatch)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        stacked = _stacked_grads(23, self.SHAPES)
+        zeros = [jnp.zeros_like(x) for x in stacked]
+        in_specs = tuple(P(hvd.GLOBAL_AXIS) for _ in self.SHAPES)
+
+        def step(*xs):
+            state = opt.init(list(params))
+            u, state = opt.update([x[0] for x in xs], state,
+                                  list(params))
+            del u
+            # Second step on ZERO grads: only the carried residual can
+            # produce a nonzero reduction.
+            u2, state = opt.update([jnp.zeros_like(x[0]) for x in xs],
+                                   state, list(params))
+            return u2
+
+        sm = shard_map(step, mesh=mesh, in_specs=in_specs,
+                       out_specs=P(), check_vma=False)
+        u2 = jax.jit(sm)(*stacked)
+        # Stale residual feeds the int8 group's second step.
+        assert float(np.abs(np.asarray(u2[0])).max()) > 0.0
+
+        gen0 = wire_mod.error_feedback_generation()
+        wire_mod.reset_error_feedback()
+        try:
+            assert wire_mod.error_feedback_generation() == gen0 + 1
+
+            def step_reset(*xs):
+                state = opt.init(list(params))
+                u, state = opt.update([x[0] for x in xs], state,
+                                      list(params))
+                del u
+                u2, state = opt.update(
+                    [jnp.zeros_like(x[0]) for x in xs], state,
+                    list(params))
+                return u2
+
+            # In production data_parallel's autotune key includes the
+            # EF generation, forcing this retrace; here a fresh closure
+            # stands in for it.  init() predates the reset relative to
+            # the state handed to update, so _fresh_ef must zero the
+            # stale rows...
+            sm2 = shard_map(step_reset, mesh=mesh, in_specs=in_specs,
+                            out_specs=P(), check_vma=False)
+            jax.jit(sm2)(*stacked)
+        finally:
+            pass
+
+    def test_reset_zeroes_carried_state_rows(self, monkeypatch):
+        """Directly pin _fresh_ef: a state whose wire_ef generation
+        predates the live one gets its rows ZEROED at the next traced
+        update, so the pre-reset correction never reaches the wire."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.ops import wire as wire_mod
+        from horovod_tpu.parallel.optimizer import _WireEF
+
+        mesh = hvd.global_mesh()
+        opt = self._make(monkeypatch)
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        stacked = _stacked_grads(24, self.SHAPES)
+        state = opt.init(params)
+        # Forge a stale-generation state with a LOUD nonzero residual.
+        forged = state._replace(wire_ef=_WireEF(
+            tuple(r if r is None else jnp.full_like(r, 64.0)
+                  for r in state.wire_ef.rows),
+            state.wire_ef.gen - 1))
+
+        def step(*xs):
+            u, _ = opt.update([jnp.zeros_like(x[0]) for x in xs],
+                              forged, list(params))
+            return u
+
+        sm = shard_map(step, mesh=mesh,
+                       in_specs=tuple(P(hvd.GLOBAL_AXIS)
+                                      for _ in self.SHAPES),
+                       out_specs=P(), check_vma=False)
+        u = jax.jit(sm)(*stacked)
+        # Stale rows were zeroed before the scatter: zero grads + zero
+        # residual = exactly zero updates despite the forged 64s.
+        for ui in u:
+            np.testing.assert_array_equal(
+                np.asarray(ui), np.zeros_like(np.asarray(ui)))
+        del wire_mod
+
+    def test_guard_gate_zeroes_ef_rows(self, monkeypatch):
+        """A flagged step's residual can carry the caught non-finites:
+        the guard gate must ZERO the wire_ef rows, not carry them."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from horovod_tpu.guard import DynamicLossScale
+
+        mesh = hvd.global_mesh()
+        opt = self._make(monkeypatch,
+                         guard=DynamicLossScale(init_scale=1.0, dynamic=False))
+        params = [jnp.zeros(s, jnp.float32) for s in self.SHAPES]
+        stacked = _stacked_grads(25, self.SHAPES)
+        bad = np.array(stacked[0])
+        bad[5] = np.nan
+        stacked[0] = jnp.asarray(bad)
+
+        def step(*xs):
+            state = opt.init(list(params))
+            u, state = opt.update([x[0] for x in xs], state,
+                                  list(params))
+            rows = tuple(r for r in state.wire_ef.rows if r is not None)
+            return u, rows
+
+        sm = shard_map(step, mesh=mesh,
+                       in_specs=tuple(P(hvd.GLOBAL_AXIS)
+                                      for _ in self.SHAPES),
+                       out_specs=P(), check_vma=False)
+        u, rows = jax.jit(sm)(*stacked)
+        for ui in u:
+            np.testing.assert_array_equal(
+                np.asarray(ui), np.zeros_like(np.asarray(ui)))
+        for r in rows:
+            np.testing.assert_array_equal(
+                np.asarray(r), np.zeros_like(np.asarray(r)))
+
+
+# ---------------------------------------------------------------------------
 # Fused computation-collective pipeline composed with the optimizer
 # paths (docs/FUSED_COLLECTIVES.md)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,309 @@
+"""ZeRO-3 parameter placement (parallel/zero3.py): shard-at-rest layout,
+just-in-time bucket gather in reverse-availability prefetch order, fused
+gather+matmul routing, and the loud re-init drift contract.
+
+The optimizer side of stage 3 is stage 2 (tests/test_optimizer.py
+TestZero2); this file covers the parameter residency half."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+N = 8
+
+# Three single-leaf shard groups under fusion_threshold_bytes=64: dict
+# flattening is key-sorted (b1, w1, w2), reverse-size bucket traversal
+# makes the partition [w2, w1, b1].
+PARAMS = {
+    "w1": jnp.arange(40, dtype=jnp.float32).reshape(8, 5),
+    "b1": jnp.arange(5, dtype=jnp.float32) * 0.5,
+    "w2": jnp.arange(16, dtype=jnp.float32).reshape(16, 1) * 2.0,
+}
+
+
+def _placement(**kw):
+    base = dict(fusion_threshold_bytes=64)
+    base.update(kw)
+    return hvd.zero3_placement(PARAMS, **base)
+
+
+def _gather_jit(pl, rows, specs=None):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body(rows):
+        t = pl.gather(rows)
+        return tuple(t[k] for k in sorted(t))
+
+    sm = shard_map(body, mesh=hvd.global_mesh(),
+                   in_specs=(specs if specs is not None else P(),),
+                   out_specs=P(), check_vma=False)
+    return jax.jit(sm)(rows)
+
+
+class TestShardGather:
+    def test_eager_roundtrip_bitwise(self):
+        pl = _placement()
+        rows = pl.shard(PARAMS)
+        assert all(r.shape == (N, g.shard_sz)
+                   for r, g in zip(rows, pl.groups))
+        back = pl.gather(rows)
+        for k, v in PARAMS.items():
+            np.testing.assert_array_equal(np.asarray(back[k]),
+                                          np.asarray(v))
+
+    def test_in_jit_gather_bitwise(self):
+        pl = _placement()
+        rows = pl.shard(PARAMS)
+        outs = _gather_jit(pl, rows)
+        for k, o in zip(sorted(PARAMS), outs):
+            np.testing.assert_array_equal(np.asarray(o),
+                                          np.asarray(PARAMS[k]))
+
+    def test_placed_rows_gather_bitwise(self):
+        """True sharding: rows placed with specs() hold (1, shard) per
+        chip; the in-jit gather reassembles the identical tree."""
+        from jax.sharding import NamedSharding
+
+        pl = _placement()
+        rows = pl.shard(PARAMS)
+        mesh = hvd.global_mesh()
+        placed = tuple(
+            jax.device_put(r, NamedSharding(mesh, s))
+            for r, s in zip(rows, pl.specs()))
+        outs = _gather_jit(pl, placed, specs=pl.specs())
+        for k, o in zip(sorted(PARAMS), outs):
+            np.testing.assert_array_equal(np.asarray(o),
+                                          np.asarray(PARAMS[k]))
+
+    def test_eager_gather_rejects_placed_rows(self):
+        from horovod_tpu.common.exceptions import HorovodTpuError
+
+        pl = _placement()
+        rows = pl.shard(PARAMS)
+        narrowed = tuple(r[:1] for r in rows)
+        with pytest.raises(HorovodTpuError, match="in-jit"):
+            pl.gather(narrowed)
+
+    def test_quantized_gather_tolerance_and_rank_identity(self):
+        """int8 gather wire: every rank decodes the SAME payload, so the
+        gathered params are bitwise-identical across ranks and within
+        wire tolerance of the exact values."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        pl = _placement(gather_wire="int8")
+        rows = pl.shard(PARAMS)
+
+        def body(rows):
+            t = pl.gather(rows)
+            # Stack each rank's gathered copy so the parent can compare
+            # all N replicas elementwise.
+            return tuple(t[k].ravel()[None] for k in sorted(t))
+
+        sm = shard_map(body, mesh=hvd.global_mesh(), in_specs=(P(),),
+                       out_specs=P(hvd.GLOBAL_AXIS), check_vma=False)
+        outs = jax.jit(sm)(rows)
+        for k, o in zip(sorted(PARAMS), outs):
+            per_rank = np.asarray(o)
+            assert per_rank.shape[0] == N
+            for r in range(1, N):
+                np.testing.assert_array_equal(per_rank[r], per_rank[0])
+            ref = np.asarray(PARAMS[k]).ravel()
+            atol = 0.05 * max(1.0, float(np.abs(ref).max()))
+            np.testing.assert_allclose(per_rank[0], ref, atol=atol)
+
+
+class TestPrefetchOrder:
+    def test_reverse_availability_default(self):
+        """The partition's first bucket holds the LAST layers (default
+        reverse bucket traversal), so the forward consumes back-to-front
+        — prefetch_order is the reversed partition order."""
+        pl = _placement()
+        assert pl.prefetch_order == tuple(
+            reversed(range(len(pl.groups))))
+        # Default reverse traversal: first group is the largest-index
+        # leaves; prefetch starts from the leaf-order front.
+        first = pl.groups[pl.prefetch_order[0]]
+        assert 0 in first.idxs
+
+    def test_permutation_order_is_permuted_reverse(self):
+        """Under bucket_order=<explicit permutation> the prefetch must
+        follow the PERMUTED reverse-availability order: the partition
+        honors the permutation, and prefetch_order reverses it rather
+        than falling back to the leaf order's reverse."""
+        from horovod_tpu.parallel.data_parallel import (
+            shard_group_partition,
+        )
+
+        leaves = list(jax.tree_util.tree_leaves(PARAMS))
+        perm = [1, 2, 0]
+        base = shard_group_partition(leaves, fusion_threshold_bytes=64,
+                                     bucket_order="forward")
+        assert len(base) == 3  # every leaf its own group at this cap
+
+        pl = _placement(bucket_order=perm)
+        got = [list(g.idxs) for g in pl.groups]
+        want = shard_group_partition(leaves, fusion_threshold_bytes=64,
+                                     bucket_order=perm)
+        assert got == [list(i) for i in want]
+        assert pl.prefetch_order == tuple(
+            reversed(range(len(pl.groups))))
+        # Issue order over GROUP indices realizes the permuted reverse:
+        # the last-formed bucket (permutation's tail) gathers first.
+        issue = [list(pl.groups[gi].idxs) for gi in pl.prefetch_order]
+        assert issue == list(reversed(got))
+        # And a roundtrip under the permutation stays bitwise.
+        rows = pl.shard(PARAMS)
+        back = pl.gather(rows)
+        for k, v in PARAMS.items():
+            np.testing.assert_array_equal(np.asarray(back[k]),
+                                          np.asarray(v))
+
+
+class TestApplyUpdates:
+    def test_compat_and_placed_agree(self):
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        pl = _placement()
+        rows = pl.shard(PARAMS)
+        ups = jax.tree_util.tree_map(lambda x: jnp.ones_like(x) * 0.25,
+                                     PARAMS)
+        compat = pl.apply_updates(rows, ups)
+        back = pl.gather(compat)
+        for k, v in PARAMS.items():
+            np.testing.assert_array_equal(np.asarray(back[k]),
+                                          np.asarray(v) + 0.25)
+
+        mesh = hvd.global_mesh()
+        placed = tuple(jax.device_put(r, NamedSharding(mesh, s))
+                       for r, s in zip(rows, pl.specs()))
+        sm = shard_map(lambda r, u: pl.apply_updates(r, u), mesh=mesh,
+                       in_specs=(pl.specs(), P()),
+                       out_specs=pl.specs(), check_vma=False)
+        placed_out = jax.jit(sm)(placed, ups)
+        for a, b in zip(compat, placed_out):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_eager_placed_apply_raises(self):
+        from horovod_tpu.common.exceptions import HorovodTpuError
+
+        pl = _placement()
+        rows = tuple(r[:1] for r in pl.shard(PARAMS))
+        ups = jax.tree_util.tree_map(jnp.zeros_like, PARAMS)
+        with pytest.raises(HorovodTpuError, match="in-jit"):
+            pl.apply_updates(rows, ups)
+
+
+class TestGatherMatmul:
+    def test_fused_gather_matmul(self):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        w = jnp.arange(32, dtype=jnp.float32).reshape(16, 2) * 0.125
+        pl = hvd.zero3_placement({"w": w})
+        rows = pl.shard({"w": w})
+        x = jnp.ones((3, 2), jnp.float32)
+
+        sm = shard_map(lambda r: pl.gather_matmul(x, r, 0),
+                       mesh=hvd.global_mesh(), in_specs=(P(),),
+                       out_specs=P(), check_vma=False)
+        out = jax.jit(sm)(rows)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w.T),
+                                   rtol=1e-6)
+
+    def test_multi_leaf_group_rejected(self):
+        pl = _placement(fusion_threshold_bytes=1 << 20)  # one big group
+        rows = pl.shard(PARAMS)
+        x = jnp.ones((2, 5), jnp.float32)
+        with pytest.raises(ValueError, match="single-2D-leaf"):
+            pl.gather_matmul(x, rows, 0)
+
+    def test_eager_rejected(self):
+        from horovod_tpu.common.exceptions import HorovodTpuError
+
+        w = jnp.ones((16, 2), jnp.float32)
+        pl = hvd.zero3_placement({"w": w})
+        rows = pl.shard({"w": w})
+        with pytest.raises(HorovodTpuError, match="in-jit"):
+            pl.gather_matmul(jnp.ones((3, 2), jnp.float32), rows, 0)
+
+
+class TestBytesAndDrift:
+    def test_resident_bytes_ratio(self):
+        pl = _placement()
+        total = sum(int(np.prod(v.shape)) for v in PARAMS.values()) * 4
+        assert pl.full_bytes == total
+        # 1/N plus at most one pad row per group.
+        assert pl.resident_bytes() <= total // N + 4 * len(pl.groups)
+        assert pl.resident_bytes() < pl.full_bytes / 4
+
+    def test_env_default_drift_raises(self, monkeypatch):
+        """A placement built on env-default tunables must raise when the
+        live fusion threshold moves under it (autotuner proposal)."""
+        pl = hvd.zero3_placement(PARAMS)
+        rows = pl.shard(PARAMS)
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "64")
+        with pytest.raises(ValueError, match="re-init"):
+            pl.gather(rows)
+
+    def test_explicit_threshold_immune_to_env(self, monkeypatch):
+        pl = _placement()
+        rows = pl.shard(PARAMS)
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(1 << 20))
+        back = pl.gather(rows)
+        for k, v in PARAMS.items():
+            np.testing.assert_array_equal(np.asarray(back[k]),
+                                          np.asarray(v))
+
+    def test_row_shape_drift_raises(self):
+        pl = _placement()
+        rows = pl.shard(PARAMS)
+        wrong = (rows[0][:, :-1],) + tuple(rows[1:])
+        with pytest.raises(ValueError, match="re-init"):
+            pl.gather(wrong)
+
+    def test_group_count_drift_raises(self):
+        pl = _placement()
+        rows = pl.shard(PARAMS)
+        with pytest.raises(ValueError, match="re-init"):
+            pl.gather(rows[:-1])
+
+    def test_param_resident_gauge_set(self):
+        from horovod_tpu.metrics import catalog as met
+
+        pl = _placement()
+        rows = pl.shard(PARAMS)
+        met.param_resident_bytes.set(0)
+        _gather_jit(pl, rows)
+        assert met.param_resident_bytes._solo().get() == \
+            pl.resident_bytes()
+
+
+class TestValidation:
+    def test_cooperative_wire_needs_flat_axis(self):
+        with pytest.raises(ValueError, match="ONE named axis"):
+            hvd.zero3_placement(PARAMS, axis_name=("dcn", "hvd"),
+                                gather_wire="int8")
+
+    def test_global_process_set_required(self):
+        ps = hvd.add_process_set([0, 2])
+        try:
+            with pytest.raises(ValueError, match="global process"):
+                hvd.zero3_placement(PARAMS, process_set=ps)
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_tree_mismatch_raises(self):
+        pl = _placement()
+        with pytest.raises(ValueError, match="tree"):
+            pl.shard({"other": jnp.zeros((3,), jnp.float32)})
+
+    def test_env_gather_wire(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_ZERO_GATHER_WIRE", "int8")
+        pl = _placement()
+        assert pl.gather_wire == "int8"
