@@ -377,6 +377,32 @@ CATALOG: Tuple[EnvVar, ...] = (
        "Hours before bench.py's cached last-known-good on-chip record "
        "is reported as stale instead of silently reused.",
        "BENCHMARKS.md"),
+    _v("HOROVOD_SERVE_PAGE_TOKENS", "16", "serve",
+       "KV-cache pool page size in tokens (autotuner knob "
+       "serve_page_tokens; compiled-shape key of the serving step).",
+       "SERVING.md"),
+    _v("HOROVOD_SERVE_MAX_BATCH", "8", "serve",
+       "Row count of the compiled continuous-batching decode step "
+       "(autotuner knob serve_max_batch).",
+       "SERVING.md"),
+    _v("HOROVOD_SERVE_POOL_PAGES", "0", "serve",
+       "KV pool size in pages; 0 = auto (max_batch full-length "
+       "sequences).",
+       "SERVING.md"),
+    _v("HOROVOD_SERVE_SLO_MS", "(unset)", "serve",
+       "Per-token p99 latency SLO in ms; when observed p99 exceeds it "
+       "the server flips speculative decoding on (unset/0 disables the "
+       "controller).",
+       "SERVING.md"),
+    _v("HOROVOD_SERVE_REPLICA_ID", "(set by ReplicaManager)", "serve",
+       "Replica index handed to each `python -m "
+       "horovod_tpu.serve.replica` worker by its manager (internal "
+       "spawn handshake, like the rendezvous address/port).",
+       "SERVING.md"),
+    _v("HOROVOD_SERVE_SPEC_GAMMA", "4", "serve",
+       "Speculative draft length per serving round (autotuner knob "
+       "serve_spec_gamma; compiled verify-chunk width).",
+       "SERVING.md"),
 )
 
 #: Literal prefixes that legitimately appear in code (startswith filters
@@ -390,7 +416,7 @@ PREFIXES: Dict[str, str] = {
 _COMPONENT_ORDER = (
     "topology", "launcher", "rendezvous", "elastic", "faults",
     "metrics", "timeline", "trace", "autotune", "guard", "ops",
-    "models", "bench",
+    "models", "serve", "bench",
 )
 
 _HEADER = """\

@@ -54,3 +54,12 @@ class TensorShapeMismatchError(HorovodTpuError):
 class DuplicateTensorNameError(HorovodTpuError):
     """Same tensor name submitted twice in one step (reference:
     controller.cc "Duplicate tensor name" semantic race detector)."""
+
+
+class InvalidRequestError(HorovodTpuError, ValueError):
+    """A caller handed the decode/serve stack an impossible request:
+    non-positive batch, max_len shorter than the prompt, a prompt
+    longer than the cache window, or a non-positive token budget.
+    Doubly inherits ValueError so pre-existing callers (and tests)
+    catching ValueError keep working while the serving layer can catch
+    the whole framework family via HorovodTpuError."""

@@ -91,6 +91,10 @@ CATALOG = {
         "Before a training step: err flips one mantissa bit of this "
         "rank's first parameter (silent replica divergence for the "
         "digest check to catch).",
+    "serve.replica_die":
+        "Each serving-replica work-loop iteration: exit kills the "
+        "replica process mid-stream (the manager's lease/respawn must "
+        "recover its in-flight sequences), err raises in the loop.",
 }
 
 _lock = threading.Lock()

@@ -202,6 +202,25 @@ digest_mismatch = _REG.counter(
     "Cross-replica parameter-digest mismatches detected (silent replica "
     "divergence, attributed to a bucket).")
 
+# -- serving (horovod_tpu/serve, docs/SERVING.md) ---------------------------
+serve_queue_depth = _REG.gauge(
+    "hvd_serve_queue_depth",
+    "Requests waiting for a batch row / KV pages (admission "
+    "back-pressure; sampled each server step).")
+serve_batch_occupancy = _REG.gauge(
+    "hvd_serve_batch_occupancy",
+    "Active rows / max_batch of the compiled serving decode step "
+    "(continuous batching keeps this near 1 under load).")
+serve_pool_pages_free = _REG.gauge(
+    "hvd_serve_pool_pages_free",
+    "Free pages in the paged KV-cache pool (0 = admissions stall until "
+    "an eviction returns pages).")
+serve_p99_ms = _REG.gauge(
+    "hvd_serve_p99_ms",
+    "Observed p99 per-token decode latency over the SLO controller's "
+    "sliding window (the signal that toggles speculative decoding "
+    "against HOROVOD_SERVE_SLO_MS).")
+
 _enabled = not util.env_bool("METRICS_DISABLE", False)
 
 

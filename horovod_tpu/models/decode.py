@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..common.exceptions import InvalidRequestError
 from ..parallel import sequence as seq_mod
 from .transformer import (
     TransformerConfig,
@@ -70,6 +71,10 @@ def init_decode_cache(cfg: TransformerConfig, batch: int,
     decode-side sibling of the int8/fp8 wire compression
     (ops/quantized.py).  The scales factor into the attention
     contractions; writes quantize one vector per step."""
+    if batch < 1:
+        raise InvalidRequestError(
+            f"batch must be >= 1, got {batch} (an empty cache would "
+            "fail silently at the first decode step)")
     if max_len < 1:
         raise ValueError(f"max_len must be >= 1, got {max_len}")
     # A cache SMALLER than the window is fine as long as the ring never
@@ -121,6 +126,43 @@ def _cache_write(c, val, slot):
     return lax.dynamic_update_slice(c, val, (0, slot, 0, 0))
 
 
+def _cache_write_rows(c, val, slots):
+    """Per-row variant of `_cache_write`: each batch row writes its
+    chunk at its OWN ring slot (`slots` [B] int32) — the vector-pos
+    decode path for continuously batched serving, where admitted
+    sequences sit at different depths of the same compiled step.
+    Writes the same bytes `_cache_write` would per row (quantization is
+    per-vector, data movement is exact), so scalar/vector parity is
+    bitwise when all rows share a position."""
+    if isinstance(c, dict):
+        q, scale = _quant_vec(val, c["q"].dtype)
+        wq = jax.vmap(
+            lambda b, v, s: lax.dynamic_update_slice(b, v, (s, 0, 0)))
+        ws = jax.vmap(
+            lambda b, v, s: lax.dynamic_update_slice(b, v, (s, 0)))
+        return {"q": wq(c["q"], q, slots),
+                "scale": ws(c["scale"], scale, slots)}
+    return jax.vmap(
+        lambda b, v, s: lax.dynamic_update_slice(b, v, (s, 0, 0)))(
+            c, val, slots)
+
+
+def _rope_rows(x, positions, theta: float):
+    """Rotary embedding with PER-ROW positions: x [B, c, H, Dh],
+    positions [B, c] int (`transformer._rope` is the shared-[T]
+    variant).  Same elementwise math row by row, so it matches _rope
+    bitwise whenever the rows agree."""
+    Dh = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, Dh, 2, dtype=jnp.float32) / Dh)
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(angles), jnp.sin(angles)        # [B, c, Dh/2]
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape)
+
+
 def _tree_idx(t, i):
     return jax.tree_util.tree_map(lambda a: a[i], t)
 
@@ -148,6 +190,13 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
     from the weights, not cfg, so tp shards just work).  Returns
     (x, ck, cv) with slots `pos % S .. (pos+c-1) % S` overwritten.
     Chunks with c > 1 must not wrap the ring (the c == 1 step may).
+
+    `pos` may be a SCALAR (all rows at the same depth — the classic
+    batch path) or a [B] VECTOR (each row at its own depth — the
+    continuous-batching serving path): rope angles, ring slots, and the
+    causal mask are then computed per row.  With equal entries the
+    vector path is bitwise-identical to the scalar path (same
+    elementwise ops, broadcast vs materialized operands).
     """
     dt = cfg.compute_dtype
     _shape_src = ck["q"] if isinstance(ck, dict) else ck
@@ -161,13 +210,21 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
     v = jnp.einsum("bod,dhk->bohk", h, lp["wv"].astype(dt))
     Hq, Hkv = q.shape[2], k.shape[2]
     g = Hq // Hkv
-    positions = pos + jnp.arange(c)                # [c]
-    q = _rope(q, positions, cfg.rope_theta).astype(dt)
-    k = _rope(k, positions, cfg.rope_theta).astype(dt)
-
-    slot = pos % S
-    ck = _cache_write(ck, k, slot)
-    cv = _cache_write(cv, v, slot)
+    pos = jnp.asarray(pos)
+    vec = pos.ndim == 1
+    if vec:
+        positions = pos[:, None] + jnp.arange(c)[None, :]   # [B, c]
+        q = _rope_rows(q, positions, cfg.rope_theta).astype(dt)
+        k = _rope_rows(k, positions, cfg.rope_theta).astype(dt)
+        ck = _cache_write_rows(ck, k, pos % S)
+        cv = _cache_write_rows(cv, v, pos % S)
+    else:
+        positions = pos + jnp.arange(c)                # [c]
+        q = _rope(q, positions, cfg.rope_theta).astype(dt)
+        k = _rope(k, positions, cfg.rope_theta).astype(dt)
+        slot = pos % S
+        ck = _cache_write(ck, k, slot)
+        cv = _cache_write(cv, v, slot)
 
     # Grouped attention against the ring: q [B,c,Hkv,g,Dh] x
     # cache [B,S,Hkv,Dh] — the repeated kv heads never materialize.
@@ -188,14 +245,26 @@ def _decode_layer(lp, ck, cv, x, pos, cfg: TransformerConfig,
     # query i (absolute pos+i) sees slots holding abs <= pos+i.  The
     # chunk's own keys were just written, so intra-chunk causality
     # falls out of the same comparison.
-    abs_pos = _slot_positions(pos + c - 1, S)           # [S]
-    q_pos = positions                                    # [c]
-    valid = (abs_pos[None, :] >= 0) & \
-        (abs_pos[None, :] <= q_pos[:, None])             # [c, S]
-    if cfg.attn_window:
-        valid = valid & ((q_pos[:, None] - abs_pos[None, :])
-                         < cfg.attn_window)
-    s = jnp.where(valid[None, None, None, :, :], s, -1e30)
+    if vec:
+        last = pos[:, None] + (c - 1)                    # [B, 1]
+        j = jnp.arange(S)[None, :]
+        abs_pos = last - ((last - j) % S)                # [B, S]
+        q_pos = positions                                # [B, c]
+        valid = (abs_pos[:, None, :] >= 0) & \
+            (abs_pos[:, None, :] <= q_pos[:, :, None])   # [B, c, S]
+        if cfg.attn_window:
+            valid = valid & ((q_pos[:, :, None] - abs_pos[:, None, :])
+                             < cfg.attn_window)
+        s = jnp.where(valid[:, None, None, :, :], s, -1e30)
+    else:
+        abs_pos = _slot_positions(pos + c - 1, S)        # [S]
+        q_pos = positions                                # [c]
+        valid = (abs_pos[None, :] >= 0) & \
+            (abs_pos[None, :] <= q_pos[:, None])         # [c, S]
+        if cfg.attn_window:
+            valid = valid & ((q_pos[:, None] - abs_pos[None, :])
+                             < cfg.attn_window)
+        s = jnp.where(valid[None, None, None, :, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     if isinstance(cv, dict):
         pv = p * cv["scale"].transpose(0, 2, 1)[:, :, None, None, :]
@@ -283,6 +352,11 @@ def transformer_decode_step(params: Dict, cache: Dict, tokens,
     window — the caller must keep the TOTAL sequence within `max_len`
     (older positions would be silently evicted otherwise; the
     generate/beam entry points enforce this via _resolve_max_len).
+
+    `cache["pos"]` may be a [B] VECTOR (per-row depths — the serving
+    pool's continuous-batching view, see horovod_tpu/serve/pool.py);
+    the step then ropes/writes/masks per row and advances every entry
+    by one.
     """
     dt = cfg.compute_dtype
     x = params["embed"][tokens].astype(dt)[:, None, :]    # [B,1,D]
@@ -325,15 +399,20 @@ def transformer_extend(params: Dict, cache: Dict, tokens,
     S = (_ck0["q"] if isinstance(_ck0, dict) else _ck0).shape[2]
     pos = cache["pos"]
     if not isinstance(pos, jax.core.Tracer):
-        if int(pos) % S + c > S:
+        # Vector pos (per-row serving depths): every row must fit — the
+        # wrap guard checks the worst slot, the window guard the
+        # deepest row.
+        pos_np = np.asarray(pos).reshape(-1)
+        pmax = int(pos_np.max())
+        if int((pos_np % S).max()) + c > S:
             raise ValueError(
-                f"extend chunk of {c} tokens at pos {int(pos)} would "
+                f"extend chunk of {c} tokens at pos {pmax} would "
                 f"wrap the ring (max_len {S}); split the chunk or size "
                 f"the cache larger")
-        if cfg.attn_window and int(pos) >= S:
+        if cfg.attn_window and pmax >= S:
             raise ValueError(
                 f"extend on a wrapped windowed ring (attn_window "
-                f"{cfg.attn_window}, pos {int(pos)} >= max_len {S}) "
+                f"{cfg.attn_window}, pos {pmax} >= max_len {S}) "
                 "would silently drop still-in-window keys for the "
                 "chunk's earlier queries; decode token-by-token with "
                 "transformer_decode_step past max_len")
@@ -631,10 +710,15 @@ def transformer_prefill(params: Dict, cache: Dict, prompt,
     (pos == 0) and T0 <= max_len."""
     dt = cfg.compute_dtype
     B, T0 = prompt.shape
+    if B < 1 or T0 < 1:
+        raise InvalidRequestError(
+            f"prompt must be non-empty, got shape {(B, T0)} (an empty "
+            "prefill would silently leave the cache desynced)")
     _ck0 = cache["k"]
     S = (_ck0["q"] if isinstance(_ck0, dict) else _ck0).shape[2]
     if T0 > S:
-        raise ValueError(f"prompt length {T0} > cache max_len {S}")
+        raise InvalidRequestError(
+            f"prompt length {T0} > cache max_len {S}")
     # Prefill writes the prompt at slot 0; a warm cache (pos != 0)
     # would silently desync slot <-> absolute-position bookkeeping.
     # Enforce eagerly whenever pos is concrete (inside jit pos is a
@@ -684,12 +768,12 @@ def _resolve_max_len(cfg, T0, max_new_tokens, max_len):
     max_len = max_len or (T0 + max_new_tokens)
     if T0 + max_new_tokens > max_len:
         if not cfg.attn_window:
-            raise ValueError(
+            raise InvalidRequestError(
                 f"max_len {max_len} < prompt {T0} + new "
                 f"{max_new_tokens} (only windowed configs may roll "
                 f"the cache)")
         if max_len < cfg.attn_window:
-            raise ValueError(
+            raise InvalidRequestError(
                 f"max_len {max_len} < attn_window {cfg.attn_window} "
                 f"and the sequence ({T0} + {max_new_tokens} tokens) "
                 f"wraps the ring: positions still inside the band "
@@ -729,7 +813,18 @@ def transformer_generate(params: Dict, cfg: TransformerConfig, prompt,
     `max_len` defaults to T0 + max_new_tokens; with `cfg.attn_window`
     it may be as small as max(window, T0) — the ring rolls."""
     B, T0 = prompt.shape
+    if B < 1 or T0 < 1:
+        raise InvalidRequestError(
+            f"prompt must be non-empty, got shape {(B, T0)}")
+    if max_new_tokens < 1:
+        raise InvalidRequestError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens} (a "
+            "zero-length scan would silently return an empty batch)")
     max_len = _resolve_max_len(cfg, T0, max_new_tokens, max_len)
+    if max_len < T0:
+        raise InvalidRequestError(
+            f"max_len {max_len} < prompt length {T0}: the prefill "
+            "would overrun the ring before the first generated token")
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature and rng is None:

@@ -1,0 +1,26 @@
+"""Continuous-batching inference serving over the decode stack.
+
+Layout (docs/SERVING.md):
+  - pool.py      paged KV-cache pool (PagedKVPool, PoolExhaustedError)
+  - scheduler.py per-step admit/evict scheduler (ContinuousScheduler)
+  - slo.py       SLO-aware speculative-decode toggling (SloController)
+  - server.py    the decode loop tying them together (InferenceServer)
+  - loadgen.py   seeded load generator + bench stats (make_trace, ...)
+  - replica.py   elastic multi-replica serving (ReplicaManager)
+"""
+
+from .pool import PagedKVPool, PoolExhaustedError
+from .scheduler import ActiveSeq, ContinuousScheduler, POLICIES, Request
+from .server import InferenceServer
+from .slo import SloController
+
+__all__ = [
+    "ActiveSeq",
+    "ContinuousScheduler",
+    "InferenceServer",
+    "POLICIES",
+    "PagedKVPool",
+    "PoolExhaustedError",
+    "Request",
+    "SloController",
+]

@@ -1,0 +1,171 @@
+"""Seeded load generation and bench accounting for the serving stack.
+
+``make_trace`` produces a DETERMINISTIC mixed-length request trace —
+(arrival_step, prompt, max_new_tokens) tuples from a seeded RNG over a
+few discrete prompt lengths (discrete so the prefill jit compiles a
+handful of programs, not one per request).  ``run_trace`` replays the
+trace against an ``InferenceServer``, submitting each request when the
+server's step clock reaches its arrival, and returns the stats record
+the benches serialize into BENCH_serve.json.
+
+The same trace replayed against ``policy="fifo"`` and
+``policy="static"`` servers is the continuous-vs-static A/B: identical
+requests, identical kernels, identical pool — only the admission
+policy differs.
+
+BENCH_serve.json is JSON-lines (one record per bench run, newest
+last).  ``read_latest_record`` applies the same staleness gate as
+bench.py: a previous record older than HOROVOD_BENCH_CACHE_MAX_AGE_H
+hours is surfaced with ``stale=True`` and a WARNING instead of being
+silently trusted for comparisons.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.exceptions import InvalidRequestError
+from .server import InferenceServer
+
+logger = logging.getLogger("horovod_tpu.serve.loadgen")
+
+Trace = List[Tuple[int, np.ndarray, int]]
+
+
+def make_trace(seed: int, n_requests: int, vocab_size: int,
+               prompt_lens: Tuple[int, ...] = (8, 16, 32),
+               max_new_lo: int = 8, max_new_hi: int = 64,
+               long_frac: float = 0.0, long_lo: int = 0,
+               long_hi: int = 0,
+               arrival_every: float = 2.0) -> Trace:
+    """Mixed-length trace: request i arrives at step
+    ``round(i * arrival_every)`` with a seeded prompt length and token
+    budget.  Pure function of its arguments — replaying the same seed
+    gives byte-identical traces (the determinism anchor for the
+    scheduler tests and the A/B bench).
+
+    ``long_frac`` > 0 makes the budget distribution BIMODAL: that
+    fraction of requests draws from [long_lo, long_hi] instead — the
+    realistic serving mix (mostly short answers, a tail of long
+    generations) where wave batching wastes the most, because one long
+    request pins every row of its wave."""
+    if n_requests < 1:
+        raise InvalidRequestError(
+            f"n_requests must be >= 1, got {n_requests}")
+    if not 0.0 <= long_frac <= 1.0:
+        raise InvalidRequestError(
+            f"long_frac must be in [0, 1], got {long_frac}")
+    rng = np.random.RandomState(seed)
+    trace: Trace = []
+    for i in range(n_requests):
+        T0 = int(rng.choice(prompt_lens))
+        if long_frac and rng.random_sample() < long_frac:
+            mn = int(rng.randint(long_lo, long_hi + 1))
+        else:
+            mn = int(rng.randint(max_new_lo, max_new_hi + 1))
+        prompt = rng.randint(0, vocab_size, size=T0).astype(np.int32)
+        trace.append((int(round(i * arrival_every)), prompt, mn))
+    return trace
+
+
+def run_trace(server: InferenceServer, trace: Trace,
+              max_steps: int = 200000) -> Dict:
+    """Replay a trace to completion; returns the stats record."""
+    pending = sorted(range(len(trace)), key=lambda i: trace[i][0])
+    peak_util = 0.0
+    t0 = time.perf_counter()
+    steps = 0
+    while steps < max_steps:
+        while pending and trace[pending[0]][0] <= server.step_no:
+            _, prompt, mn = trace[pending.pop(0)]
+            server.submit(prompt, mn)
+        if not pending and server.sched.drained():
+            break
+        server.step()
+        peak_util = max(peak_util, server.pool.utilization())
+        steps += 1
+    if pending or not server.sched.drained():
+        raise InvalidRequestError(
+            f"trace did not drain within {max_steps} steps")
+    wall_s = time.perf_counter() - t0
+    return server_stats(server, wall_s, peak_util)
+
+
+def _pct(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    return float(np.percentile(np.asarray(xs), q))
+
+
+def server_stats(server: InferenceServer, wall_s: float,
+                 peak_util: float, n_chips: int = 1) -> Dict:
+    return {
+        "wall_s": wall_s,
+        "device_steps": server.device_steps,
+        "spec_steps": server.spec_steps,
+        "tokens_out": server.tokens_out,
+        "tokens_per_sec_per_chip":
+            server.tokens_out / wall_s / max(1, n_chips) if wall_s else 0.0,
+        "request_p50_ms": _pct(server.request_latencies_ms, 50),
+        "request_p99_ms": _pct(server.request_latencies_ms, 99),
+        "token_p50_ms": _pct(server.token_latencies_ms, 50),
+        "token_p99_ms": _pct(server.token_latencies_ms, 99),
+        "batch_occupancy_mean": server.occupancy_mean(),
+        "kv_pool_peak_utilization": peak_util,
+        "slo_decisions": list(server.slo.decisions),
+    }
+
+
+# -- BENCH_serve.json --------------------------------------------------------
+
+CACHE_MAX_AGE_H = float(
+    os.environ.get("HOROVOD_BENCH_CACHE_MAX_AGE_H", "24"))
+
+
+def append_record(path: str, record: Dict) -> Dict:
+    """Stamp provenance onto ``record`` and append it as one JSON line."""
+    now = time.time()
+    record = dict(record)
+    record["captured_unix"] = now
+    record["captured_utc"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(now))
+    with open(path, "a") as f:
+        f.write(json.dumps(record, sort_keys=True) + "\n")
+    return record
+
+
+def read_latest_record(path: str) -> Optional[Dict]:
+    """Newest record from a JSON-lines bench file, with the staleness
+    gate applied: records older than HOROVOD_BENCH_CACHE_MAX_AGE_H get
+    ``stale=True`` + ``stale_hours`` and log a WARNING, so a rotted
+    baseline can't silently anchor a regression comparison."""
+    if not os.path.exists(path):
+        return None
+    last = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                last = line
+    if last is None:
+        return None
+    rec = json.loads(last)
+    age_h = (time.time() - rec.get("captured_unix", 0.0)) / 3600.0
+    rec["stale_hours"] = age_h
+    rec["stale"] = age_h > CACHE_MAX_AGE_H
+    if rec["stale"]:
+        logger.warning(
+            "bench record in %s is %.1fh old (> %.1fh gate) — treat "
+            "comparisons against it as stale", path, age_h,
+            CACHE_MAX_AGE_H)
+    return rec
+
+
+__all__ = ["Trace", "append_record", "make_trace", "read_latest_record",
+           "run_trace", "server_stats"]

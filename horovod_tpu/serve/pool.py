@@ -1,0 +1,311 @@
+"""Paged KV-cache pool: the serving engine's memory allocator.
+
+A contiguous decode cache ties a sequence's KV bytes to its batch row
+for the whole generation — finished sequences hold pages until the
+batch drains.  The pool breaks that coupling (the vLLM PagedAttention
+idea, applied to this repo's ring-decode cache): one fixed-size page
+table per model, page = ``page_tokens`` tokens x layers x kv-heads,
+carved out of the SAME ``init_decode_cache`` storage (so the int8 /
+fp8_e4m3 quantized layouts ride along unchanged), with per-sequence
+page lists and LIFO alloc/free on admit/evict.
+
+The decode kernels never see pages.  ``gather`` materializes the
+active set's pages into a ``[L, B, view_tokens, Hkv, Dh]`` view — the
+exact shape ``transformer_decode_step`` already takes — and
+``scatter_slots`` copies the one ring slot each step writes back into
+the owning page.  Both are pure data movement (no arithmetic), which
+is why pooled decode is BITWISE-equal to contiguous-cache decode: the
+step consumes identical bytes either way
+(tests/test_serve.py::test_pooled_decode_bitwise_equal).
+
+Amortization contract (see docs/SERVING.md): the view is rebuilt only
+on MEMBERSHIP change (admit/evict); steady-state steps pay one
+written-slot scatter per active row.  The pool stays the source of
+truth, so replica handoff and bitwise replay need no view state.
+
+The page-table bookkeeping (free stack, page lists) is host-side
+Python; the data movement itself runs as small jitted kernels (one
+compiled program per shape signature, pool buffers donated) because
+op-by-op eager dispatch of the per-step scatter dominated the serving
+step on small models.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.exceptions import HorovodTpuError, InvalidRequestError
+from ..models.decode import init_decode_cache
+
+
+# -- jitted data-movement kernels -------------------------------------------
+# Each is ONE compiled program per shape signature (the eager op-by-op
+# versions cost 4-8 dispatches per step, which dominated the serving
+# step on small models).  Pool buffers are donated: the caller always
+# rebinds self.k/self.v to the result, and serving pools are the
+# biggest buffers on the chip — double-buffering them per step would
+# halve the page budget.
+
+
+def _each(kv, f):
+    """Apply f to a plain cache array or to both halves of a quantized
+    {"q", "scale"} dict (payload and scale move together untouched)."""
+    if isinstance(kv, dict):
+        return {"q": f(kv["q"], False), "scale": f(kv["scale"], True)}
+    return f(kv, False)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _zero_pages_jit(pool_kv, idx):
+    k, v = pool_kv
+    return (_each(k, lambda c, _s: c.at[:, idx].set(0)),
+            _each(v, lambda c, _s: c.at[:, idx].set(0)))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_slots_jit(pool_kv, view_kv, pids, offs, rows, slots):
+    k, v = pool_kv
+    vk, vv = view_kv
+
+    def one(pool_c, view_c):
+        return _each(pool_c, lambda c, scale: c.at[:, pids, offs].set(
+            (view_c["scale"] if scale else
+             view_c["q"] if isinstance(view_c, dict) else
+             view_c)[:, rows, slots]))
+
+    return one(k, vk), one(v, vv)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(3,))
+def _scatter_pages_jit(pool_kv, cache_kv, idx, n_pages):
+    k, v = pool_kv
+    ck, cv = cache_kv
+
+    def one(pool_c, c):
+        def f(pc, scale):
+            src = (c["scale"] if scale else
+                   c["q"] if isinstance(c, dict) else c)
+            src = src[:, 0].reshape(src.shape[0], n_pages, -1,
+                                    *src.shape[3:])
+            return pc.at[:, idx].set(src)
+        return _each(pool_c, f)
+
+    return one(k, ck), one(v, cv)
+
+
+@jax.jit
+def _gather_jit(pool_kv, idx):
+    k, v = pool_kv
+
+    def one(pool_c):
+        def f(c, _s):
+            g = c[:, idx]                # [L, B, Vp, pt, ...]
+            return g.reshape(g.shape[0], g.shape[1], -1, *g.shape[4:])
+        return _each(pool_c, f)
+
+    return one(k), one(v)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _gather_rows_jit(view_kv, pool_kv, idx, rows):
+    vk, vv = view_kv
+    k, v = pool_kv
+
+    def one(view_c, pool_c):
+        def f(vc, scale):
+            src = (pool_c["scale"] if scale else
+                   pool_c["q"] if isinstance(pool_c, dict) else pool_c)
+            g = src[:, idx]              # [L, n, Vp, pt, ...]
+            return vc.at[:, rows].set(
+                g.reshape(g.shape[0], g.shape[1], -1, *g.shape[4:]))
+        return _each(view_c, f)
+
+    return one(vk, k), one(vv, v)
+
+
+class PoolExhaustedError(HorovodTpuError):
+    """Admission asked for more KV pages than the pool has free.  The
+    scheduler treats this as back-pressure (the request waits in the
+    queue), not as a crash."""
+
+
+class PagedKVPool:
+    """Fixed-size page table over ``init_decode_cache`` storage.
+
+    Storage layout: ``k``/``v`` are the plain decode-cache arrays with
+    the BATCH axis reinterpreted as the PAGE axis —
+    ``[L, total_pages, page_tokens, Hkv, Dh]`` (quantized variants are
+    the same ``{"q", "scale"}`` dicts).  A sequence's logical ring of
+    ``n`` tokens maps to ``ceil(n / page_tokens)`` pages; slot ``s``
+    lives at ``(pages[s // page_tokens], s % page_tokens)``.
+    """
+
+    def __init__(self, cfg, total_pages: int, page_tokens: int,
+                 quantize: Optional[str] = None):
+        if total_pages < 1:
+            raise InvalidRequestError(
+                f"total_pages must be >= 1, got {total_pages}")
+        if page_tokens < 1:
+            raise InvalidRequestError(
+                f"page_tokens must be >= 1, got {page_tokens}")
+        store = init_decode_cache(cfg, total_pages, page_tokens,
+                                  quantize=quantize)
+        self.k = store["k"]
+        self.v = store["v"]
+        self.cfg = cfg
+        self.total_pages = total_pages
+        self.page_tokens = page_tokens
+        self.quantize = quantize
+        # LIFO free stack: page 0 at the top so a fresh pool allocates
+        # 0, 1, 2, ... — deterministic reuse order for the tests.
+        self._free: List[int] = list(range(total_pages - 1, -1, -1))
+        self.pages: Dict[int, List[int]] = {}
+
+    # -- accounting ----------------------------------------------------
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_tokens)
+
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    def utilization(self) -> float:
+        return 1.0 - len(self._free) / self.total_pages
+
+    def can_alloc(self, n_tokens: int) -> bool:
+        return self.pages_needed(n_tokens) <= len(self._free)
+
+    # -- alloc / free ---------------------------------------------------
+
+    def alloc(self, seq_id: int, n_tokens: int) -> List[int]:
+        """Allocate (and zero) enough pages for ``n_tokens`` ring slots.
+
+        Zeroing on alloc, not on free, keeps eviction O(1) and makes a
+        freshly gathered view bitwise-equal to a fresh contiguous
+        cache — the parity anchor the serve tests pin."""
+        if seq_id in self.pages:
+            raise InvalidRequestError(
+                f"sequence {seq_id} already holds pages "
+                f"{self.pages[seq_id]}")
+        need = self.pages_needed(n_tokens)
+        if need > len(self._free):
+            raise PoolExhaustedError(
+                f"need {need} pages for {n_tokens} tokens, only "
+                f"{len(self._free)}/{self.total_pages} free")
+        pids = [self._free.pop() for _ in range(need)]
+        self._zero_pages(pids)
+        self.pages[seq_id] = pids
+        return pids
+
+    def free(self, seq_id: int) -> List[int]:
+        """Return a sequence's pages to the free stack (on evict/EOS)."""
+        try:
+            pids = self.pages.pop(seq_id)
+        except KeyError:
+            raise InvalidRequestError(
+                f"sequence {seq_id} holds no pages") from None
+        # Reversed so the most-recently-used page sits on top and the
+        # next alloc reuses it first (cache-warm, deterministic).
+        self._free.extend(reversed(pids))
+        return pids
+
+    def _zero_pages(self, pids: Sequence[int]) -> None:
+        idx = jnp.asarray(list(pids), jnp.int32)
+        self.k, self.v = _zero_pages_jit((self.k, self.v), idx)
+
+    # -- view gather / scatter -----------------------------------------
+
+    def gather(self, seq_ids: Sequence[Optional[int]],
+               view_pages: int) -> Tuple:
+        """Materialize the active rows' pages as a contiguous decode
+        view ``[L, B, view_pages * page_tokens, Hkv, Dh]``.
+
+        ``seq_ids[b] is None`` marks an idle row; idle rows (and the
+        tail of short page lists) index page 0 — never READ, because
+        the ring's absolute-position mask hides slots past each row's
+        ``pos``, and never WRITTEN BACK, because ``scatter_slots`` only
+        runs over active rows."""
+        idx = np.zeros((len(seq_ids), view_pages), np.int32)
+        for b, sid in enumerate(seq_ids):
+            if sid is None:
+                continue
+            pids = self.pages[sid]
+            if len(pids) > view_pages:
+                raise InvalidRequestError(
+                    f"sequence {sid} holds {len(pids)} pages > view "
+                    f"capacity {view_pages}")
+            idx[b, :len(pids)] = pids
+        return _gather_jit((self.k, self.v), jnp.asarray(idx))
+
+    def gather_rows(self, view_k, view_v,
+                    row_sids: Sequence[Tuple[int, int]],
+                    view_pages: int) -> Tuple:
+        """Refresh only the given (row, seq_id) pairs of an EXISTING
+        view — the admit-time fast path.  Rows whose sequence was
+        evicted need no refresh at all (their stale view bytes are
+        masked off and never scattered back), so steady-state
+        continuous batching pays one small row update per ADMISSION,
+        not a full pool gather per membership change."""
+        if not row_sids:
+            return view_k, view_v
+        idx = np.zeros((len(row_sids), view_pages), np.int32)
+        rows = []
+        for i, (row, sid) in enumerate(row_sids):
+            rows.append(row)
+            pids = self.pages[sid]
+            if len(pids) > view_pages:
+                raise InvalidRequestError(
+                    f"sequence {sid} holds {len(pids)} pages > view "
+                    f"capacity {view_pages}")
+            idx[i, :len(pids)] = pids
+        return _gather_rows_jit(
+            (view_k, view_v), (self.k, self.v), jnp.asarray(idx),
+            jnp.asarray(rows, jnp.int32))
+
+    def _slot_coords(self, seq_ids: Sequence[int],
+                     slots: Sequence[int]) -> Tuple[jax.Array, jax.Array]:
+        pt = self.page_tokens
+        pids, offs = [], []
+        for sid, s in zip(seq_ids, slots):
+            pids.append(self.pages[sid][s // pt])
+            offs.append(s % pt)
+        return jnp.asarray(pids, jnp.int32), jnp.asarray(offs, jnp.int32)
+
+    def scatter_slots(self, view_k, view_v, seq_ids: Sequence[int],
+                      rows: Sequence[int],
+                      slots: Sequence[int]) -> None:
+        """Copy ONE written ring slot per active row from the view back
+        into the owning page: row ``rows[i]`` (sequence ``seq_ids[i]``)
+        wrote view slot ``slots[i]`` this step.  Exact copy — the
+        quantized payload and its scale move together untouched."""
+        if not seq_ids:
+            return
+        pids, offs = self._slot_coords(seq_ids, slots)
+        self.k, self.v = _scatter_slots_jit(
+            (self.k, self.v), (view_k, view_v), pids, offs,
+            jnp.asarray(list(rows), jnp.int32),
+            jnp.asarray(list(slots), jnp.int32))
+
+    def scatter_pages(self, seq_id: int, cache_k, cache_v) -> None:
+        """Install a freshly prefilled contiguous cache (batch 1, ring
+        length EXACTLY this sequence's page budget) into its pages —
+        the admit-time bulk write."""
+        pids = self.pages[seq_id]
+        pt = self.page_tokens
+        ring = (cache_k["q"] if isinstance(cache_k, dict)
+                else cache_k).shape[2]
+        if ring != len(pids) * pt:
+            raise InvalidRequestError(
+                f"prefill cache ring {ring} != page budget "
+                f"{len(pids) * pt} of sequence {seq_id}")
+        self.k, self.v = _scatter_pages_jit(
+            (self.k, self.v), (cache_k, cache_v),
+            jnp.asarray(pids, jnp.int32), len(pids))
+
+
+__all__ = ["PagedKVPool", "PoolExhaustedError"]

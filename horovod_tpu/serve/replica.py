@@ -1,0 +1,302 @@
+"""Elastic multi-replica serving: lease/respawn over inference replicas.
+
+Training already has the machinery (runner/elastic/): value-change
+heartbeat leases detect hung-but-alive workers, WorkerStateRegistry
+accumulates strikes and blacklists hosts, the driver respawns with
+backoff.  Serving reuses exactly those pieces — only the unit of
+recovery changes: not a training generation, but the set of IN-FLIGHT
+SEQUENCES a dead replica was decoding.
+
+Topology: the manager runs a RendezvousServer (the same control plane
+the launcher uses) and spawns N ``python -m horovod_tpu.serve.replica``
+worker processes.  All coordination is KV keys:
+
+  serve/config              model + server spec, JSON (manager -> all)
+  serve/assign/<rid>/<req>  request payload, JSON (manager -> replica)
+  serve/result/<req>        generated tokens, JSON (replica -> manager)
+  serve/heartbeat/<rid>     incrementing counter (replica liveness)
+  serve/stop                set to drain and exit every replica
+
+Failure model: a replica dies (crash, or the ``serve.replica_die``
+fault point — docs/FAULT_TOLERANCE.md) or its heartbeat VALUE stops
+changing for ``lease_ttl`` seconds.  The manager records the strike,
+reassigns every request the dead replica had not yet finished to the
+live replicas, and respawns the process unless the registry has
+blacklisted it.  Replicas build their weights deterministically from
+the config seed and decode greedily, so a recovered sequence's tokens
+are IDENTICAL to the no-fault run — redelivery is idempotent
+(tests/test_serve.py::TestReplicaElastic).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Set
+
+from .. import faults as _faults
+from ..common.exceptions import HorovodTpuError, InvalidRequestError
+from ..metrics import catalog as _met
+from ..runner.elastic.registration import WorkerStateRegistry
+from ..runner.rendezvous import RendezvousClient, RendezvousServer
+
+logger = logging.getLogger("horovod_tpu.serve.replica")
+
+
+class ReplicaManager:
+    """Spawns, monitors, and heals a fleet of serving replicas."""
+
+    def __init__(self, n_replicas: int, config: Dict, *,
+                 lease_ttl: float = 5.0, respawn_backoff: float = 0.5,
+                 failure_threshold: int = 3,
+                 child_env: Optional[Dict[str, str]] = None):
+        if n_replicas < 1:
+            raise InvalidRequestError(
+                f"n_replicas must be >= 1, got {n_replicas}")
+        self.n_replicas = n_replicas
+        self.config = config
+        self.lease_ttl = lease_ttl
+        self.respawn_backoff = respawn_backoff
+        self.child_env = dict(child_env or {})
+        self.registry = WorkerStateRegistry(
+            failure_threshold=failure_threshold)
+        self.server = RendezvousServer()
+        self.port = self.server.start(0)
+        self.kv = self.server.kv()
+        self.kv.put("serve/config", json.dumps(config))
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.assigned: Dict[int, Set[int]] = {
+            r: set() for r in range(n_replicas)}
+        self.results: Dict[int, List[int]] = {}
+        self._requests: Dict[int, Dict] = {}
+        self._next_req = 0
+        self._rr = 0
+        self._hb_last: Dict[int, Optional[str]] = {}
+        self._hb_deadline: Dict[int, float] = {}
+        self._down: Set[int] = set()
+        self._respawns = 0
+        for r in range(n_replicas):
+            self._spawn(r)
+
+    # -- process control -----------------------------------------------
+
+    def _host(self, rid: int) -> str:
+        return f"replica{rid}"
+
+    def _spawn(self, rid: int) -> None:
+        env = dict(os.environ)
+        env.update(self.child_env)
+        env.update({
+            "HOROVOD_RENDEZVOUS_ADDR": "127.0.0.1",
+            "HOROVOD_RENDEZVOUS_PORT": str(self.port),
+            "HOROVOD_SECRET_KEY": self.server.secret,
+            "HOROVOD_SERVE_REPLICA_ID": str(rid),
+            "HOROVOD_HOSTNAME": self._host(rid),
+        })
+        self.procs[rid] = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.serve.replica"], env=env)
+        self._hb_last[rid] = None
+        self._hb_deadline[rid] = time.time() + self.lease_ttl \
+            + self.lease_ttl  # start grace: first beat needs model init
+        logger.info("replica %d spawned (pid %d)", rid,
+                    self.procs[rid].pid)
+
+    # -- request intake ------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        req_id = self._next_req
+        self._next_req += 1
+        payload = {"prompt": [int(t) for t in prompt],
+                   "max_new_tokens": int(max_new_tokens)}
+        self._requests[req_id] = payload
+        live = [r for r in range(self.n_replicas)
+                if not self.registry.is_blacklisted(self._host(r))]
+        if not live:
+            raise HorovodTpuError("no live serving replicas left")
+        rid = live[self._rr % len(live)]
+        self._rr += 1
+        self._assign(rid, req_id)
+        return req_id
+
+    def _assign(self, rid: int, req_id: int) -> None:
+        self.assigned[rid].add(req_id)
+        self.kv.put(f"serve/assign/{rid}/{req_id}",
+                    json.dumps(self._requests[req_id]))
+
+    # -- failure detection / healing -----------------------------------
+
+    def _check_replica(self, rid: int, now: float) -> Optional[str]:
+        """Returns a failure reason or None if the replica is healthy."""
+        proc = self.procs[rid]
+        code = proc.poll()
+        if code is not None:
+            return f"exited with code {code}"
+        hb = self.kv.get(f"serve/heartbeat/{rid}")
+        if hb != self._hb_last[rid] and hb is not None:
+            self._hb_last[rid] = hb
+            self._hb_deadline[rid] = now + self.lease_ttl
+        elif now > self._hb_deadline[rid]:
+            if _met.enabled():
+                _met.worker_lease_expired.inc()
+            return (f"heartbeat lease expired "
+                    f"({self.lease_ttl:.1f}s without a value change)")
+        return None
+
+    def _heal(self, rid: int, why: str) -> None:
+        logger.warning("replica %d FAILED: %s", rid, why)
+        proc = self.procs[rid]
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        self.registry.record_failure(self._host(rid), 0, why)
+        unfinished = {r for r in self.assigned[rid]
+                      if r not in self.results}
+        self.assigned[rid] = set()
+        live = [r for r in range(self.n_replicas)
+                if r != rid
+                and not self.registry.is_blacklisted(self._host(r))
+                and self.procs[r].poll() is None]
+        for i, req_id in enumerate(sorted(unfinished)):
+            if not live:
+                break
+            new_rid = live[i % len(live)]
+            logger.info("request %d reassigned: replica %d -> %d",
+                        req_id, rid, new_rid)
+            self._assign(new_rid, req_id)
+        if self.registry.is_blacklisted(self._host(rid)):
+            logger.warning("replica %d blacklisted — not respawning",
+                           rid)
+            self._down.add(rid)
+            if not live and unfinished:
+                raise HorovodTpuError(
+                    f"{len(unfinished)} requests stranded: every "
+                    f"replica is dead or blacklisted")
+            return
+        time.sleep(self.respawn_backoff * (2 ** min(self._respawns, 4)))
+        self._respawns += 1
+        if _met.enabled():
+            _met.worker_respawns.inc()
+        self._spawn(rid)
+        # A respawned replica reloads weights from the seed and replays
+        # any still-assigned requests — hand its old unserved ones back.
+        for req_id in sorted(unfinished):
+            if not live:
+                self._assign(rid, req_id)
+
+    # -- completion ----------------------------------------------------
+
+    def poll_results(self) -> None:
+        for key in self.kv.keys("serve/result/"):
+            req_id = int(key.rsplit("/", 1)[1])
+            if req_id in self.results:
+                continue
+            val = self.kv.get(key)
+            if val is not None:
+                self.results[req_id] = json.loads(val)
+
+    def wait_all(self, timeout: float = 120.0) -> Dict[int, List[int]]:
+        """Block until every submitted request has a result, healing
+        replicas along the way."""
+        deadline = time.time() + timeout
+        while True:
+            now = time.time()
+            self.poll_results()
+            if len(self.results) == len(self._requests):
+                return dict(self.results)
+            for rid in range(self.n_replicas):
+                if rid in self._down:
+                    continue
+                why = self._check_replica(rid, now)
+                if why is not None:
+                    self._heal(rid, why)
+            if now > deadline:
+                missing = sorted(set(self._requests) - set(self.results))
+                raise HorovodTpuError(
+                    f"serving timed out after {timeout:.0f}s with "
+                    f"requests {missing} unfinished")
+            time.sleep(0.05)
+
+    def stop(self) -> None:
+        try:
+            self.kv.put("serve/stop", "1")
+            for proc in self.procs.values():
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        finally:
+            self.server.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+# -- the replica worker process ---------------------------------------------
+
+
+def _build_server(config: Dict):
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import TransformerConfig, transformer_init
+    from .server import InferenceServer
+
+    kw = dict(config["cfg"])
+    kw["compute_dtype"] = getattr(jnp, kw.get("compute_dtype",
+                                              "float32"))
+    cfg = TransformerConfig(**kw)
+    params = transformer_init(
+        jax.random.PRNGKey(int(config.get("seed", 0))), cfg)
+    return InferenceServer(params, cfg, **config.get("serve", {})), cfg
+
+
+def main() -> None:
+    rid = int(os.environ["HOROVOD_SERVE_REPLICA_ID"])
+    client = RendezvousClient(
+        os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+        int(os.environ["HOROVOD_RENDEZVOUS_PORT"]),
+        os.environ["HOROVOD_SECRET_KEY"])
+    raw = client.wait("serve/config", 30.0)
+    if raw is None:
+        raise HorovodTpuError("replica got no serve/config within 30s")
+    config = json.loads(raw)
+    server, _ = _build_server(config)
+    claimed: Set[str] = set()
+    beat = 0
+    logger.info("replica %d serving (pid %d)", rid, os.getpid())
+    while True:
+        beat += 1
+        client.put(f"serve/heartbeat/{rid}", str(beat))
+        if client.get("serve/stop"):
+            break
+        for key in client.keys(f"serve/assign/{rid}/"):
+            if key in claimed:
+                continue
+            claimed.add(key)
+            payload = json.loads(client.get(key))
+            server.submit(payload["prompt"], payload["max_new_tokens"],
+                          req_id=int(key.rsplit("/", 1)[1]))
+        # The fault point that kills a replica mid-stream in the e2e
+        # test (serve.replica_die@N:exit:1, host-scoped via
+        # HOROVOD_FAULT_HOSTS=replicaK).
+        _faults.point("serve.replica_die")
+        if server.sched.drained():
+            time.sleep(0.05)
+            continue
+        for seq in server.step():
+            client.put(f"serve/result/{seq.req.req_id}",
+                       json.dumps(seq.generated))
+
+
+if __name__ == "__main__":
+    main()
+
+
+__all__ = ["ReplicaManager", "main"]

@@ -1,0 +1,392 @@
+"""Continuous-batching inference server over the paged KV pool.
+
+One compiled decode step of fixed ``max_batch`` rows serves every
+in-flight sequence; admission/eviction happens BETWEEN steps (the
+scheduler), and sequence KV state lives in the pool (pool.py).  The
+decode kernels run UNCHANGED — the only model-side addition is the
+vector-``pos`` path in ``models/decode.py``, because continuously
+batched rows sit at different depths of the same step.
+
+Step anatomy (``step()``):
+
+  1. admit   — queued requests board free rows; prefill-on-admit runs
+               ``transformer_prefill`` into a scratch cache sized
+               exactly to the request's page budget, then bulk-writes
+               the pages (``scatter_pages``).
+  2. emit    — each active row's next token is decided HOST-side from
+               its pending logits (greedy serving); finished rows
+               (max_new / EOS) evict and free their pages BEFORE any
+               device work, so the last token costs no decode step.
+  3. gather  — only if membership changed: rebuild the pooled view.
+  4. decode  — one vector-pos ``transformer_decode_step`` (plain), or
+               one speculative round (draft chain + chunked verify)
+               when the SLO controller has flipped speculation on.
+  5. scatter — copy each active row's written ring slot(s) back into
+               its pages; the pool stays the source of truth.
+
+Speculative rounds keep the greedy target chain EXACT: every decided
+token is the argmax of target logits computed over a correct prefix
+(accepted-prefix min over rows; stale speculative slots are never
+readable before they are overwritten — the same always-write-before-
+read ring property ``transformer_speculative_generate`` relies on).
+
+All host orchestration (clocks, metrics, env) stays OUTSIDE the jitted
+programs; the compiled pieces are the same module-cached
+``_spec_step_fn`` / ``_spec_extend_fn`` programs the speculative
+decoder uses, plus one prefill jit — shapes (max_batch, view ring,
+gamma) key the program cache through tracing, which is why the
+``serve_page_tokens`` / ``serve_max_batch`` / ``serve_spec_gamma``
+autotuner knobs are part of the compiled-shape key (docs/AUTOTUNE.md).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import util
+from ..common.exceptions import InvalidRequestError
+from ..metrics import catalog as _met
+from ..models.decode import (
+    _spec_extend_fn,
+    _spec_step_fn,
+    init_decode_cache,
+    transformer_prefill,
+)
+from ..utils import autotune
+from .pool import PagedKVPool
+from .scheduler import ActiveSeq, ContinuousScheduler, Request
+from .slo import SloController
+
+
+@functools.lru_cache(maxsize=None)
+def _prefill_fn(cfg):
+    return jax.jit(lambda p, c, t: transformer_prefill(p, c, t, cfg))
+
+
+class InferenceServer:
+    """Greedy continuous-batching decode server (one model replica).
+
+    ``policy="static"`` turns the SAME machinery into the static-
+    batching baseline (admit only into an empty batch) — the bench's
+    A/B isolates the batching policy exactly.
+    """
+
+    def __init__(self, params, cfg, *,
+                 max_seq_tokens: int,
+                 max_batch: Optional[int] = None,
+                 page_tokens: Optional[int] = None,
+                 pool_pages: Optional[int] = None,
+                 quantize: Optional[str] = None,
+                 draft_params=None, draft_cfg=None,
+                 gamma: Optional[int] = None,
+                 slo_ms: Optional[float] = None,
+                 force_spec: bool = False,
+                 policy: str = "fifo", seed: int = 0):
+        self.params = params
+        self.cfg = cfg
+        self.page_tokens = page_tokens or \
+            autotune.current_serve_page_tokens()
+        self.max_batch = max_batch or autotune.current_serve_max_batch()
+        self.gamma = gamma or autotune.current_serve_spec_gamma()
+        if self.page_tokens < 1 or self.max_batch < 1 or self.gamma < 1:
+            raise InvalidRequestError(
+                f"page_tokens/max_batch/gamma must be >= 1, got "
+                f"{self.page_tokens}/{self.max_batch}/{self.gamma}")
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        if (draft_params is None) != (draft_cfg is None):
+            raise InvalidRequestError(
+                "draft_params and draft_cfg come together")
+        if draft_params is not None and cfg.attn_window:
+            raise InvalidRequestError(
+                "speculative serving does not support attn_window "
+                "configs (chunked verify over a rolling ring)")
+        # Per-sequence budget: the full ring a request may need.  The
+        # gamma headroom mirrors transformer_speculative_generate — a
+        # round writes up to gamma slots past the accepted frontier.
+        headroom = self.gamma if draft_params is not None else 0
+        self.max_seq_tokens = max_seq_tokens + headroom
+        self.view_pages = -(-self.max_seq_tokens // self.page_tokens)
+        self.view_tokens = self.view_pages * self.page_tokens
+        pool_pages = pool_pages or autotune.current_serve_pool_pages() \
+            or self.max_batch * self.view_pages
+        self.pool = PagedKVPool(cfg, pool_pages, self.page_tokens,
+                                quantize=quantize)
+        self.dpool = None
+        if draft_params is not None:
+            self.dpool = PagedKVPool(draft_cfg, pool_pages,
+                                     self.page_tokens)
+        self.sched = ContinuousScheduler(self.max_batch, policy=policy,
+                                         seed=seed)
+        if slo_ms is None:                 # HOROVOD_SERVE_SLO_MS
+            slo_ms = util.env_float("SERVE_SLO_MS", 0.0)
+        self.slo = SloController(slo_ms)
+        self.force_spec = force_spec
+
+        V = cfg.vocab_size
+        self.row_pos = np.zeros(self.max_batch, np.int64)
+        self.last_logits = np.zeros((self.max_batch, V), np.float32)
+        self.row_seq: List[Optional[int]] = [None] * self.max_batch
+        self.view_k = self.view_v = None
+        self.dview_k = self.dview_v = None
+        self._dirty_rows: Dict[int, int] = {}    # row -> seq_id to refresh
+        self.step_no = 0
+        self._next_req_id = 0
+        self._submit_wall: Dict[int, float] = {}
+        # run stats (read by loadgen / the bench)
+        self.tokens_out = 0
+        self.device_steps = 0
+        self.spec_steps = 0
+        self.occupancy_sum = 0.0
+        self.token_latencies_ms: List[float] = []
+        self.request_latencies_ms: List[float] = []
+
+    # -- request intake ------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               eos_id: Optional[int] = None,
+               req_id: Optional[int] = None) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size + max_new_tokens > self.max_seq_tokens:
+            raise InvalidRequestError(
+                f"request needs {prompt.size} + {max_new_tokens} "
+                f"tokens > per-sequence budget {self.max_seq_tokens}")
+        if req_id is None:
+            req_id = self._next_req_id
+        self._next_req_id = max(self._next_req_id, req_id) + 1
+        req = Request(req_id=req_id, prompt=prompt,
+                      max_new_tokens=max_new_tokens, eos_id=eos_id,
+                      arrival_step=self.step_no)
+        self._submit_wall[req_id] = time.perf_counter()
+        self.sched.submit(req, self.step_no)
+        return req_id
+
+    # -- admission -----------------------------------------------------
+
+    def _budget_tokens(self, req: Request) -> int:
+        n = int(req.prompt.size) + req.max_new_tokens
+        if self.draft_params is not None:
+            n += self.gamma
+        return n
+
+    def _can_admit(self, req: Request) -> bool:
+        n = self._budget_tokens(req)
+        if not self.pool.can_alloc(n):
+            return False
+        return self.dpool is None or self.dpool.can_alloc(n)
+
+    def _prefill_into(self, pool: PagedKVPool, params, cfg, seq,
+                      npages: int):
+        scratch = init_decode_cache(cfg, 1, npages * self.page_tokens,
+                                    quantize=pool.quantize)
+        lg, scratch = _prefill_fn(cfg)(
+            params, scratch, jnp.asarray(seq.req.prompt[None]))
+        pool.scatter_pages(seq.req.req_id, scratch["k"], scratch["v"])
+        return lg
+
+    def _admit(self) -> None:
+        for seq in self.sched.admit(self.step_no, self._can_admit):
+            budget = self._budget_tokens(seq.req)
+            pids = self.pool.alloc(seq.req.req_id, budget)
+            lg = self._prefill_into(self.pool, self.params, self.cfg,
+                                    seq, len(pids))
+            if self.dpool is not None:
+                dpids = self.dpool.alloc(seq.req.req_id, budget)
+                self._prefill_into(self.dpool, self.draft_params,
+                                   self.draft_cfg, seq, len(dpids))
+            T0 = int(seq.req.prompt.size)
+            seq.pos = T0
+            self.row_pos[seq.row] = T0
+            self.last_logits[seq.row] = np.asarray(lg)[0]
+            self.row_seq[seq.row] = seq.req.req_id
+            self._dirty_rows[seq.row] = seq.req.req_id
+
+    def _finish(self, seq: ActiveSeq) -> None:
+        self.sched.evict(self.step_no, seq.row)
+        self.pool.free(seq.req.req_id)
+        if self.dpool is not None:
+            self.dpool.free(seq.req.req_id)
+        self.row_seq[seq.row] = None
+        self.row_pos[seq.row] = 0
+        self._dirty_rows.pop(seq.row, None)
+        t0 = self._submit_wall.pop(seq.req.req_id, None)
+        if t0 is not None:
+            self.request_latencies_ms.append(
+                (time.perf_counter() - t0) * 1e3)
+
+    def _refresh_views(self) -> None:
+        """Bring the pooled decode view up to date: a full gather the
+        first time, then per-admitted-row updates (evicted rows need
+        none — see PagedKVPool.gather_rows)."""
+        if self.view_k is None:
+            self.view_k, self.view_v = self.pool.gather(
+                self.row_seq, self.view_pages)
+            if self.dpool is not None:
+                self.dview_k, self.dview_v = self.dpool.gather(
+                    self.row_seq, self.view_pages)
+        elif self._dirty_rows:
+            pairs = sorted(self._dirty_rows.items())
+            self.view_k, self.view_v = self.pool.gather_rows(
+                self.view_k, self.view_v, pairs, self.view_pages)
+            if self.dpool is not None:
+                self.dview_k, self.dview_v = self.dpool.gather_rows(
+                    self.dview_k, self.dview_v, pairs, self.view_pages)
+        self._dirty_rows.clear()
+
+    # -- the step ------------------------------------------------------
+
+    def step(self) -> List[ActiveSeq]:
+        """One scheduler+decode iteration; returns sequences finished
+        THIS step (their ``generated`` lists are complete)."""
+        t0 = time.perf_counter()
+        self._admit()
+        finished: List[ActiveSeq] = []
+        feed = np.zeros(self.max_batch, np.int64)
+        for row in sorted(self.sched.active):
+            seq = self.sched.active[row]
+            if not seq.done:
+                tok = int(np.argmax(self.last_logits[row]))
+                seq.generated.append(tok)
+                self.tokens_out += 1
+                feed[row] = tok
+            if seq.done:
+                finished.append(seq)
+                self._finish(seq)
+        rows = sorted(self.sched.active)
+        decided = 0
+        if rows:
+            self._refresh_views()
+            spec = (self.draft_params is not None
+                    and (self.force_spec or self.slo.update(self.step_no)))
+            if spec:
+                decided = self._spec_round(rows, feed)
+                self.spec_steps += 1
+            else:
+                self._plain_step(rows, feed)
+            self.device_steps += 1
+            self.occupancy_sum += len(rows) / self.max_batch
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            per_tok = dt_ms / (1 + decided)
+            self.token_latencies_ms.append(per_tok)
+            self.slo.record(per_tok)
+        self._update_gauges()
+        self.step_no += 1
+        return finished
+
+    def _plain_step(self, rows: Sequence[int], feed: np.ndarray) -> None:
+        base = self.row_pos.copy()
+        cache = {"k": self.view_k, "v": self.view_v,
+                 "pos": jnp.asarray(base, jnp.int32)}
+        lg, cache = _spec_step_fn(self.cfg)(
+            self.params, cache, jnp.asarray(feed, jnp.int32))
+        self.view_k, self.view_v = cache["k"], cache["v"]
+        sids = [self.row_seq[r] for r in rows]
+        slots = [int(base[r]) % self.view_tokens for r in rows]
+        self.pool.scatter_slots(self.view_k, self.view_v, sids, rows,
+                                slots)
+        self.last_logits = np.array(lg)    # copy: row writes on admit
+        for r in rows:
+            self.row_pos[r] += 1
+            self.sched.active[r].pos = int(self.row_pos[r])
+
+    def _spec_round(self, rows: Sequence[int], feed: np.ndarray) -> int:
+        """Draft-propose / chunk-verify round; returns how many EXTRA
+        tokens (beyond the step's emit) were decided per row."""
+        gamma = self.gamma
+        base = self.row_pos.copy()
+        dstep = _spec_step_fn(self.draft_cfg)
+        dcache = {"k": self.dview_k, "v": self.dview_v,
+                  "pos": jnp.asarray(base, jnp.int32)}
+        drafts: List[np.ndarray] = []     # d_1 .. d_gamma, each [B]
+        cur = feed
+        for _ in range(gamma):
+            dlg, dcache = dstep(self.draft_params, dcache,
+                                jnp.asarray(cur, jnp.int32))
+            cur = np.asarray(jnp.argmax(dlg, -1))
+            drafts.append(cur)
+        self.dview_k, self.dview_v = dcache["k"], dcache["v"]
+
+        chunk = np.stack([feed] + drafts[:-1], axis=1)     # [B, gamma]
+        tcache = {"k": self.view_k, "v": self.view_v,
+                  "pos": jnp.asarray(base, jnp.int32)}
+        tlg, tcache = _spec_extend_fn(self.cfg)(
+            self.params, tcache, jnp.asarray(chunk, jnp.int32))
+        self.view_k, self.view_v = tcache["k"], tcache["v"]
+        tlogits = np.asarray(tlg)                          # [B, g, V]
+
+        # Accepted prefix per row, capped at gamma-1 so the round
+        # always ends holding VERIFIED logits for the next undecided
+        # position (tlogits[:, n_acc]).  Min-acceptance keeps every
+        # row's advance equal; a row that accepted further replays its
+        # own draft from those logits next step — values are exact.
+        n_acc = gamma - 1
+        for r in rows:
+            acc = 0
+            while acc < gamma - 1 and \
+                    int(drafts[acc][r]) == \
+                    int(np.argmax(tlogits[r, acc])):
+                acc += 1
+            n_acc = min(n_acc, acc)
+        for r in rows:
+            seq = self.sched.active[r]
+            for i in range(n_acc):
+                if seq.done:
+                    break
+                seq.generated.append(int(drafts[i][r]))
+                self.tokens_out += 1
+            self.last_logits[r] = tlogits[r, n_acc]
+            self.row_pos[r] = int(base[r]) + n_acc + 1
+            seq.pos = int(self.row_pos[r])
+        # Scatter the verified slots (emit token + accepted drafts):
+        # ring positions base .. base + n_acc per row.
+        sids = [self.row_seq[r] for r in rows]
+        for off in range(n_acc + 1):
+            slots = [(int(base[r]) + off) % self.view_tokens
+                     for r in rows]
+            self.pool.scatter_slots(self.view_k, self.view_v, sids,
+                                    rows, slots)
+            if self.dpool is not None:
+                self.dpool.scatter_slots(self.dview_k, self.dview_v,
+                                         sids, rows, slots)
+        return n_acc
+
+    # -- loops / observability -----------------------------------------
+
+    def run(self, max_steps: int = 100000) -> List[ActiveSeq]:
+        """Step until queue and batch drain; returns finished seqs in
+        completion order."""
+        done: List[ActiveSeq] = []
+        for _ in range(max_steps):
+            if self.sched.drained():
+                break
+            done.extend(self.step())
+        if not self.sched.drained():
+            raise InvalidRequestError(
+                f"server did not drain within {max_steps} steps "
+                f"({self.sched.queue_depth()} queued, "
+                f"{len(self.sched.active)} active)")
+        return done
+
+    def occupancy_mean(self) -> float:
+        return self.occupancy_sum / max(1, self.device_steps)
+
+    def _update_gauges(self) -> None:
+        # Sampled, not per-step: the p99 percentile over the SLO window
+        # costs more than a whole decode dispatch on small models.
+        if not _met.enabled() or self.step_no % 16:
+            return
+        _met.serve_queue_depth.set(self.sched.queue_depth())
+        _met.serve_batch_occupancy.set(self.sched.occupancy())
+        _met.serve_pool_pages_free.set(self.pool.pages_free())
+        p99 = self.slo.p99_ms()
+        if p99:
+            _met.serve_p99_ms.set(p99)
+
+
+__all__ = ["InferenceServer"]

@@ -1,0 +1,80 @@
+"""SLO-aware speculative-decode toggling.
+
+The server's one latency lever at fixed batch is speculative decoding:
+a round replaces ``n_acc + 1`` sequential target dispatches with one
+draft chain plus one chunked verify, cutting per-TOKEN latency when
+the target is dispatch- or memory-bound.  It costs draft compute and
+(at batch) min-acceptance throughput, so it should engage only when
+the latency SLO is actually at risk.
+
+The controller watches the observed p99 of per-token step latency over
+a sliding window and flips speculation per step against
+``HOROVOD_SERVE_SLO_MS``:
+
+  - p99 > slo_ms            -> ON  (latency over budget)
+  - p99 < slo_ms * hysteresis -> OFF (comfortably under budget)
+  - in between              -> hold (no flapping)
+
+plus a minimum dwell between flips so one outlier step can't toggle
+the compiled-program mix.  Decisions are appended to ``decisions`` —
+``(step, "spec_on" | "spec_off", p99_ms)`` — so tests replay the
+control trace deterministically from a recorded latency sequence.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..common.exceptions import InvalidRequestError
+
+
+class SloController:
+    def __init__(self, slo_ms: Optional[float], window: int = 64,
+                 hysteresis: float = 0.7, dwell_steps: int = 8):
+        """``slo_ms`` None or <= 0 disables the controller (speculation
+        stays off unless the server forces it)."""
+        if not 0.0 < hysteresis <= 1.0:
+            raise InvalidRequestError(
+                f"hysteresis must be in (0, 1], got {hysteresis}")
+        if window < 1 or dwell_steps < 0:
+            raise InvalidRequestError(
+                f"window must be >= 1 and dwell_steps >= 0, got "
+                f"{window}/{dwell_steps}")
+        self.slo_ms = slo_ms if slo_ms and slo_ms > 0 else None
+        self.hysteresis = hysteresis
+        self.dwell_steps = dwell_steps
+        self._lat = deque(maxlen=window)
+        self.spec_on = False
+        self._last_flip = -(dwell_steps + 1)
+        self.decisions: List[Tuple[int, str, float]] = []
+
+    def record(self, step_ms: float) -> None:
+        self._lat.append(float(step_ms))
+
+    def p99_ms(self) -> float:
+        if not self._lat:
+            return 0.0
+        return float(np.percentile(np.asarray(self._lat), 99))
+
+    def update(self, step: int) -> bool:
+        """One control decision; returns the (possibly new) spec state."""
+        if self.slo_ms is None or not self._lat:
+            return self.spec_on
+        if step - self._last_flip <= self.dwell_steps:
+            return self.spec_on
+        p99 = self.p99_ms()
+        if not self.spec_on and p99 > self.slo_ms:
+            self.spec_on = True
+            self._last_flip = step
+            self.decisions.append((step, "spec_on", p99))
+        elif self.spec_on and p99 < self.slo_ms * self.hysteresis:
+            self.spec_on = False
+            self._last_flip = step
+            self.decisions.append((step, "spec_off", p99))
+        return self.spec_on
+
+
+__all__ = ["SloController"]

@@ -405,6 +405,20 @@ def init_from_env() -> Optional[ParameterManager]:
     # is not pinned.
     pm.register("zero_stage", 0, 3, integer=True,
                 initial=_env_zero_stage())
+    # Serving knobs (docs/SERVING.md): KV-pool page size, the compiled
+    # decode step's row count, and the speculative draft length.  All
+    # three change COMPILED SHAPES (the view ring, the batch axis, the
+    # verify chunk), so the serve program cache keys on them — a tuner
+    # move costs a retrace, which is why their live values are read
+    # once at server construction, not per step.
+    pm.register("serve_page_tokens", 8, 256, log_scale=True,
+                integer=True,
+                initial=util.env_int("SERVE_PAGE_TOKENS", 16))
+    pm.register("serve_max_batch", 1, 64, log_scale=True,
+                integer=True,
+                initial=util.env_int("SERVE_MAX_BATCH", 8))
+    pm.register("serve_spec_gamma", 1, 16, integer=True,
+                initial=util.env_int("SERVE_SPEC_GAMMA", 4))
     _manager = pm
     logger.info("autotune enabled: %s", pm.values())
     return pm
@@ -620,3 +634,63 @@ def current_guard_digest_interval() -> int:
     if env <= 0:
         return 0
     return tuned_guard_digest_interval(env)
+
+
+def tuned_serve_page_tokens(default: int) -> int:
+    """KV-pool page size honoring the autotuner when active (used by
+    serve.InferenceServer at construction)."""
+    if _manager is not None and "serve_page_tokens" in _manager._tunables:
+        return max(1, int(_manager.value("serve_page_tokens")))
+    return default
+
+
+def current_serve_page_tokens() -> int:
+    """The live KV-pool page size in tokens: HOROVOD_SERVE_PAGE_TOKENS
+    (16 — small enough that a short request wastes < one page, big
+    enough that gather/scatter index tables stay tiny), overridden by
+    the autotuner when active.  Shape-changing: consulted once at
+    server construction."""
+    return tuned_serve_page_tokens(
+        max(1, util.env_int("SERVE_PAGE_TOKENS", 16)))
+
+
+def tuned_serve_max_batch(default: int) -> int:
+    """Serving batch rows honoring the autotuner when active (used by
+    serve.InferenceServer at construction)."""
+    if _manager is not None and "serve_max_batch" in _manager._tunables:
+        return max(1, int(_manager.value("serve_max_batch")))
+    return default
+
+
+def current_serve_max_batch() -> int:
+    """The live compiled decode-step row count:
+    HOROVOD_SERVE_MAX_BATCH (8), overridden by the autotuner when
+    active.  Shape-changing: consulted once at server construction."""
+    return tuned_serve_max_batch(
+        max(1, util.env_int("SERVE_MAX_BATCH", 8)))
+
+
+def tuned_serve_spec_gamma(default: int) -> int:
+    """Speculative draft length honoring the autotuner when active
+    (used by serve.InferenceServer at construction)."""
+    if _manager is not None and "serve_spec_gamma" in _manager._tunables:
+        return max(1, int(_manager.value("serve_spec_gamma")))
+    return default
+
+
+def current_serve_spec_gamma() -> int:
+    """The live speculative draft length: HOROVOD_SERVE_SPEC_GAMMA
+    (4 — the sweet spot for greedy draft/target pairs before
+    min-acceptance across the batch eats the wins), overridden by the
+    autotuner when active.  Shape-changing (the verify chunk width):
+    consulted once at server construction."""
+    return tuned_serve_spec_gamma(
+        max(1, util.env_int("SERVE_SPEC_GAMMA", 4)))
+
+
+def current_serve_pool_pages() -> int:
+    """KV-pool size in pages: HOROVOD_SERVE_POOL_PAGES (0 = auto, the
+    server sizes the pool to max_batch full-length sequences).  Plain
+    env read — not a tuner knob, because pool size is a capacity
+    decision, not a throughput tradeoff."""
+    return max(0, util.env_int("SERVE_POOL_PAGES", 0))

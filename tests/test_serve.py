@@ -1,0 +1,434 @@
+"""Serving-stack tests (horovod_tpu/serve): paged KV pool invariants,
+pooled-vs-contiguous bitwise parity, scheduler determinism, the SLO
+controller's replayable control trace, input validation, the bench
+record stale gate, and the two-replica elastic e2e (a replica dies
+mid-stream, lease/respawn recovers every sequence token-exactly)."""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.common.exceptions import HorovodTpuError
+from horovod_tpu.models import (
+    TransformerConfig,
+    init_decode_cache,
+    transformer_decode_step,
+    transformer_generate,
+    transformer_init,
+    transformer_prefill,
+)
+from horovod_tpu.serve import (
+    ContinuousScheduler,
+    InferenceServer,
+    PagedKVPool,
+    PoolExhaustedError,
+    Request,
+    SloController,
+)
+from horovod_tpu.serve.loadgen import (
+    append_record,
+    make_trace,
+    read_latest_record,
+    run_trace,
+)
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, d_model=32, n_heads=4, d_head=8,
+                d_ff=64, n_layers=2, compute_dtype=jnp.float32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return cfg, transformer_init(jax.random.PRNGKey(0), cfg)
+
+
+class TestPagedKVPool:
+    def test_alloc_free_reuse_no_leak(self):
+        pool = PagedKVPool(_cfg(), total_pages=8, page_tokens=4)
+        a = pool.alloc(1, 10)          # 3 pages
+        b = pool.alloc(2, 4)           # 1 page
+        assert a == [0, 1, 2] and b == [3]
+        assert pool.pages_free() == 4
+        assert pool.utilization() == pytest.approx(0.5)
+        pool.free(1)
+        assert pool.pages_free() == 7
+        # Deterministic LIFO reuse: the MRU page of the freed list
+        # comes back first.
+        c = pool.alloc(3, 8)
+        assert c == [0, 1]
+        pool.free(2)
+        pool.free(3)
+        assert pool.pages_free() == 8
+        assert pool.pages == {}        # no leaked page lists
+
+    def test_exhaustion_and_double_alloc(self):
+        pool = PagedKVPool(_cfg(), total_pages=2, page_tokens=4)
+        pool.alloc(1, 8)
+        with pytest.raises(PoolExhaustedError):
+            pool.alloc(2, 4)
+        with pytest.raises(HorovodTpuError, match="already holds"):
+            pool.alloc(1, 4)
+        with pytest.raises(HorovodTpuError, match="holds no pages"):
+            pool.free(99)
+        assert pool.can_alloc(4) is False
+        pool.free(1)
+        assert pool.can_alloc(8) is True
+
+    @pytest.mark.parametrize("quantize", [None, "int8"])
+    def test_pooled_decode_bitwise_equal(self, model, quantize):
+        """The tentpole parity claim: decode over a pooled-page view is
+        BITWISE equal to decode over a contiguous cache, because
+        gather/scatter is pure data movement.  Both sides start from
+        the SAME per-row prefill bytes (a batched prefill may reduce in
+        a different order); the pooled side routes them through
+        scatter_pages -> gather."""
+        cfg, params = model
+        B, T0, steps, ring = 2, 4, 5, 16
+        toks = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(1), (B, T0), 0, 64), np.int32)
+
+        def _cat(a, b):                 # concat caches on the batch axis
+            if isinstance(a, dict):
+                return {k: jnp.concatenate([a[k], b[k]], axis=1)
+                        for k in a}
+            return jnp.concatenate([a, b], axis=1)
+
+        pool = PagedKVPool(cfg, total_pages=2 * (ring // 4),
+                           page_tokens=4, quantize=quantize)
+        ck = cv = lg0 = None
+        for b in range(B):
+            pool.alloc(b, ring)
+            scratch = init_decode_cache(cfg, 1, ring, quantize=quantize)
+            plg, scratch = transformer_prefill(
+                params, scratch, jnp.asarray(toks[b:b + 1]), cfg)
+            pool.scatter_pages(b, scratch["k"], scratch["v"])
+            ck = scratch["k"] if ck is None else _cat(ck, scratch["k"])
+            cv = scratch["v"] if cv is None else _cat(cv, scratch["v"])
+            lg0 = plg if lg0 is None else jnp.concatenate(
+                [lg0, plg], axis=0)
+
+        def _np(kv):
+            return (np.asarray(kv["q"]) if isinstance(kv, dict)
+                    else np.asarray(kv))
+
+        # gather reproduces the installed bytes exactly
+        vk, vv = pool.gather([0, 1], ring // 4)
+        np.testing.assert_array_equal(_np(vk), _np(ck))
+        np.testing.assert_array_equal(_np(vv), _np(cv))
+
+        pos = np.full(B, T0, np.int64)
+        tok = jnp.argmax(lg0, -1)
+        rtok = tok
+        for _ in range(steps):
+            p = jnp.asarray(pos, jnp.int32)
+            rlg, rc = transformer_decode_step(
+                params, {"k": ck, "v": cv, "pos": p}, rtok, cfg)
+            ck, cv = rc["k"], rc["v"]
+            lg, c = transformer_decode_step(
+                params, {"k": vk, "v": vv, "pos": p}, tok, cfg)
+            vk, vv = c["k"], c["v"]
+            pool.scatter_slots(vk, vv, [0, 1], [0, 1],
+                               [int(q) % ring for q in pos])
+            np.testing.assert_array_equal(np.asarray(lg),
+                                          np.asarray(rlg))
+            pos += 1
+            tok, rtok = jnp.argmax(lg, -1), jnp.argmax(rlg, -1)
+        # The per-step scatter kept the POOL the source of truth: a
+        # fresh gather reproduces the contiguous cache bit-for-bit.
+        fk, fv = pool.gather([0, 1], ring // 4)
+        np.testing.assert_array_equal(_np(fk), _np(ck))
+        np.testing.assert_array_equal(_np(fv), _np(cv))
+
+    def test_gather_rows_matches_full_gather(self, model):
+        cfg, _ = model
+        pool = PagedKVPool(cfg, total_pages=6, page_tokens=4)
+        pool.alloc(10, 8)
+        pool.alloc(11, 8)
+        vk, vv = pool.gather([10, 11, None], 2)
+        pool.free(10)
+        pool.alloc(12, 8)
+        uk, uv = pool.gather_rows(vk, vv, [(2, 12)], 2)
+        fk, fv = pool.gather([None, 11, 12], 2)   # row0 stale is fine:
+        np.testing.assert_array_equal(            # compare rows 1..2
+            np.asarray(uk)[:, 1:], np.asarray(fk)[:, 1:])
+        np.testing.assert_array_equal(
+            np.asarray(uv)[:, 1:], np.asarray(fv)[:, 1:])
+
+    def test_validation(self):
+        with pytest.raises(HorovodTpuError):
+            PagedKVPool(_cfg(), total_pages=0, page_tokens=4)
+        with pytest.raises(HorovodTpuError):
+            PagedKVPool(_cfg(), total_pages=4, page_tokens=0)
+
+
+class TestScheduler:
+    def _run(self, policy, seed=0):
+        sched = ContinuousScheduler(3, policy=policy, seed=seed)
+        for n in range(8):              # deep queue: policy must choose
+            sched.submit(Request(req_id=n, prompt=np.ones(4),
+                                 max_new_tokens=2 + n % 3), 0)
+        step = 0
+        while not sched.drained():
+            sched.admit(step, lambda r: True)
+            for row, seq in list(sched.active.items()):
+                seq.generated.append(0)
+                if seq.done:
+                    sched.evict(step, row)
+            step += 1
+        return sched.decision_log
+
+    @pytest.mark.parametrize("policy", ["fifo", "random", "static"])
+    def test_scheduler_deterministic(self, policy):
+        assert self._run(policy) == self._run(policy)
+
+    def test_seed_changes_random_policy(self):
+        assert self._run("random", 0) != self._run("random", 1)
+
+    def test_static_admits_only_empty_batch(self):
+        sched = ContinuousScheduler(2, policy="static")
+        for i in range(4):
+            sched.submit(Request(req_id=i, prompt=np.ones(2),
+                                 max_new_tokens=1), 0)
+        assert len(sched.admit(0, lambda r: True)) == 2
+        assert sched.admit(1, lambda r: True) == []   # batch occupied
+        sched.evict(2, 0)
+        assert sched.admit(3, lambda r: True) == []   # still one active
+        sched.evict(3, 1)
+        assert len(sched.admit(4, lambda r: True)) == 2
+
+    def test_backpressure_stops_admission(self):
+        sched = ContinuousScheduler(4)
+        for i in range(3):
+            sched.submit(Request(req_id=i, prompt=np.ones(2),
+                                 max_new_tokens=1), 0)
+        out = sched.admit(0, lambda r: r.req_id < 1)
+        assert [s.req.req_id for s in out] == [0]
+        assert sched.queue_depth() == 2
+
+
+class TestSloController:
+    def test_disabled_without_slo(self):
+        c = SloController(None)
+        c.record(100.0)
+        assert c.update(0) is False and c.decisions == []
+
+    def test_toggle_replay(self):
+        lat = [1.0] * 20 + [9.0] * 30 + [1.0] * 40
+
+        def replay():
+            c = SloController(5.0, window=8, hysteresis=0.5,
+                              dwell_steps=4)
+            out = []
+            for i, ms in enumerate(lat):
+                c.record(ms)
+                out.append(c.update(i))
+            return c.decisions, out
+
+        d1, states = replay()
+        d2, _ = replay()
+        assert d1 == d2                      # deterministic replay
+        events = [e for _, e, _ in d1]
+        assert events[:2] == ["spec_on", "spec_off"]
+        assert states[25] is True and states[-1] is False
+
+    def test_dwell_blocks_flapping(self):
+        c = SloController(5.0, window=4, dwell_steps=100)
+        for i, ms in enumerate([9, 9, 9, 1, 1, 1, 9, 9, 9, 1]):
+            c.record(float(ms))
+            c.update(i)
+        assert len(c.decisions) <= 1
+
+    def test_validation(self):
+        with pytest.raises(HorovodTpuError):
+            SloController(5.0, hysteresis=0.0)
+        with pytest.raises(HorovodTpuError):
+            SloController(5.0, window=0)
+
+
+class TestInputValidation:
+    """The satellite bugfix: impossible requests raise HorovodTpuError
+    (InvalidRequestError also IS-A ValueError for older callers)."""
+
+    def test_init_decode_cache_bad_batch(self, model):
+        cfg, _ = model
+        with pytest.raises(HorovodTpuError, match="batch"):
+            init_decode_cache(cfg, 0, 8)
+
+    def test_generate_bad_args(self, model):
+        cfg, params = model
+        prompt = jnp.ones((1, 4), jnp.int32)
+        with pytest.raises(HorovodTpuError, match="max_new_tokens"):
+            transformer_generate(params, cfg, prompt, 0)
+        with pytest.raises(HorovodTpuError, match="max_len"):
+            transformer_generate(params, cfg, prompt, 4, max_len=2)
+        with pytest.raises(HorovodTpuError, match="non-empty"):
+            transformer_generate(params, cfg,
+                                 jnp.ones((1, 0), jnp.int32), 4)
+
+    def test_prefill_prompt_longer_than_window(self, model):
+        cfg, params = model
+        cache = init_decode_cache(cfg, 1, 4)
+        with pytest.raises(HorovodTpuError, match="max_len"):
+            transformer_prefill(params, cache,
+                                jnp.ones((1, 8), jnp.int32), cfg)
+
+    def test_server_rejects_oversized_request(self, model):
+        cfg, params = model
+        srv = InferenceServer(params, cfg, max_seq_tokens=16,
+                              max_batch=2, page_tokens=4)
+        with pytest.raises(HorovodTpuError, match="budget"):
+            srv.submit(np.ones(8, np.int32), 16)
+        with pytest.raises(HorovodTpuError, match="policy"):
+            InferenceServer(params, cfg, max_seq_tokens=16,
+                            max_batch=2, policy="nope")
+
+
+class TestInferenceServer:
+    def test_continuous_matches_generate(self, model):
+        """Every request served through the pooled continuous batch
+        yields exactly transformer_generate's greedy tokens."""
+        cfg, params = model
+        srv = InferenceServer(params, cfg, max_seq_tokens=24,
+                              max_batch=3, page_tokens=4)
+        rng = np.random.RandomState(2)
+        reqs = []
+        for _ in range(7):
+            prompt = rng.randint(0, 64, size=int(rng.choice([3, 5])))
+            mn = int(rng.randint(2, 8))
+            reqs.append((srv.submit(prompt, mn), prompt, mn))
+        by_id = {s.req.req_id: s.generated for s in srv.run()}
+        for rid, prompt, mn in reqs:
+            ref, _ = transformer_generate(
+                params, cfg, jnp.asarray(prompt[None], jnp.int32), mn)
+            assert by_id[rid] == np.asarray(ref)[0].tolist()
+        assert srv.pool.pages_free() == srv.pool.total_pages
+
+    def test_spec_serving_matches_generate(self, model):
+        """Speculative rounds (independent draft) stay greedy-exact."""
+        cfg, params = model
+        draft = transformer_init(jax.random.PRNGKey(9), cfg)
+        srv = InferenceServer(params, cfg, max_seq_tokens=24,
+                              max_batch=2, page_tokens=4,
+                              draft_params=draft, draft_cfg=cfg,
+                              gamma=3, force_spec=True)
+        rng = np.random.RandomState(3)
+        reqs = []
+        for _ in range(4):
+            prompt = rng.randint(0, 64, size=4)
+            reqs.append((srv.submit(prompt, 6), prompt))
+        by_id = {s.req.req_id: s.generated for s in srv.run()}
+        assert srv.spec_steps > 0
+        for rid, prompt in reqs:
+            ref, _ = transformer_generate(
+                params, cfg, jnp.asarray(prompt[None], jnp.int32), 6)
+            assert by_id[rid] == np.asarray(ref)[0].tolist()
+
+    def test_eos_stops_row(self, model):
+        cfg, params = model
+        prompt = np.arange(4, dtype=np.int32)
+        ref, _ = transformer_generate(
+            params, cfg, jnp.asarray(prompt[None]), 8)
+        eos = int(np.asarray(ref)[0, 2])
+        srv = InferenceServer(params, cfg, max_seq_tokens=16,
+                              max_batch=2, page_tokens=4)
+        srv.submit(prompt, 8, eos_id=eos)
+        (seq,) = srv.run()
+        assert seq.generated[-1] == eos and len(seq.generated) <= 3
+        assert seq.generated == np.asarray(ref)[
+            0, :len(seq.generated)].tolist()
+
+
+class TestBenchRecords:
+    def test_append_and_stale_gate(self, tmp_path, caplog):
+        path = str(tmp_path / "BENCH_serve.json")
+        assert read_latest_record(path) is None
+        append_record(path, {"bench": "decode_bench", "x": 1})
+        rec = read_latest_record(path)
+        assert rec["x"] == 1 and rec["stale"] is False
+        assert "captured_utc" in rec
+        # age a record past the gate
+        old = {"bench": "decode_bench", "x": 2,
+               "captured_unix": time.time() - 100 * 3600}
+        with open(path, "a") as f:
+            f.write(json.dumps(old) + "\n")
+        with caplog.at_level("WARNING"):
+            rec = read_latest_record(path)
+        assert rec["stale"] is True and rec["stale_hours"] > 24
+        assert any("stale" in m for m in caplog.messages)
+
+    def test_run_trace_stats(self, model):
+        cfg, params = model
+        trace = make_trace(3, 5, cfg.vocab_size, prompt_lens=(3, 5),
+                           max_new_lo=2, max_new_hi=6,
+                           arrival_every=1.0)
+        srv = InferenceServer(params, cfg, max_seq_tokens=16,
+                              max_batch=2, page_tokens=4)
+        stats = run_trace(srv, trace)
+        assert stats["tokens_out"] == sum(mn for _, _, mn in trace)
+        assert 0 < stats["batch_occupancy_mean"] <= 1
+        assert 0 < stats["kv_pool_peak_utilization"] <= 1
+        assert stats["request_p99_ms"] >= stats["request_p50_ms"]
+
+    def test_make_trace_deterministic_and_bimodal(self):
+        t1 = make_trace(5, 20, 64, long_frac=0.5, long_lo=90,
+                        long_hi=99)
+        t2 = make_trace(5, 20, 64, long_frac=0.5, long_lo=90,
+                        long_hi=99)
+        assert all((a[0] == b[0] and a[2] == b[2]
+                    and np.array_equal(a[1], b[1]))
+                   for a, b in zip(t1, t2))
+        assert any(mn >= 90 for _, _, mn in t1)
+        assert any(mn < 90 for _, _, mn in t1)
+
+
+@pytest.mark.slow
+class TestReplicaElastic:
+    """np=2-style e2e: two serving replicas over the rendezvous
+    control plane; the serve.replica_die fault kills one mid-stream;
+    the manager's lease/respawn recovers with no lost sequence and
+    token-identical results."""
+
+    CONFIG = {
+        "cfg": dict(vocab_size=64, d_model=32, n_heads=4, d_head=8,
+                    d_ff=64, n_layers=2, compute_dtype="float32"),
+        "seed": 0,
+        "serve": dict(max_seq_tokens=24, max_batch=2, page_tokens=4),
+    }
+
+    def _requests(self):
+        rng = np.random.RandomState(1)
+        return [(rng.randint(0, 64, size=4).tolist(),
+                 int(rng.randint(2, 6))) for _ in range(6)]
+
+    def _serve(self, child_env):
+        from horovod_tpu.serve.replica import ReplicaManager
+        env = {"JAX_PLATFORMS": "cpu"}
+        env.update(child_env)
+        with ReplicaManager(2, self.CONFIG, lease_ttl=10.0,
+                            respawn_backoff=0.2,
+                            child_env=env) as mgr:
+            for prompt, mn in self._requests():
+                mgr.submit(prompt, mn)
+            results = mgr.wait_all(timeout=180)
+            respawns = mgr._respawns
+        return results, respawns
+
+    def test_replica_death_recovers_all_sequences(self):
+        baseline, r0 = self._serve({})
+        assert r0 == 0
+        assert len(baseline) == 6
+        recovered, r1 = self._serve({
+            "HOROVOD_FAULT_SPEC": "serve.replica_die@3:exit:1",
+            "HOROVOD_FAULT_HOSTS": "replica1",
+        })
+        assert r1 >= 1                      # the dead replica respawned
+        assert recovered == baseline        # no lost/garbled sequence
