@@ -140,6 +140,15 @@ def main():
               f"{s['batch_occupancy_mean']:4.2f})  speedup "
               f"{res['speedup_tokens_per_sec']:5.2f}x",
               file=sys.stderr, flush=True)
+        # TTFT / inter-token percentiles come from the serving
+        # histograms (hvd_serve_ttft_seconds / _intertoken_seconds),
+        # delta-snapshotted per replay by run_trace.
+        print(f"{'':10s} ttft p50/p99 "
+              f"{f.get('ttft_p50_ms', 0.0):7.1f}/"
+              f"{f.get('ttft_p99_ms', 0.0):7.1f} ms   itl p50/p99 "
+              f"{f.get('itl_p50_ms', 0.0):6.2f}/"
+              f"{f.get('itl_p99_ms', 0.0):6.2f} ms",
+              file=sys.stderr, flush=True)
     if records:
         rec = {"bench": "decode_bench", "kind": "continuous_vs_static",
                "tiny": bool(args.tiny), "configs": records}

@@ -403,6 +403,21 @@ CATALOG: Tuple[EnvVar, ...] = (
        "Speculative draft length per serving round (autotuner knob "
        "serve_spec_gamma; compiled verify-chunk width).",
        "SERVING.md"),
+    _v("HOROVOD_SERVE_METRICS_INTERVAL", "16", "serve",
+       "Steps between serving-gauge samples (queue depth, occupancy, "
+       "pool pages, p99); a final unconditional flush runs at drain "
+       "and atexit so shorter runs still report.",
+       "SERVING.md"),
+    _v("HOROVOD_SERVE_FLIGHTREC_DEPTH", "512", "serve",
+       "Flight-recorder ring depth in events (autotuner knob "
+       "serve_flightrec_depth, host_only: never part of the "
+       "program-cache key); <= 0 disables the recorder.",
+       "SERVING.md"),
+    _v("HOROVOD_SERVE_FLIGHTREC_DIR", ".", "serve",
+       "Directory flight-recorder dumps are written to on a trigger "
+       "(crash, pool exhaustion, SLO breach, guard escalation, "
+       "injected replica death).",
+       "SERVING.md"),
 )
 
 #: Literal prefixes that legitimately appear in code (startswith filters

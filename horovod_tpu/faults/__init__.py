@@ -38,6 +38,8 @@ from .spec import (  # noqa: F401  (re-export)
     FaultSchedule,
     parse_duration,
     parse_spec,
+    register_exit_hook,
+    unregister_exit_hook,
 )
 
 logger = logging.getLogger("horovod_tpu.faults")
@@ -45,6 +47,7 @@ logger = logging.getLogger("horovod_tpu.faults")
 __all__ = [
     "CATALOG", "FaultInjected", "FaultSchedule", "RetryPolicy",
     "active", "clear", "install", "parse_spec", "point",
+    "register_exit_hook", "unregister_exit_hook",
 ]
 
 # Every fault point the runtime exposes.  Kept flat + literal so the lint

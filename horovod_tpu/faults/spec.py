@@ -35,7 +35,7 @@ import random
 import re
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..common.exceptions import HorovodTpuError
 
@@ -192,8 +192,42 @@ class FaultSchedule:
             return act
         if act.mode == "exit":
             logger.warning("fault injected: %r — exiting", act)
+            # os._exit skips atexit, so consumers that must flush state
+            # on an injected death (the serving flight recorder) hook
+            # in here instead of relying on interpreter teardown.
+            _run_exit_hooks(f"fault_exit:{point}")
             os._exit(act.exit_code)
         return act
+
+
+# ---------------------------------------------------------------------------
+# Pre-exit hooks: called (best effort) before an `exit`-mode fault's
+# os._exit, which bypasses atexit entirely.
+# ---------------------------------------------------------------------------
+
+_exit_hooks: List[Callable[[str], None]] = []
+
+
+def register_exit_hook(fn: Callable[[str], None]) -> None:
+    """Register `fn(reason)` to run before an exit-mode fault point
+    terminates the process.  Idempotent per function object."""
+    if fn not in _exit_hooks:
+        _exit_hooks.append(fn)
+
+
+def unregister_exit_hook(fn: Callable[[str], None]) -> None:
+    if fn in _exit_hooks:
+        _exit_hooks.remove(fn)
+
+
+def _run_exit_hooks(reason: str) -> None:
+    for fn in list(_exit_hooks):
+        # lint: allow-swallow(the process is exiting; a failed flush
+        # hook must not mask the injected exit)
+        try:
+            fn(reason)
+        except Exception:  # noqa: BLE001
+            logger.exception("fault exit hook failed")
 
 
 def _record_injection(point: str, mode: str) -> None:

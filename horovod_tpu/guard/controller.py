@@ -236,6 +236,14 @@ class TrainingGuard:
         from ..ops import wire as _wire
         if _met.enabled():
             _met.guard_rollbacks.inc()
+        try:
+            # Guard escalation is a flight-recorder dump trigger
+            # (docs/SERVING.md): a co-located serving replica's ring is
+            # post-mortem context for whatever corrupted training.
+            from ..serve import flightrec as _fr
+            _fr.dump_all("guard_escalation")
+        except Exception:  # lint: allow-swallow(best-effort forensics)
+            pass           # rollback must proceed regardless
         restored = None
         if self._mgr is not None:
             restored = self._mgr.restore_latest(template=template)

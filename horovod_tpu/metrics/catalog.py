@@ -220,6 +220,24 @@ serve_p99_ms = _REG.gauge(
     "Observed p99 per-token decode latency over the SLO controller's "
     "sliding window (the signal that toggles speculative decoding "
     "against HOROVOD_SERVE_SLO_MS).")
+serve_ttft = _REG.histogram(
+    "hvd_serve_ttft_seconds",
+    "Time to first token: request submit to its first emitted token "
+    "(queue wait + prefill + the first decode dispatch), log4 buckets "
+    "1us..67s.")
+serve_intertoken = _REG.histogram(
+    "hvd_serve_intertoken_seconds",
+    "Inter-token latency: server step wall time divided by tokens "
+    "decided that step (speculative rounds amortize over accepted "
+    "drafts), observed once per decode step.")
+serve_queue_delay = _REG.histogram(
+    "hvd_serve_queue_delay_seconds",
+    "Admission queue delay: request submit to batch-row admission "
+    "(back-pressure from rows or KV pages).")
+serve_e2e_latency = _REG.histogram(
+    "hvd_serve_e2e_latency_seconds",
+    "End-to-end request latency: submit to completion/eviction "
+    "(= queue delay + prefill + decode).")
 
 _enabled = not util.env_bool("METRICS_DISABLE", False)
 

@@ -7,8 +7,10 @@ Layout (docs/SERVING.md):
   - server.py    the decode loop tying them together (InferenceServer)
   - loadgen.py   seeded load generator + bench stats (make_trace, ...)
   - replica.py   elastic multi-replica serving (ReplicaManager)
+  - flightrec.py always-on crash/breach flight recorder (FlightRecorder)
 """
 
+from .flightrec import FlightRecorder
 from .pool import PagedKVPool, PoolExhaustedError
 from .scheduler import ActiveSeq, ContinuousScheduler, POLICIES, Request
 from .server import InferenceServer
@@ -17,6 +19,7 @@ from .slo import SloController
 __all__ = [
     "ActiveSeq",
     "ContinuousScheduler",
+    "FlightRecorder",
     "InferenceServer",
     "POLICIES",
     "PagedKVPool",

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import time
 from typing import Dict, List, Optional, Tuple
@@ -31,6 +32,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common.exceptions import InvalidRequestError
+from ..metrics import catalog as _met
 from .server import InferenceServer
 
 logger = logging.getLogger("horovod_tpu.serve.loadgen")
@@ -74,10 +76,48 @@ def make_trace(seed: int, n_requests: int, vocab_size: int,
     return trace
 
 
+def hist_cumulative(hist) -> List[Tuple[float, int]]:
+    """Snapshot of an UNLABELED histogram's cumulative bucket counts —
+    (upper_bound, cumulative_count) pairs ending with +Inf."""
+    return hist._solo().cumulative()
+
+
+def hist_delta_quantile(before: List[Tuple[float, int]],
+                        after: List[Tuple[float, int]],
+                        q: float) -> float:
+    """Quantile `q` (percent) of the observations a histogram gained
+    BETWEEN two `hist_cumulative` snapshots, linearly interpolated
+    within the containing bucket.  Delta-based on purpose: the metrics
+    registry is process-global, so an absolute read would mix every
+    earlier bench rep / warmup into this rep's percentile."""
+    target_total = after[-1][1] - before[-1][1]
+    if target_total <= 0:
+        return 0.0
+    target = q / 100.0 * target_total
+    lo, prev_cum = 0.0, 0
+    for (ub, ca), (_, cb) in zip(after, before):
+        cum = ca - cb
+        if cum >= target:
+            in_bucket = cum - prev_cum
+            if math.isinf(ub) or not in_bucket:
+                return lo
+            return lo + (target - prev_cum) / in_bucket * (ub - lo)
+        if not math.isinf(ub):
+            lo = ub
+        prev_cum = cum
+    return lo
+
+
 def run_trace(server: InferenceServer, trace: Trace,
               max_steps: int = 200000) -> Dict:
-    """Replay a trace to completion; returns the stats record."""
+    """Replay a trace to completion; returns the stats record, with
+    TTFT / inter-token percentiles read from the serving histograms
+    (delta over this replay only)."""
     pending = sorted(range(len(trace)), key=lambda i: trace[i][0])
+    hist0 = None
+    if _met.enabled():
+        hist0 = (hist_cumulative(_met.serve_ttft),
+                 hist_cumulative(_met.serve_intertoken))
     peak_util = 0.0
     t0 = time.perf_counter()
     steps = 0
@@ -94,7 +134,22 @@ def run_trace(server: InferenceServer, trace: Trace,
         raise InvalidRequestError(
             f"trace did not drain within {max_steps} steps")
     wall_s = time.perf_counter() - t0
-    return server_stats(server, wall_s, peak_util)
+    server.flush_metrics()
+    stats = server_stats(server, wall_s, peak_util)
+    if hist0 is not None:
+        ttft1 = hist_cumulative(_met.serve_ttft)
+        itl1 = hist_cumulative(_met.serve_intertoken)
+        stats.update({
+            "ttft_p50_ms":
+                hist_delta_quantile(hist0[0], ttft1, 50) * 1e3,
+            "ttft_p99_ms":
+                hist_delta_quantile(hist0[0], ttft1, 99) * 1e3,
+            "itl_p50_ms":
+                hist_delta_quantile(hist0[1], itl1, 50) * 1e3,
+            "itl_p99_ms":
+                hist_delta_quantile(hist0[1], itl1, 99) * 1e3,
+        })
+    return stats
 
 
 def _pct(xs: List[float], q: float) -> float:
@@ -167,5 +222,6 @@ def read_latest_record(path: str) -> Optional[Dict]:
     return rec
 
 
-__all__ = ["Trace", "append_record", "make_trace", "read_latest_record",
+__all__ = ["Trace", "append_record", "hist_cumulative",
+           "hist_delta_quantile", "make_trace", "read_latest_record",
            "run_trace", "server_stats"]

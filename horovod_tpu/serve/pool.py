@@ -33,7 +33,7 @@ step on small models.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -165,6 +165,11 @@ class PagedKVPool:
         # 0, 1, 2, ... — deterministic reuse order for the tests.
         self._free: List[int] = list(range(total_pages - 1, -1, -1))
         self.pages: Dict[int, List[int]] = {}
+        #: Optional observer called after every alloc/free with
+        #: (event, seq_id, n_pages, pages_free) — the server wires this
+        #: to the flight recorder.  Observational only.
+        self.on_event: Optional[Callable[[str, int, int, int],
+                                         None]] = None
 
     # -- accounting ----------------------------------------------------
 
@@ -200,6 +205,8 @@ class PagedKVPool:
         pids = [self._free.pop() for _ in range(need)]
         self._zero_pages(pids)
         self.pages[seq_id] = pids
+        if self.on_event is not None:
+            self.on_event("alloc", seq_id, len(pids), len(self._free))
         return pids
 
     def free(self, seq_id: int) -> List[int]:
@@ -212,6 +219,8 @@ class PagedKVPool:
         # Reversed so the most-recently-used page sits on top and the
         # next alloc reuses it first (cache-warm, deterministic).
         self._free.extend(reversed(pids))
+        if self.on_event is not None:
+            self.on_event("free", seq_id, len(pids), len(self._free))
         return pids
 
     def _zero_pages(self, pids: Sequence[int]) -> None:

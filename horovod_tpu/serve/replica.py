@@ -259,6 +259,23 @@ def _build_server(config: Dict):
 
 def main() -> None:
     rid = int(os.environ["HOROVOD_SERVE_REPLICA_ID"])
+    # Per-replica timeline: HOROVOD_TIMELINE=/path.json on the manager
+    # (or in child_env) gives each replica its own `.rank<rid>` file
+    # with pid=rid, so `python -m horovod_tpu.trace merge` lays every
+    # replica's request lanes side by side and can stitch a reassigned
+    # request's spans across processes with flow arrows.
+    tl_base = os.environ.get("HOROVOD_TIMELINE")
+    if tl_base:
+        from ..utils.timeline import start_timeline
+        # A respawned incarnation must not overwrite the dead one's
+        # file — those events are what lets the merge stitch a
+        # reassigned request's lane across processes.  First incarnation
+        # gets the documented `.rank<rid>` name; respawns suffix it.
+        tl_path, k = f"{tl_base}.rank{rid}", 0
+        while os.path.exists(tl_path):
+            k += 1
+            tl_path = f"{tl_base}.rank{rid}.respawn{k}"
+        start_timeline(tl_path, rank=rid)
     client = RendezvousClient(
         os.environ["HOROVOD_RENDEZVOUS_ADDR"],
         int(os.environ["HOROVOD_RENDEZVOUS_PORT"]),

@@ -95,10 +95,21 @@ class ContinuousScheduler:
         self.active: Dict[int, ActiveSeq] = {}       # row -> seq
         self._free_rows: List[int] = list(range(max_batch - 1, -1, -1))
         self.decision_log: List[Tuple[int, str, int, int]] = []
+        #: Optional mirror of decision_log appends, called with the same
+        #: (step, event, req_id, row) tuple — the server wires this to
+        #: the flight recorder.  Purely observational: it must not (and
+        #: cannot) change admission order.
+        self.observer: Optional[
+            Callable[[int, str, int, int], None]] = None
+
+    def _log(self, step: int, event: str, req_id: int, row: int) -> None:
+        self.decision_log.append((step, event, req_id, row))
+        if self.observer is not None:
+            self.observer(step, event, req_id, row)
 
     def submit(self, req: Request, step: int) -> None:
         self.queue.append(req)
-        self.decision_log.append((step, "submit", req.req_id, -1))
+        self._log(step, "submit", req.req_id, -1)
 
     def queue_depth(self) -> int:
         return len(self.queue)
@@ -124,7 +135,7 @@ class ContinuousScheduler:
             row = self._free_rows.pop()
             seq = ActiveSeq(req=req, row=row, pos=0, admit_step=step)
             self.active[row] = seq
-            self.decision_log.append((step, "admit", req.req_id, row))
+            self._log(step, "admit", req.req_id, row)
             out.append(seq)
         return out
 
@@ -137,7 +148,7 @@ class ContinuousScheduler:
         self._free_rows.append(row)
         # Keep row handout deterministic regardless of eviction order.
         self._free_rows.sort(reverse=True)
-        self.decision_log.append((step, "evict", seq.req.req_id, row))
+        self._log(step, "evict", seq.req.req_id, row)
         return seq
 
     def drained(self) -> bool:

@@ -41,8 +41,10 @@ autotuner knobs are part of the compiled-shape key (docs/AUTOTUNE.md).
 
 from __future__ import annotations
 
+import atexit
 import functools
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -59,7 +61,9 @@ from ..models.decode import (
     transformer_prefill,
 )
 from ..utils import autotune
-from .pool import PagedKVPool
+from ..utils.timeline import get_timeline
+from .flightrec import FlightRecorder
+from .pool import PagedKVPool, PoolExhaustedError
 from .scheduler import ActiveSeq, ContinuousScheduler, Request
 from .slo import SloController
 
@@ -67,6 +71,12 @@ from .slo import SloController
 @functools.lru_cache(maxsize=None)
 def _prefill_fn(cfg):
     return jax.jit(lambda p, c, t: transformer_prefill(p, c, t, cfg))
+
+
+def _flush_at_exit(ref: "weakref.ref") -> None:
+    srv = ref()
+    if srv is not None:
+        srv.flush_metrics()
 
 
 class InferenceServer:
@@ -128,6 +138,42 @@ class InferenceServer:
             slo_ms = util.env_float("SERVE_SLO_MS", 0.0)
         self.slo = SloController(slo_ms)
         self.force_spec = force_spec
+        # Gauge sampling cadence (HOROVOD_SERVE_METRICS_INTERVAL): the
+        # p99 percentile over the SLO window costs more than a whole
+        # decode dispatch on small models, so gauges are sampled, with
+        # one unconditional flush at drain/atexit (flush_metrics) so
+        # runs shorter than the interval still report.
+        self._metrics_interval = max(
+            1, util.env_int("SERVE_METRICS_INTERVAL", 16))
+        # Always-on flight recorder (docs/SERVING.md): depth <= 0
+        # disables it.  Host-side only — the depth knob never touches
+        # compiled shapes (host_only in autotune, out of the program-
+        # cache key).
+        depth = autotune.current_serve_flightrec_depth()
+        self.flightrec: Optional[FlightRecorder] = \
+            FlightRecorder(depth) if depth > 0 else None
+        if self.flightrec is not None:
+            rec = self.flightrec
+            self.sched.observer = lambda step, event, req, row: \
+                rec.record("sched", {"event": event, "req": req,
+                                     "row": row}, step=step)
+            self.pool.on_event = lambda ev, sid, n, free: \
+                rec.record("pool", {"event": ev, "req": sid,
+                                    "pages": n, "free": free},
+                           step=self.step_no)
+            if self.dpool is not None:
+                self.dpool.on_event = lambda ev, sid, n, free: \
+                    rec.record("dpool", {"event": ev, "req": sid,
+                                         "pages": n, "free": free},
+                               step=self.step_no)
+        self.slo.on_flip = self._on_slo_flip
+        # Per-request lifecycle state feeding the timeline spans, the
+        # latency histograms, and the flight recorder.
+        self._req_obs: Dict[int, Dict] = {}
+        # atexit flush through a weakref so short-lived servers (tests,
+        # benches) are still collectable.
+        ref = weakref.ref(self)
+        atexit.register(_flush_at_exit, ref)
 
         V = cfg.vocab_size
         self.row_pos = np.zeros(self.max_batch, np.int64)
@@ -164,6 +210,18 @@ class InferenceServer:
                       max_new_tokens=max_new_tokens, eos_id=eos_id,
                       arrival_step=self.step_no)
         self._submit_wall[req_id] = time.perf_counter()
+        tl = get_timeline()
+        self._req_obs[req_id] = {
+            "submit_us": tl.now_us() if tl is not None else None,
+            "admit_us": None, "prefill_end_us": None,
+            "wall_prefill_end": None, "first": False, "spec_ms": 0.0,
+        }
+        if tl is not None:
+            tl.instant("serve_submit", category="serve",
+                       args={"req": req_id,
+                             "prompt_tokens": int(prompt.size),
+                             "max_new": int(max_new_tokens)},
+                       tid=f"req/{req_id}")
         self.sched.submit(req, self.step_no)
         return req_id
 
@@ -192,33 +250,118 @@ class InferenceServer:
 
     def _admit(self) -> None:
         for seq in self.sched.admit(self.step_no, self._can_admit):
+            rid = seq.req.req_id
+            obs = self._req_obs.get(rid)
+            tl = get_timeline()
+            t_submit = self._submit_wall.get(rid)
+            if t_submit is not None and _met.enabled():
+                _met.serve_queue_delay.observe(
+                    time.perf_counter() - t_submit)
+            if tl is not None and obs is not None \
+                    and obs["submit_us"] is not None:
+                # queue_wait ends exactly where prefill starts: the
+                # stamp captured right after this complete() call is the
+                # prefill span's start, so the request's three spans
+                # abut and their durations sum to its e2e latency.
+                tl.complete("queue_wait", category="serve",
+                            start_us=obs["submit_us"],
+                            args={"req": rid}, tid=f"req/{rid}")
+            t_prefill_us = tl.now_us() if tl is not None else None
+            wall_prefill = time.perf_counter()
             budget = self._budget_tokens(seq.req)
-            pids = self.pool.alloc(seq.req.req_id, budget)
+            pids = self.pool.alloc(rid, budget)
             lg = self._prefill_into(self.pool, self.params, self.cfg,
                                     seq, len(pids))
             if self.dpool is not None:
-                dpids = self.dpool.alloc(seq.req.req_id, budget)
+                dpids = self.dpool.alloc(rid, budget)
                 self._prefill_into(self.dpool, self.draft_params,
                                    self.draft_cfg, seq, len(dpids))
             T0 = int(seq.req.prompt.size)
+            if obs is not None:
+                obs["admit_us"] = t_prefill_us
+            if tl is not None and t_prefill_us is not None:
+                tl.complete("prefill", category="serve",
+                            start_us=t_prefill_us,
+                            args={"req": rid, "prompt_tokens": T0,
+                                  "row": seq.row},
+                            tid=f"req/{rid}")
+            if obs is not None:
+                obs["prefill_end_us"] = (tl.now_us()
+                                         if tl is not None else None)
+                obs["wall_prefill_end"] = time.perf_counter()
+            if self.flightrec is not None:
+                dur_us = (time.perf_counter() - wall_prefill) * 1e6
+                end = self.flightrec.now_us()
+                self.flightrec.record(
+                    "span", {"name": "prefill", "req": rid,
+                             "prompt_tokens": T0, "row": seq.row},
+                    step=self.step_no, ts_us=end - dur_us,
+                    dur_us=dur_us)
             seq.pos = T0
             self.row_pos[seq.row] = T0
             self.last_logits[seq.row] = np.asarray(lg)[0]
-            self.row_seq[seq.row] = seq.req.req_id
-            self._dirty_rows[seq.row] = seq.req.req_id
+            self.row_seq[seq.row] = rid
+            self._dirty_rows[seq.row] = rid
+
+    def _first_token(self, seq: ActiveSeq) -> None:
+        """Called once per request, right after its first token is
+        decided — TTFT = queue wait + prefill + the first decode
+        dispatch, measured from submit."""
+        rid = seq.req.req_id
+        obs = self._req_obs.get(rid)
+        if obs is None or obs["first"]:
+            return
+        obs["first"] = True
+        t0 = self._submit_wall.get(rid)
+        if t0 is not None and _met.enabled():
+            _met.serve_ttft.observe(time.perf_counter() - t0)
+        tl = get_timeline()
+        if tl is not None:
+            tl.instant("serve_first_token", category="serve",
+                       args={"req": rid, "step": self.step_no},
+                       tid=f"req/{rid}")
+        if self.flightrec is not None:
+            self.flightrec.record("first_token", {"req": rid},
+                                  step=self.step_no)
 
     def _finish(self, seq: ActiveSeq) -> None:
+        rid = seq.req.req_id
         self.sched.evict(self.step_no, seq.row)
-        self.pool.free(seq.req.req_id)
+        self.pool.free(rid)
         if self.dpool is not None:
-            self.dpool.free(seq.req.req_id)
+            self.dpool.free(rid)
         self.row_seq[seq.row] = None
         self.row_pos[seq.row] = 0
         self._dirty_rows.pop(seq.row, None)
-        t0 = self._submit_wall.pop(seq.req.req_id, None)
+        t0 = self._submit_wall.pop(rid, None)
         if t0 is not None:
             self.request_latencies_ms.append(
                 (time.perf_counter() - t0) * 1e3)
+            if _met.enabled():
+                _met.serve_e2e_latency.observe(time.perf_counter() - t0)
+        obs = self._req_obs.pop(rid, None)
+        tl = get_timeline()
+        if tl is not None:
+            if obs is not None and obs["prefill_end_us"] is not None:
+                tl.complete("decode", category="serve",
+                            start_us=obs["prefill_end_us"],
+                            args={"req": rid,
+                                  "tokens": len(seq.generated),
+                                  "spec_ms": round(obs["spec_ms"], 3)},
+                            tid=f"req/{rid}")
+            tl.instant("serve_evict", category="serve",
+                       args={"req": rid,
+                             "tokens": len(seq.generated)},
+                       tid=f"req/{rid}")
+        if self.flightrec is not None and obs is not None \
+                and obs["wall_prefill_end"] is not None:
+            dur_us = (time.perf_counter()
+                      - obs["wall_prefill_end"]) * 1e6
+            self.flightrec.record(
+                "span", {"name": "decode", "req": rid,
+                         "tokens": len(seq.generated)},
+                step=self.step_no,
+                ts_us=self.flightrec.now_us() - dur_us, dur_us=dur_us)
 
     def _refresh_views(self) -> None:
         """Bring the pooled decode view up to date: a full gather the
@@ -243,7 +386,25 @@ class InferenceServer:
 
     def step(self) -> List[ActiveSeq]:
         """One scheduler+decode iteration; returns sequences finished
-        THIS step (their ``generated`` lists are complete)."""
+        THIS step (their ``generated`` lists are complete).
+
+        A crash inside the step — including ``PoolExhaustedError`` —
+        dumps the flight recorder BEFORE the exception propagates, so
+        the post-mortem ring always covers the failing step."""
+        try:
+            return self._step_impl()
+        except BaseException as e:
+            if self.flightrec is not None:
+                reason = ("pool_exhausted"
+                          if isinstance(e, PoolExhaustedError)
+                          else f"crash:{type(e).__name__}")
+                self.flightrec.record(
+                    "error", {"type": type(e).__name__,
+                              "msg": str(e)[:200]}, step=self.step_no)
+                self.flightrec.dump(reason)
+            raise
+
+    def _step_impl(self) -> List[ActiveSeq]:
         t0 = time.perf_counter()
         self._admit()
         finished: List[ActiveSeq] = []
@@ -255,6 +416,8 @@ class InferenceServer:
                 seq.generated.append(tok)
                 self.tokens_out += 1
                 feed[row] = tok
+                if len(seq.generated) == 1:
+                    self._first_token(seq)
             if seq.done:
                 finished.append(seq)
                 self._finish(seq)
@@ -265,7 +428,15 @@ class InferenceServer:
             spec = (self.draft_params is not None
                     and (self.force_spec or self.slo.update(self.step_no)))
             if spec:
+                t_spec = time.perf_counter()
                 decided = self._spec_round(rows, feed)
+                spec_ms = (time.perf_counter() - t_spec) * 1e3
+                for r in rows:
+                    sid = self.row_seq[r]
+                    ob = (self._req_obs.get(sid)
+                          if sid is not None else None)
+                    if ob is not None:
+                        ob["spec_ms"] += spec_ms
                 self.spec_steps += 1
             else:
                 self._plain_step(rows, feed)
@@ -275,7 +446,13 @@ class InferenceServer:
             per_tok = dt_ms / (1 + decided)
             self.token_latencies_ms.append(per_tok)
             self.slo.record(per_tok)
+            if _met.enabled():
+                _met.serve_intertoken.observe(per_tok / 1e3)
         self._update_gauges()
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "step", {"rows": len(rows), "decided": 1 + decided,
+                         "finished": len(finished)}, step=self.step_no)
         self.step_no += 1
         return finished
 
@@ -366,6 +543,7 @@ class InferenceServer:
             if self.sched.drained():
                 break
             done.extend(self.step())
+        self.flush_metrics()
         if not self.sched.drained():
             raise InvalidRequestError(
                 f"server did not drain within {max_steps} steps "
@@ -379,14 +557,40 @@ class InferenceServer:
     def _update_gauges(self) -> None:
         # Sampled, not per-step: the p99 percentile over the SLO window
         # costs more than a whole decode dispatch on small models.
-        if not _met.enabled() or self.step_no % 16:
+        if not _met.enabled() \
+                or self.step_no % self._metrics_interval:
             return
+        self._set_gauges()
+
+    def _set_gauges(self) -> None:
         _met.serve_queue_depth.set(self.sched.queue_depth())
         _met.serve_batch_occupancy.set(self.sched.occupancy())
         _met.serve_pool_pages_free.set(self.pool.pages_free())
         p99 = self.slo.p99_ms()
         if p99:
             _met.serve_p99_ms.set(p99)
+
+    def flush_metrics(self) -> None:
+        """Unconditional gauge sample — called at drain and atexit so a
+        run shorter than ``HOROVOD_SERVE_METRICS_INTERVAL`` steps still
+        exports its final state."""
+        if _met.enabled():
+            self._set_gauges()
+
+    def _on_slo_flip(self, step: int, event: str, p99: float) -> None:
+        tl = get_timeline()
+        if tl is not None:
+            tl.instant("slo_toggle", category="serve",
+                       args={"step": step, "event": event,
+                             "p99_ms": round(p99, 3)})
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "slo", {"event": event, "p99_ms": round(p99, 3)},
+                step=step)
+            if event == "spec_on":
+                # The SLO just went over budget — snapshot the ring so
+                # the breach is diagnosable even if the run recovers.
+                self.flightrec.dump("slo_breach")
 
 
 __all__ = ["InferenceServer"]

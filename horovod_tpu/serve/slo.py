@@ -24,7 +24,7 @@ control trace deterministically from a recorded latency sequence.
 from __future__ import annotations
 
 from collections import deque
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -50,6 +50,12 @@ class SloController:
         self.spec_on = False
         self._last_flip = -(dwell_steps + 1)
         self.decisions: List[Tuple[int, str, float]] = []
+        #: Optional mirror of `decisions` appends, called with the same
+        #: (step, event, p99_ms) tuple the decision trace records — the
+        #: server wires this to the timeline (`slo_toggle` instant) and
+        #: the flight recorder (spec_on = the SLO-breach dump trigger).
+        self.on_flip: Optional[
+            Callable[[int, str, float], None]] = None
 
     def record(self, step_ms: float) -> None:
         self._lat.append(float(step_ms))
@@ -70,10 +76,14 @@ class SloController:
             self.spec_on = True
             self._last_flip = step
             self.decisions.append((step, "spec_on", p99))
+            if self.on_flip is not None:
+                self.on_flip(step, "spec_on", p99)
         elif self.spec_on and p99 < self.slo_ms * self.hysteresis:
             self.spec_on = False
             self._last_flip = step
             self.decisions.append((step, "spec_off", p99))
+            if self.on_flip is not None:
+                self.on_flip(step, "spec_off", p99)
         return self.spec_on
 
 

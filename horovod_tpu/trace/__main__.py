@@ -7,6 +7,12 @@
     # per-step critical path + straggler attribution (JSON report)
     python -m horovod_tpu.trace analyze train.json train.rank*.json
 
+    # per-REQUEST latency decomposition from serve lifecycle spans
+    python -m horovod_tpu.trace analyze --serve serve.json.rank*
+
+    # render a crash/breach flight-recorder dump to Perfetto
+    python -m horovod_tpu.trace flightrec serve_flightrec.replica1.123.json
+
 Inputs are the per-rank HOROVOD_TIMELINE files from a run with
 HOROVOD_TIMELINE_ALL_RANKS=1 and HOROVOD_TIMELINE_MARK_CYCLES=1 (the
 CYCLE_n barrier instants are what the ranks are clock-aligned on).
@@ -45,6 +51,16 @@ def main(argv=None) -> int:
     anp.add_argument("-o", "--out", default=None,
                      help="also write the JSON report here")
     anp.add_argument("--align", choices=("cycle", "wall"), default=None)
+    anp.add_argument("--serve", action="store_true",
+                     help="per-REQUEST latency decomposition from the "
+                          "serve lifecycle spans (queue/prefill/decode/"
+                          "spec-verify) instead of per-step attribution")
+
+    fp = sub.add_parser("flightrec",
+                        help="render a flight-recorder dump "
+                             "(serve_flightrec.*.json) to Perfetto")
+    fp.add_argument("dump", metavar="FLIGHTREC_DUMP")
+    fp.add_argument("-o", "--out", default="flightrec_trace.json")
 
     args = ap.parse_args(argv)
     if args.cmd == "merge":
@@ -56,7 +72,18 @@ def main(argv=None) -> int:
               f"ranks {md['ranks']}, {md['flow_events']} flow events, "
               f"align={md['align']}")
         return 0
-    report = core.analyze(args.files, align=args.align)
+    if args.cmd == "flightrec":
+        trace = core.flightrec_to_trace(args.dump)
+        core.write_merged(trace, args.out)
+        md = trace["metadata"]
+        print(f"wrote {args.out}: {len(trace['traceEvents'])} events, "
+              f"reason={md['reason']}, replica={md['replica']}, "
+              f"dropped={md['dropped']}")
+        return 0
+    if args.serve:
+        report = core.analyze_serve(args.files, align=args.align)
+    else:
+        report = core.analyze(args.files, align=args.align)
     text = json.dumps(report, indent=2, sort_keys=True)
     if args.out:
         with open(args.out, "w") as f:

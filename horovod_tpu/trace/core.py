@@ -40,7 +40,8 @@ import statistics
 from typing import Dict, List, Optional, Sequence, Union
 
 __all__ = ["load_events", "load_rank_traces", "cycle_arrivals",
-           "clock_offsets", "merge", "write_merged", "analyze"]
+           "clock_offsets", "merge", "write_merged", "analyze",
+           "analyze_serve", "flightrec_to_trace", "load_flightrec"]
 
 _CYCLE_RE = re.compile(r"^CYCLE_(\d+)$")
 _RANK_FILE_RE = re.compile(r"\.rank(\d+)\.")
@@ -89,11 +90,10 @@ def load_rank_traces(paths: Sequence[str]) -> Traces:
     for p in paths:
         events = load_events(p)
         rank = _rank_of(p, events)
-        if rank in traces:
-            raise ValueError(
-                f"{p}: rank {rank} already loaded — pass one timeline "
-                "file per rank")
-        traces[rank] = events
+        # Several files carrying the same pid concatenate into one lane:
+        # a respawned serving replica's incarnations each write their own
+        # file (`.rank<k>` / `.rank<k>.respawn<j>`) but share a replica id.
+        traces.setdefault(rank, []).extend(events)
     return traces
 
 
@@ -153,11 +153,18 @@ def _flow_groups(traces: Traces) -> Dict[tuple, List[dict]]:
         for ev in events:
             name = str(ev.get("name", ""))
             cat = str(ev.get("cat", ""))
+            tid = str(ev.get("tid", ""))
             if ev.get("ph") == "X" and cat == "collective":
-                key = ("coll", ev.get("step"), name, str(ev.get("tid", "")))
+                key = ("coll", ev.get("step"), name, tid)
             elif ev.get("ph") == "i" and (cat in _STATIC_LINK_CATS
                                           or _CYCLE_RE.match(name)):
                 key = ("instant", cat, name)
+            elif cat == "serve" and tid.startswith("req/"):
+                # One group per request lane: a request whose lifecycle
+                # events land on >= 2 pids was REASSIGNED between
+                # replicas — the >=2-pid rule below draws the flow arrow
+                # exactly for those.
+                key = ("serve", tid)
             else:
                 continue
             groups.setdefault(key, []).append(ev)
@@ -367,4 +374,211 @@ def analyze(traces_or_paths: Union[Traces, Sequence[str]],
                              for r, o in offsets.items()},
         "steps": steps,
         "summary": summary,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Serving analysis (`analyze --serve`)
+# ---------------------------------------------------------------------------
+
+_REQ_TID_RE = re.compile(r"^req/(\d+)$")
+
+
+def _pctl(vals: List[float], q: float) -> float:
+    """Nearest-rank percentile (matches loadgen._pct)."""
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    k = max(0, min(len(vals) - 1, int(round(q / 100.0 * len(vals))) - 1))
+    return vals[k]
+
+
+def analyze_serve(traces_or_paths: Union[Traces, Sequence[str]],
+                  align: Optional[str] = None) -> dict:
+    """Per-request latency decomposition from serve lifecycle spans.
+
+    Each request renders as a `req/<id>` lane carrying (at most) three
+    abutting spans — `queue_wait`, `prefill`, `decode` — plus the
+    `serve_submit` / `serve_first_token` / `serve_evict` instants
+    (docs/TIMELINE.md).  The pid owning the `decode` span COMPLETED the
+    request; any other pid that saw the same request lane held it
+    before a reassignment and is the blamed replica.  All component
+    durations come from the completing replica's own clock, so
+    queue + prefill + decode sums to its measured e2e within the
+    clock-alignment tolerance (the spans abut; only the us-scale stamp
+    gaps between them are unaccounted)."""
+    if align is None:
+        align = os.environ.get("HOROVOD_TRACE_ALIGN", "cycle")
+    traces = (traces_or_paths if isinstance(traces_or_paths, dict)
+              else load_rank_traces(traces_or_paths))
+    offsets = clock_offsets(traces, align=align)
+    aligned = _aligned(traces, offsets)
+
+    # req_id -> pid -> {"spans": {name: ev}, "instants": {name: ev}}
+    reqs: Dict[int, Dict[int, dict]] = {}
+    for r in sorted(aligned):
+        for ev in aligned[r]:
+            if str(ev.get("cat", "")) != "serve":
+                continue
+            m = _REQ_TID_RE.match(str(ev.get("tid", "")))
+            if not m:
+                continue
+            rid = int(m.group(1))
+            slot = reqs.setdefault(rid, {}).setdefault(
+                r, {"spans": {}, "instants": {}})
+            kind = "spans" if ev.get("ph") == "X" else "instants"
+            slot[kind][str(ev.get("name", ""))] = ev
+
+    requests: List[dict] = []
+    e2es: List[float] = []
+    ttfts: List[float] = []
+    n_reassigned = 0
+    for rid in sorted(reqs):
+        by_pid = reqs[rid]
+        completer = None
+        for pid, slot in sorted(by_pid.items()):
+            if "decode" in slot["spans"]:
+                completer = pid
+        replicas = sorted(by_pid)
+        reassigned = len(replicas) > 1
+        n_reassigned += reassigned
+        row: dict = {
+            "req": rid,
+            "replicas": replicas,
+            "reassigned": reassigned,
+            "blamed_replica": (min(r for r in replicas
+                                   if r != completer)
+                               if reassigned and completer is not None
+                               else None),
+            "completed_by": completer,
+        }
+        if completer is None:
+            row["complete"] = False
+            requests.append(row)
+            continue
+        slot = by_pid[completer]
+        comp = {}
+        for name in ("queue_wait", "prefill", "decode"):
+            ev = slot["spans"].get(name)
+            comp[name] = (float(ev.get("dur", 0.0)) / 1e3
+                          if ev is not None else 0.0)
+        dec = slot["spans"]["decode"]
+        spec_ms = float((dec.get("args") or {}).get("spec_ms", 0.0))
+        dec_end = float(dec.get("ts", 0.0)) + float(dec.get("dur", 0.0))
+        sub = slot["instants"].get("serve_submit")
+        e2e_ms = ((dec_end - float(sub.get("ts", 0.0))) / 1e3
+                  if sub is not None
+                  else comp["queue_wait"] + comp["prefill"]
+                  + comp["decode"])
+        ft = slot["instants"].get("serve_first_token")
+        ttft_ms = ((float(ft.get("ts", 0.0))
+                    - float(sub.get("ts", 0.0))) / 1e3
+                   if ft is not None and sub is not None else None)
+        row.update({
+            "complete": True,
+            "queue_ms": round(comp["queue_wait"], 3),
+            "prefill_ms": round(comp["prefill"], 3),
+            "decode_ms": round(comp["decode"], 3),
+            "spec_verify_ms": round(spec_ms, 3),
+            "e2e_ms": round(e2e_ms, 3),
+            "ttft_ms": (round(ttft_ms, 3)
+                        if ttft_ms is not None else None),
+            "tokens": (dec.get("args") or {}).get("tokens"),
+        })
+        e2es.append(e2e_ms)
+        if ttft_ms is not None:
+            ttfts.append(ttft_ms)
+        requests.append(row)
+
+    done = [r for r in requests if r.get("complete")]
+    summary = {
+        "requests": len(requests),
+        "completed": len(done),
+        "reassigned": n_reassigned,
+        "e2e_ms_p50": round(_pctl(e2es, 50), 3),
+        "e2e_ms_p99": round(_pctl(e2es, 99), 3),
+        "ttft_ms_p50": round(_pctl(ttfts, 50), 3),
+        "ttft_ms_p99": round(_pctl(ttfts, 99), 3),
+        "queue_ms_mean": round(
+            statistics.mean([r["queue_ms"] for r in done]), 3)
+        if done else 0.0,
+        "decode_ms_mean": round(
+            statistics.mean([r["decode_ms"] for r in done]), 3)
+        if done else 0.0,
+    }
+    return {
+        "align": align,
+        "clock_offsets_us": {str(r): round(o, 1)
+                             for r, o in offsets.items()},
+        "requests": requests,
+        "summary": summary,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder dumps (`trace flightrec`)
+# ---------------------------------------------------------------------------
+
+def load_flightrec(path: str) -> dict:
+    """Load + validate one flight-recorder dump (serve/flightrec.py
+    writes them atomically, so no torn-file tolerance is needed —
+    unlike `load_events`)."""
+    with open(path) as f:
+        dump = json.load(f)
+    if not isinstance(dump, dict) or "events" not in dump:
+        raise ValueError(
+            f"{path}: not a flight-recorder dump (no 'events' key)")
+    return dump
+
+
+def flightrec_to_trace(dump_or_path: Union[dict, str]) -> dict:
+    """Render a flight-recorder dump as a Perfetto-compatible trace.
+
+    `span` records (prefill/decode mirrors with a duration) become
+    ph="X" slices on their request lane; every other kind (sched, pool,
+    slo, step, error, ...) becomes a ph="i" instant on a per-kind lane,
+    with the recorded payload as args.  pid is the replica id from the
+    dump so multiple replicas' dumps can be concatenated in one view.
+    """
+    dump = (dump_or_path if isinstance(dump_or_path, dict)
+            else load_flightrec(dump_or_path))
+    pid = dump.get("replica")
+    pid = int(pid) if pid is not None else 0
+    events: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid,
+         "args": {"name": f"flightrec replica {pid} "
+                          f"({dump.get('reason', '?')})"}},
+    ]
+    for rec in dump.get("events", []):
+        data = rec.get("data") or {}
+        ts = round(float(rec.get("ts_us", 0.0)), 1)
+        base = {"pid": pid, "cat": "flightrec"}
+        if rec.get("step") is not None:
+            base["step"] = rec["step"]
+        if rec.get("kind") == "span" and rec.get("dur_us") is not None:
+            req = data.get("req")
+            events.append({
+                "name": str(data.get("name", "span")),
+                "ph": "X", "ts": ts,
+                "dur": round(float(rec["dur_us"]), 1),
+                "tid": f"req/{req}" if req is not None else "span",
+                "args": data, **base,
+            })
+        else:
+            events.append({
+                "name": str(rec.get("kind", "event")),
+                "ph": "i", "s": "t", "ts": ts,
+                "tid": str(rec.get("kind", "event")),
+                "args": data, **base,
+            })
+    return {
+        "traceEvents": events,
+        "metadata": {
+            "reason": dump.get("reason"),
+            "host": dump.get("host"),
+            "replica": dump.get("replica"),
+            "depth": dump.get("depth"),
+            "recorded_total": dump.get("recorded_total"),
+            "dropped": dump.get("dropped"),
+        },
     }

@@ -135,6 +135,11 @@ class _Tunable:
     high: float
     log_scale: bool = False
     integer: bool = False
+    #: Host-only knobs never influence compiled shapes, so they are
+    #: EXCLUDED from `values()` — which keys the program cache
+    #: (parallel/data_parallel._autotune_key) and drives the on_change
+    #: invalidation hook.  The BO still proposes over them.
+    host_only: bool = False
     current: float = 0.0
 
     def denorm(self, u: float) -> float:
@@ -200,8 +205,10 @@ class ParameterManager:
     # -- setup -----------------------------------------------------------
     def register(self, name: str, low: float, high: float,
                  log_scale: bool = False, integer: bool = False,
-                 initial: Optional[float] = None) -> None:
-        t = _Tunable(name, low, high, log_scale, integer)
+                 initial: Optional[float] = None,
+                 host_only: bool = False) -> None:
+        t = _Tunable(name, low, high, log_scale, integer,
+                     host_only=host_only)
         t.current = initial if initial is not None else t.denorm(0.5)
         self._tunables[name] = t
         self._order.append(name)
@@ -212,7 +219,11 @@ class ParameterManager:
         return int(t.current) if t.integer else t.current
 
     def values(self) -> Dict[str, float]:
-        return {n: self.value(n) for n in self._order}
+        """Shape-relevant knob values ONLY — this dict keys the program
+        cache and feeds on_change, so `host_only` knobs (e.g. the
+        flight-recorder depth) are deliberately absent."""
+        return {n: self.value(n) for n in self._order
+                if not self._tunables[n].host_only}
 
     @property
     def frozen(self) -> bool:
@@ -419,6 +430,13 @@ def init_from_env() -> Optional[ParameterManager]:
                 initial=util.env_int("SERVE_MAX_BATCH", 8))
     pm.register("serve_spec_gamma", 1, 16, integer=True,
                 initial=util.env_int("SERVE_SPEC_GAMMA", 4))
+    # Flight-recorder ring depth (docs/SERVING.md): purely host-side
+    # memory-vs-postmortem-window, so host_only keeps it OUT of the
+    # serve program-cache key — a tuner move never costs a retrace.
+    pm.register("serve_flightrec_depth", 64, 8192, log_scale=True,
+                integer=True, host_only=True,
+                initial=max(64, util.env_int("SERVE_FLIGHTREC_DEPTH",
+                                             512)))
     _manager = pm
     logger.info("autotune enabled: %s", pm.values())
     return pm
@@ -686,6 +704,27 @@ def current_serve_spec_gamma() -> int:
     consulted once at server construction."""
     return tuned_serve_spec_gamma(
         max(1, util.env_int("SERVE_SPEC_GAMMA", 4)))
+
+
+def tuned_serve_flightrec_depth(default: int) -> int:
+    """Flight-recorder ring depth honoring the autotuner when active
+    (used by serve.InferenceServer at construction).  host_only: the
+    knob never appears in `values()` / the program-cache key."""
+    if _manager is not None and \
+            "serve_flightrec_depth" in _manager._tunables:
+        return max(1, int(_manager.value("serve_flightrec_depth")))
+    return default
+
+
+def current_serve_flightrec_depth() -> int:
+    """The live flight-recorder ring depth:
+    HOROVOD_SERVE_FLIGHTREC_DEPTH (512 events; <= 0 disables the
+    recorder entirely and is NOT overridden by the tuner), overridden
+    by the autotuner when active.  Host-side only — no retrace."""
+    env = util.env_int("SERVE_FLIGHTREC_DEPTH", 512)
+    if env <= 0:
+        return 0
+    return tuned_serve_flightrec_depth(env)
 
 
 def current_serve_pool_pages() -> int:

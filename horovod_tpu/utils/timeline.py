@@ -228,7 +228,8 @@ class Timeline:
 
     # -- instant events ---------------------------------------------------
     def instant(self, name: str, category: str = "event",
-                args: Optional[dict] = None) -> None:
+                args: Optional[dict] = None,
+                tid: Optional[str] = None) -> None:
         self._writer.enqueue({
             "name": name,
             "cat": category,
@@ -236,17 +237,20 @@ class Timeline:
             "s": "p",
             "ts": round(self._now_us(), 1),
             "pid": self._rank,
-            "tid": category,
+            "tid": tid if tid is not None else category,
             **self._step_stamp(),
             **({"args": args} if args else {}),
         })
 
     # -- complete spans with caller-held start (trace span model) ---------
     def complete(self, name: str, category: str, start_us: float,
-                 args: Optional[dict] = None) -> None:
+                 args: Optional[dict] = None,
+                 tid: Optional[str] = None) -> None:
         """Emit a `ph="X"` span from a caller-captured `now_us()` start to
         now — the per-step host span the fleet tracer's critical-path
-        analysis consumes (tid = category, unlike per-tensor activities)."""
+        analysis consumes.  `tid` defaults to the category (the training
+        step lane); the serve layer overrides it with `req/<id>` so every
+        request renders as its own Gantt row (docs/TIMELINE.md)."""
         now = self._now_us()
         self._writer.enqueue({
             "name": name,
@@ -255,7 +259,7 @@ class Timeline:
             "ts": round(start_us, 1),
             "dur": round(now - start_us, 1),
             "pid": self._rank,
-            "tid": category,
+            "tid": tid if tid is not None else category,
             **self._step_stamp(),
             **({"args": args} if args else {}),
         })

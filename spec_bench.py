@@ -137,7 +137,11 @@ def main():
             extra += f"  slo flips {len(res['slo_decisions'])}"
         print(f"{tag:9s} {res['tokens_per_sec_per_chip']:9.0f} "
               f"tok/s/chip  tok p99 {res['token_p99_ms']:7.2f} ms  "
-              f"req p99 {res['request_p99_ms']:8.1f} ms{extra}",
+              f"req p99 {res['request_p99_ms']:8.1f} ms  "
+              f"ttft p50/p99 {res.get('ttft_p50_ms', 0.0):6.1f}/"
+              f"{res.get('ttft_p99_ms', 0.0):6.1f} ms  "
+              f"itl p50/p99 {res.get('itl_p50_ms', 0.0):5.2f}/"
+              f"{res.get('itl_p99_ms', 0.0):5.2f} ms{extra}",
               file=sys.stderr, flush=True)
     if records:
         append_record(os.path.join(repo, args.out),

@@ -850,14 +850,24 @@ def test_pallas_guard_pragma_suppresses(tmp_path):
 from hvdlint import TimelineCatalog  # noqa: E402
 
 TRACE_INSTANT_ROWS = ("CYCLE_n", "guard_bucket_k", "wire_bucket_k",
-                      "fused_bucket_k", "PROFILER_TRACE_START")
+                      "fused_bucket_k", "PROFILER_TRACE_START",
+                      "serve_submit", "serve_first_token", "serve_evict",
+                      "slo_toggle")
+SERVE_SPAN_ROWS = ("step", "queue_wait", "prefill", "decode")
 
 
-def _timeline_doc(rows):
+def _timeline_doc(rows, span_rows=None):
     table = "\n".join(f"| `{r}` | somewhere | something |" for r in rows)
-    return ("# Timeline\n\n<!-- instant-catalog:start -->\n"
-            "| Instant | Emitted by | Meaning |\n|---|---|---|\n"
-            f"{table}\n<!-- instant-catalog:end -->\n")
+    doc = ("# Timeline\n\n<!-- instant-catalog:start -->\n"
+           "| Instant | Emitted by | Meaning |\n|---|---|---|\n"
+           f"{table}\n<!-- instant-catalog:end -->\n")
+    if span_rows is not None:
+        spans = "\n".join(f"| `{r}` | somewhere | something |"
+                          for r in span_rows)
+        doc += ("\n<!-- span-catalog:start -->\n"
+                "| Span | Emitted by | Meaning |\n|---|---|---|\n"
+                f"{spans}\n<!-- span-catalog:end -->\n")
+    return doc
 
 
 def test_timeline_catalog_clean_fixture(tmp_path):
@@ -917,14 +927,58 @@ def test_timeline_catalog_missing_section_is_error(tmp_path):
     assert "instant-catalog" in findings[0].message
 
 
+def test_timeline_catalog_span_drift_both_directions(tmp_path):
+    """The span catalog is linted like the instant catalog: an emitted
+    `.complete()` name with no row, and a rowed span emitted nowhere,
+    are both findings."""
+    proj = make_project(tmp_path, {
+        "horovod_tpu/a.py": '''\
+            def f(tl, t0):
+                tl.complete("queue_wait", category="serve", start_us=t0)
+                tl.complete("mystery_span", category="serve", start_us=t0)
+                tl.instant("evt", category="event")
+            ''',
+        "docs/TIMELINE.md": _timeline_doc(
+            ("evt",), span_rows=("queue_wait", "ghost_span")),
+    })
+    findings = TimelineCatalog().run(proj)
+    assert sorted((f.rule, f.path) for f in findings) == [
+        ("stale-doc-entry", "docs/TIMELINE.md"),
+        ("undocumented-span", "horovod_tpu/a.py"),
+    ]
+    assert any("mystery_span" in f.message for f in findings)
+    assert any("ghost_span" in f.message for f in findings)
+
+
+def test_timeline_catalog_spans_need_section_only_when_emitted(tmp_path):
+    """No `.complete()` call sites -> no span table required (the
+    instant-only fixtures above); emitted spans without a span-catalog
+    section -> error."""
+    proj = make_project(tmp_path, {
+        "horovod_tpu/a.py": '''\
+            def f(tl, t0):
+                tl.complete("queue_wait", category="serve", start_us=t0)
+                tl.instant("evt", category="event")
+            ''',
+        "docs/TIMELINE.md": _timeline_doc(("evt",)),
+    })
+    findings = TimelineCatalog().run(proj)
+    assert [f.rule for f in findings] == ["error"]
+    assert "span-catalog" in findings[0].message
+
+
 def test_trace_instants_emitted_and_documented():
     """Every fleet-tracer instant family must exist on BOTH sides the
     timeline-catalog analyzer diffs — emitted in the package and rowed
     in docs/TIMELINE.md — so deleting either side is a tier-1 failure."""
-    from hvdlint.timeline_cat import _doc_rows
-    rows = set(_doc_rows(_repo_text("docs/TIMELINE.md")))
+    from hvdlint.timeline_cat import _SPAN_SECTION_RE, _doc_rows
+    doc = _repo_text("docs/TIMELINE.md")
+    rows = set(_doc_rows(doc))
     for name in TRACE_INSTANT_ROWS:
         assert name in rows, name
+    spans = set(_doc_rows(doc, _SPAN_SECTION_RE))
+    for name in SERVE_SPAN_ROWS:
+        assert name in spans, name
     assert TimelineCatalog().run(Project(REPO)) == []
 
 

@@ -1,0 +1,466 @@
+"""Serving-observability tests (the request-level forensics surface):
+lifecycle spans on per-request timeline lanes (ordering, abutment, the
+queue+prefill+decode == e2e decomposition `trace analyze --serve`
+reports), the serving latency histograms against hand-computed bucket
+counts, the flight recorder's ring bounds / trigger matrix / atomic
+dump, and the two-replica e2e where a `serve.replica_die` fault leaves
+a loadable dump and the trace merge stitches the reassigned request's
+lane across replicas."""
+
+import bisect
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.metrics import catalog as _met
+from horovod_tpu.metrics.registry import Histogram, default_latency_buckets
+from horovod_tpu.models import TransformerConfig, transformer_init
+from horovod_tpu.serve import FlightRecorder, InferenceServer, PoolExhaustedError
+from horovod_tpu.serve import flightrec as flightrec_mod
+from horovod_tpu.serve.loadgen import hist_cumulative, hist_delta_quantile
+from horovod_tpu.trace import core as trace_core
+from horovod_tpu.utils import autotune
+from horovod_tpu.utils.timeline import start_timeline, stop_timeline
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, d_model=32, n_heads=4, d_head=8,
+                d_ff=64, n_layers=2, compute_dtype=jnp.float32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return cfg, transformer_init(jax.random.PRNGKey(0), cfg)
+
+
+def _server(model, **kw):
+    cfg, params = model
+    kw.setdefault("max_seq_tokens", 24)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_tokens", 4)
+    return InferenceServer(params, cfg, **kw)
+
+
+def _submit_some(srv, n=3, seed=2):
+    rng = np.random.RandomState(seed)
+    return [srv.submit(rng.randint(0, 64, size=4).tolist(),
+                       int(rng.randint(2, 5))) for _ in range(n)]
+
+
+def _req_lanes(events):
+    lanes = {}
+    for ev in events:
+        tid = str(ev.get("tid", ""))
+        if str(ev.get("cat", "")) == "serve" and tid.startswith("req/"):
+            lanes.setdefault(tid, []).append(ev)
+    return lanes
+
+
+class TestLifecycleSpans:
+    # Stamp-gap tolerance (us) between abutting spans: the gaps are
+    # pure host bookkeeping between two `now_us()` reads, but a loaded
+    # CI machine can preempt between them.
+    TOL_US = 50_000.0
+
+    def _run_traced(self, model, tmp_path, monkeypatch, n=3):
+        monkeypatch.setenv("HOROVOD_SERVE_FLIGHTREC_DIR", str(tmp_path))
+        tlf = str(tmp_path / "serve_tl.json")
+        start_timeline(tlf)
+        try:
+            srv = _server(model)
+            ids = _submit_some(srv, n=n)
+            done = srv.run()
+        finally:
+            stop_timeline()
+        assert len(done) == n
+        return trace_core.load_events(tlf), ids
+
+    def test_span_ordering_and_abutment(self, model, tmp_path, monkeypatch):
+        events, ids = self._run_traced(model, tmp_path, monkeypatch)
+        lanes = _req_lanes(events)
+        assert set(lanes) == {f"req/{i}" for i in ids}
+        for tid, evs in lanes.items():
+            spans = {e["name"]: e for e in evs if e.get("ph") == "X"}
+            inst = {e["name"]: e for e in evs if e.get("ph") == "i"}
+            assert set(spans) == {"queue_wait", "prefill", "decode"}
+            assert set(inst) == {"serve_submit", "serve_first_token",
+                                 "serve_evict"}
+            sub = float(inst["serve_submit"]["ts"])
+            qw, pf, dec = (spans[n] for n in
+                           ("queue_wait", "prefill", "decode"))
+            qw_s, qw_e = float(qw["ts"]), float(qw["ts"]) + float(qw["dur"])
+            pf_s, pf_e = float(pf["ts"]), float(pf["ts"]) + float(pf["dur"])
+            dc_s, dc_e = float(dec["ts"]), float(dec["ts"]) + float(dec["dur"])
+            # Lifecycle order: submit opens the queue_wait span, which
+            # abuts prefill, which abuts decode; first token falls
+            # inside decode; evict marks the end.
+            assert abs(qw_s - sub) <= self.TOL_US
+            assert qw_e - self.TOL_US <= pf_s <= qw_e + self.TOL_US
+            assert pf_e - self.TOL_US <= dc_s <= pf_e + self.TOL_US
+            ft = float(inst["serve_first_token"]["ts"])
+            assert dc_s - self.TOL_US <= ft <= dc_e + self.TOL_US
+            assert float(inst["serve_evict"]["ts"]) >= dc_e - self.TOL_US
+            # The decomposition invariant: components sum to e2e within
+            # the stamp gaps.
+            e2e = dc_e - sub
+            total = (qw_e - qw_s) + (pf_e - pf_s) + (dc_e - dc_s)
+            assert abs(e2e - total) <= 3 * self.TOL_US
+
+    def test_analyze_serve_decomposition_sums(self, model, tmp_path,
+                                              monkeypatch):
+        events, ids = self._run_traced(model, tmp_path, monkeypatch)
+        report = trace_core.analyze_serve({0: events}, align="wall")
+        assert report["summary"]["requests"] == len(ids)
+        assert report["summary"]["completed"] == len(ids)
+        assert report["summary"]["reassigned"] == 0
+        for row in report["requests"]:
+            assert row["complete"] and not row["reassigned"]
+            parts = row["queue_ms"] + row["prefill_ms"] + row["decode_ms"]
+            assert abs(parts - row["e2e_ms"]) <= 3 * self.TOL_US / 1e3
+            assert row["spec_verify_ms"] >= 0.0
+            assert row["ttft_ms"] is not None
+            assert 0.0 <= row["ttft_ms"] <= row["e2e_ms"] + self.TOL_US / 1e3
+
+    def test_analyze_serve_reassignment_blame(self):
+        """Synthetic two-replica lanes: the pid owning `decode`
+        completed; the other pid that saw the lane is blamed."""
+        def span(pid, name, ts, dur, args=None):
+            return {"ph": "X", "cat": "serve", "name": name, "pid": pid,
+                    "tid": "req/7", "ts": ts, "dur": dur,
+                    "args": args or {}}
+
+        def inst(pid, name, ts):
+            return {"ph": "i", "cat": "serve", "name": name, "pid": pid,
+                    "tid": "req/7", "ts": ts, "s": "t"}
+
+        traces = {
+            0: [inst(0, "serve_submit", 1000.0),
+                span(0, "queue_wait", 1000.0, 500.0),
+                span(0, "prefill", 1500.0, 300.0),
+                inst(0, "serve_first_token", 2000.0),
+                span(0, "decode", 1800.0, 700.0,
+                     {"tokens": 4, "spec_ms": 0.1}),
+                inst(0, "serve_evict", 2500.0)],
+            # The dead replica saw the request first: partial lane only.
+            1: [inst(1, "serve_submit", 100.0),
+                span(1, "queue_wait", 100.0, 200.0)],
+        }
+        report = trace_core.analyze_serve(traces, align="wall")
+        (row,) = report["requests"]
+        assert row["reassigned"] and row["replicas"] == [0, 1]
+        assert row["completed_by"] == 0 and row["blamed_replica"] == 1
+        assert row["e2e_ms"] == pytest.approx(1.5)
+        assert row["queue_ms"] + row["prefill_ms"] + row["decode_ms"] == \
+            pytest.approx(row["e2e_ms"])
+        assert report["summary"]["reassigned"] == 1
+        # merge draws the cross-replica flow arrow for exactly this lane
+        merged = trace_core.merge(traces, align="wall", flow=True)
+        flows = [e for e in merged["traceEvents"]
+                 if e.get("cat") == "xrank" and
+                 str(e.get("tid", "")).startswith("req/")]
+        assert {"s", "f"} <= {e["ph"] for e in flows}
+        assert {e["pid"] for e in flows} == {0, 1}
+
+
+class TestLatencyHistograms:
+    def test_bucket_counts_match_hand_computed(self):
+        h = Histogram("test_obs_hand_hist_seconds", "test-only")
+        lats = [5e-7, 2e-6, 3.9e-6, 1e-4, 2.5e-3, 0.5, 70.0]
+        for v in lats:
+            h.observe(v)
+        bounds = default_latency_buckets()
+        counts = [0] * (len(bounds) + 1)
+        for v in lats:
+            counts[bisect.bisect_left(bounds, v)] += 1
+        expect, running = [], 0
+        for b, c in zip(bounds, counts):
+            running += c
+            expect.append((b, running))
+        expect.append((float("inf"), running + counts[-1]))
+        assert h._solo().cumulative() == expect
+
+    def test_hist_delta_quantile_ignores_prior_observations(self):
+        h = Histogram("test_obs_delta_hist_seconds", "test-only")
+        h.observe(50.0)                      # pre-snapshot contamination
+        before = hist_cumulative(h)
+        for _ in range(100):
+            h.observe(3e-6)
+        after = hist_cumulative(h)
+        for q in (50.0, 99.0):
+            v = hist_delta_quantile(before, after, q)
+            assert 1e-6 <= v <= 4e-6         # inside the containing bucket
+        assert hist_delta_quantile(before, before, 50.0) == 0.0
+
+    def test_server_observes_serving_histograms(self, model, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_FLIGHTREC_DIR", str(tmp_path))
+        hists = (_met.serve_ttft, _met.serve_queue_delay,
+                 _met.serve_e2e_latency, _met.serve_intertoken)
+        before = [hist_cumulative(h) for h in hists]
+        srv = _server(model)
+        n = len(_submit_some(srv, n=3))
+        done = srv.run()
+        assert len(done) == n
+        after = [hist_cumulative(h) for h in hists]
+        deltas = [a[-1][1] - b[-1][1] for a, b in zip(after, before)]
+        # One TTFT / queue-delay / e2e observation per request; at least
+        # one inter-token observation per decode step that decided any.
+        assert deltas[0] == n and deltas[1] == n and deltas[2] == n
+        assert deltas[3] >= 1
+        # All e2e observations are positive and sane (<67s top bucket).
+        e2e_p99 = hist_delta_quantile(before[2], after[2], 99.0)
+        assert 0.0 < e2e_p99 < 67.0
+
+    def test_metrics_interval_env_knob(self, model, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_METRICS_INTERVAL", "5")
+        assert _server(model)._metrics_interval == 5
+        monkeypatch.setenv("HOROVOD_SERVE_METRICS_INTERVAL", "0")
+        assert _server(model)._metrics_interval == 1   # clamped
+        monkeypatch.delenv("HOROVOD_SERVE_METRICS_INTERVAL")
+        assert _server(model)._metrics_interval == 16  # default
+
+    def test_flush_at_drain_exports_final_gauges(self, model, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_FLIGHTREC_DIR", str(tmp_path))
+        # Interval larger than the run: only the drain flush samples.
+        monkeypatch.setenv("HOROVOD_SERVE_METRICS_INTERVAL", "100000")
+        srv = _server(model)
+        _submit_some(srv, n=2)
+        srv.run()
+        assert _met.serve_queue_depth._solo()._value == 0.0
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_drop_count(self, tmp_path):
+        rec = FlightRecorder(8, out_dir=str(tmp_path))
+        try:
+            for i in range(20):
+                rec.record("step", {"i": i}, step=i)
+            assert len(rec) == 8
+            assert [e["seq"] for e in rec.snapshot()] == list(range(12, 20))
+            path = rec.dump("manual")
+            payload = flightrec_mod.load_dump(path)
+            assert payload["recorded_total"] == 20
+            assert payload["dropped"] == 12
+            assert len(payload["events"]) == 8
+        finally:
+            rec.close()
+
+    def test_depth_below_one_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            FlightRecorder(0, out_dir=str(tmp_path))
+
+    def test_dump_is_atomic_and_loadable(self, tmp_path):
+        rec = FlightRecorder(4, out_dir=str(tmp_path))
+        try:
+            rec.record("slo", {"event": "spec_on"}, step=3)
+            rec.record("span", {"name": "prefill", "req": 1},
+                       ts_us=10.0, dur_us=5.0)
+            path = rec.dump("manual")
+            assert os.path.basename(path).startswith("serve_flightrec.")
+            assert not glob.glob(str(tmp_path / "*.tmp"))  # no torn temp
+            payload = trace_core.load_flightrec(path)
+            trace = trace_core.flightrec_to_trace(payload)
+            phs = {e.get("ph") for e in trace["traceEvents"]}
+            assert "X" in phs and "i" in phs
+            span = next(e for e in trace["traceEvents"]
+                        if e.get("ph") == "X")
+            assert span["tid"] == "req/1" and span["dur"] == 5.0
+        finally:
+            rec.close()
+
+    def test_load_dump_rejects_non_dumps(self, tmp_path):
+        bad = tmp_path / "not_a_dump.json"
+        bad.write_text(json.dumps([1, 2, 3]))
+        with pytest.raises(ValueError):
+            flightrec_mod.load_dump(str(bad))
+
+    def test_server_feeds_ring(self, model, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_FLIGHTREC_DIR", str(tmp_path))
+        srv = _server(model)
+        assert srv.flightrec is not None
+        _submit_some(srv, n=2)
+        srv.run()
+        kinds = {e["kind"] for e in srv.flightrec.snapshot()}
+        assert {"step", "span", "pool", "first_token"} <= kinds
+
+    def test_depth_env_disables(self, model, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_FLIGHTREC_DEPTH", "0")
+        assert _server(model).flightrec is None
+
+    def test_step_crash_triggers_dump(self, model, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_FLIGHTREC_DIR", str(tmp_path))
+        srv = _server(model)
+        _submit_some(srv, n=1)
+
+        def boom():
+            raise PoolExhaustedError("out of pages")
+        monkeypatch.setattr(srv, "_step_impl", boom)
+        with pytest.raises(PoolExhaustedError):
+            srv.step()
+        payload = flightrec_mod.load_dump(srv.flightrec.dumps[-1])
+        assert payload["reason"] == "pool_exhausted"
+        assert payload["events"][-1]["kind"] == "error"
+
+    def test_step_crash_reason_carries_type(self, model, tmp_path,
+                                            monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_FLIGHTREC_DIR", str(tmp_path))
+        srv = _server(model)
+
+        def boom():
+            raise ValueError("bad state")
+        monkeypatch.setattr(srv, "_step_impl", boom)
+        with pytest.raises(ValueError):
+            srv.step()
+        payload = flightrec_mod.load_dump(srv.flightrec.dumps[-1])
+        assert payload["reason"] == "crash:ValueError"
+
+    def test_slo_breach_triggers_dump(self, model, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_FLIGHTREC_DIR", str(tmp_path))
+        srv = _server(model, slo_ms=5.0)
+        srv.slo.record(100.0)
+        assert srv.slo.update(0) is True     # p99 over budget: spec_on
+        payload = flightrec_mod.load_dump(srv.flightrec.dumps[-1])
+        assert payload["reason"] == "slo_breach"
+        assert any(e["kind"] == "slo" and e["data"]["event"] == "spec_on"
+                   for e in payload["events"])
+
+    def test_fault_exit_hook_triggers_dump(self, tmp_path, monkeypatch):
+        """The `exit` fault mode bypasses atexit (`os._exit`); the
+        recorder must dump through faults.register_exit_hook before the
+        process dies.  os._exit is stubbed out so the trigger path runs
+        to completion in-process."""
+        import horovod_tpu.faults as faults
+        from horovod_tpu.faults import spec as fspec
+        exits = []
+        monkeypatch.setattr(fspec.os, "_exit", exits.append)
+        rec = FlightRecorder(4, out_dir=str(tmp_path))
+        rec.record("step", {"rows": 1}, step=0)
+        faults.install("serve.replica_die:exit:1")
+        try:
+            faults.point("serve.replica_die")
+        finally:
+            faults.clear()
+            rec.close()
+        assert exits == [1]
+        payload = flightrec_mod.load_dump(rec.dumps[-1])
+        assert payload["reason"] == "fault_exit:serve.replica_die"
+
+    def test_dump_all_never_raises(self, tmp_path):
+        good = FlightRecorder(4, out_dir=str(tmp_path))
+        broken = FlightRecorder(4, out_dir=str(tmp_path / "missing_dir"))
+        good.record("step", {}, step=0)
+        try:
+            paths = flightrec_mod.dump_all("guard_escalation")
+        finally:
+            good.close()
+            broken.close()
+        assert good.dumps and good.dumps[-1] in paths
+        assert not broken.dumps               # failed silently, by design
+        payload = flightrec_mod.load_dump(good.dumps[-1])
+        assert payload["reason"] == "guard_escalation"
+
+
+class TestFlightrecAutotuneKnob:
+    def test_host_only_knob_excluded_from_values(self):
+        pm = autotune.ParameterManager()
+        pm.register("fusion_threshold", 1 << 20, 256 << 20,
+                    log_scale=True, integer=True)
+        pm.register("serve_flightrec_depth", 64, 8192, log_scale=True,
+                    integer=True, host_only=True, initial=512)
+        vals = pm.values()
+        # values() keys the program cache: the host-only depth must
+        # never appear there, but stays individually readable.
+        assert "serve_flightrec_depth" not in vals
+        assert "fusion_threshold" in vals
+        assert pm.value("serve_flightrec_depth") == 512
+
+    def test_current_depth_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_FLIGHTREC_DEPTH", "7")
+        assert autotune.current_serve_flightrec_depth() == 7
+        monkeypatch.setenv("HOROVOD_SERVE_FLIGHTREC_DEPTH", "-1")
+        assert autotune.current_serve_flightrec_depth() == 0
+        monkeypatch.delenv("HOROVOD_SERVE_FLIGHTREC_DEPTH")
+        assert autotune.current_serve_flightrec_depth() == 512
+
+
+@pytest.mark.slow
+class TestServeObsE2E:
+    """Two serving replicas; the serve.replica_die fault kills replica1
+    mid-stream.  The dead incarnation must leave a loadable
+    flight-recorder dump (via the fault-exit hook) that converts to
+    Perfetto, and the per-replica timelines must merge into a trace
+    where the reassigned requests' lanes span both replicas."""
+
+    CONFIG = {
+        "cfg": dict(vocab_size=64, d_model=32, n_heads=4, d_head=8,
+                    d_ff=64, n_layers=2, compute_dtype="float32"),
+        "seed": 0,
+        "serve": dict(max_seq_tokens=24, max_batch=2, page_tokens=4),
+    }
+
+    def test_replica_death_dump_and_stitched_trace(self, tmp_path):
+        from horovod_tpu.serve.replica import ReplicaManager
+        tl_base = str(tmp_path / "serve_tl.json")
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "HOROVOD_TIMELINE": tl_base,
+            "HOROVOD_SERVE_FLIGHTREC_DIR": str(tmp_path),
+            "HOROVOD_FAULT_SPEC": "serve.replica_die@3:exit:1",
+            "HOROVOD_FAULT_HOSTS": "replica1",
+        }
+        rng = np.random.RandomState(1)
+        reqs = [(rng.randint(0, 64, size=4).tolist(),
+                 int(rng.randint(2, 6))) for _ in range(6)]
+        with ReplicaManager(2, self.CONFIG, lease_ttl=10.0,
+                            respawn_backoff=0.2, child_env=env) as mgr:
+            for prompt, mn in reqs:
+                mgr.submit(prompt, mn)
+            results = mgr.wait_all(timeout=180)
+            respawns = mgr._respawns
+        assert len(results) == 6
+        assert respawns >= 1
+
+        # 1. The dead replica dumped its ring through the fault-exit
+        # hook before os._exit.
+        dumps = sorted(glob.glob(
+            str(tmp_path / "serve_flightrec.replica1.*.json")))
+        assert dumps, "dead replica left no flight-recorder dump"
+        payload = flightrec_mod.load_dump(dumps[0])
+        assert payload["reason"] == "fault_exit:serve.replica_die"
+        assert payload["replica"] == 1
+        assert payload["events"]
+
+        # 2. The dump converts to a valid Perfetto trace.
+        trace = trace_core.flightrec_to_trace(payload)
+        evs = trace["traceEvents"]
+        assert evs and all(e.get("pid") == 1 for e in evs
+                           if e.get("ph") in ("X", "i"))
+        json.dumps(trace)                     # fully serializable
+
+        # 3. The per-replica timelines (the dead incarnation's file
+        # survives the respawn under .respawn<k>) merge into one trace
+        # where at least one reassigned request's lane spans both
+        # replicas and carries the cross-replica flow arrow.
+        files = sorted(glob.glob(tl_base + ".rank*"))
+        assert len(files) >= 2
+        report = trace_core.analyze_serve(files, align="wall")
+        assert report["summary"]["completed"] == 6
+        stitched = [r for r in report["requests"] if r["reassigned"]]
+        assert stitched, "no request lane spans both replicas"
+        for row in stitched:
+            assert row["blamed_replica"] == 1
+            assert row["completed_by"] is not None
+        merged = trace_core.merge(files, align="wall", flow=True)
+        flow_tids = {e["tid"] for e in merged["traceEvents"]
+                     if e.get("cat") == "xrank" and
+                     str(e.get("tid", "")).startswith("req/")}
+        assert {f"req/{r['req']}" for r in stitched} <= flow_tids

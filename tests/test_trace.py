@@ -237,10 +237,16 @@ def test_rank_falls_back_to_filename(tmp_path):
     assert sorted(load_rank_traces([str(p)])) == [3]
 
 
-def test_duplicate_rank_rejected(tmp_path):
+def test_duplicate_rank_concatenates(tmp_path):
+    """Several files carrying the same pid merge into one lane — a
+    respawned serving replica's incarnations (`.rank<k>` plus
+    `.rank<k>.respawn<j>`) must land on the same replica row."""
     paths = _write_rank_files(tmp_path)
-    with pytest.raises(ValueError, match="already loaded"):
-        load_rank_traces([paths[0], paths[0]])
+    solo = load_rank_traces([paths[0]])
+    both = load_rank_traces([paths[0], paths[0]])
+    assert sorted(both) == sorted(solo)
+    for rank, events in solo.items():
+        assert len(both[rank]) == 2 * len(events)
 
 
 # ---------------------------------------------------------------------------
