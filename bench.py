@@ -437,6 +437,86 @@ def zero_memory_report(timeout: float = 600.0) -> dict:
     return out
 
 
+def run_reshard_child() -> None:
+    """Child mode: live-reshard vs checkpoint-restore timing at n=2
+    (docs/RESHARD.md).  Two simulated old ranks hold ~4 MB of ZeRO
+    shard rows; the live path publishes + fetches through the in-memory
+    transport under the default peak ceiling, the legacy path does a
+    durable checkpoint save + restore + local restack.  Prints one JSON
+    line with both wall times and the measured staging peak."""
+    import tempfile
+
+    import numpy as np
+
+    from horovod_tpu.parallel import reshard as rs
+    from horovod_tpu.utils.checkpoint import CheckpointManager
+
+    rng = np.random.RandomState(0)
+    ge = (1 << 19, 1 << 19)  # two 512k-elem f32 groups = 4 MB total
+    n_old = 2
+    rows = tuple(rng.randn(n_old, -(-e // n_old)).astype(np.float32)
+                 for e in ge)
+    peak = rs.default_peak_bytes()
+
+    t = rs.LocalTransport()
+    t0 = time.perf_counter()
+    for r in range(n_old):
+        specs, data = rs.param_streams(rows, ge, n_old, r)
+        rs.reshard_streams(specs, data, n_old, 1, r, None, t,
+                           tag="bench", peak_bytes=peak)
+    specs, _ = rs.param_streams(rows, ge, n_old, 0)
+    streams, rep = rs.reshard_streams(
+        specs, None, n_old, 1, None, 0, t, tag="bench", peak_bytes=peak)
+    live_rows = rs.streams_to_param_rows(
+        streams, ge, tuple(r.dtype for r in rows), 1, 0)
+    live_ms = (time.perf_counter() - t0) * 1000.0
+
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d)
+        t0 = time.perf_counter()
+        mgr.save(0, {"rows": list(rows)}, force=True)
+        restored = mgr.restore(0)
+        ck_rows = tuple(rs.reshard_shard_rows(np.asarray(r), e, 1)
+                        for r, e in zip(restored["rows"], ge))
+        restore_ms = (time.perf_counter() - t0) * 1000.0
+
+    bitwise = all(a.tobytes() == b.tobytes()
+                  for a, b in zip(live_rows, ck_rows))
+    emit({
+        "n_old": n_old, "n_new": 1,
+        "state_bytes": int(sum(r.nbytes for r in rows)),
+        "live_ms": round(live_ms, 2),
+        "restore_ms": round(restore_ms, 2),
+        "speedup": round(restore_ms / max(live_ms, 1e-6), 2),
+        "peak_bytes": rep.peak_bytes,
+        "peak_ceiling": peak,
+        "chunks": rep.chunks,
+        "bitwise_vs_restore": bitwise,
+    })
+
+
+def reshard_report(timeout: float = 600.0) -> dict:
+    """Live-reshard extra: redistribute-vs-restore wall time and the
+    measured staging peak at n=2, in a child process
+    (docs/RESHARD.md)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--reshard-child"],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    if r.returncode != 0:
+        log(f"reshard child rc={r.returncode} "
+            f"stderr tail: {r.stderr[-1000:]}")
+        return {}
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    log(f"reshard n=2->1: live {rec['live_ms']} ms vs "
+        f"save+restore+restack {rec['restore_ms']} ms "
+        f"({rec['speedup']}x), peak {rec['peak_bytes']} / "
+        f"{rec['peak_ceiling']} bytes, bitwise="
+        f"{rec['bitwise_vs_restore']}")
+    return rec
+
+
 def _load_trace_core():
     """The fleet tracer's analyzer (horovod_tpu/trace/core.py), loaded
     by file path so the bench parent never imports the package (and so
@@ -1124,12 +1204,23 @@ def main():
     if zb:
         result["zero_bytes"] = zb
 
+    # Live-reshard vs checkpoint-restore timing (host-side, n=2).
+    try:
+        rr = reshard_report()
+    except Exception as e:  # noqa: BLE001
+        log(f"reshard report failed: {type(e).__name__}: {e}")
+        rr = None
+    if rr:
+        result["reshard"] = rr
+
     emit(result)
 
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--zero-bytes-child":
         run_zero_bytes_child(int(sys.argv[2]))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--reshard-child":
+        run_reshard_child()
     elif len(sys.argv) >= 3 and sys.argv[1] == "--bench-child":
         emit(run_bench(sys.argv[2]))
     else:

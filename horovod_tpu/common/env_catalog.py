@@ -418,6 +418,26 @@ CATALOG: Tuple[EnvVar, ...] = (
        "(crash, pool exhaustion, SLO breach, guard escalation, "
        "injected replica death).",
        "SERVING.md"),
+    _v("HOROVOD_RESHARD_PEAK_BYTES", "67108864", "reshard",
+       "Per-host staging ceiling of a live reshard in bytes; chunks "
+       "are sized to at most a quarter of it and the measured peak is "
+       "asserted against it (hvd_reshard_peak_bytes).",
+       "RESHARD.md"),
+    _v("HOROVOD_RESHARD_CHUNK_BYTES", "0", "reshard",
+       "Reshard chunk-grid cell size in bytes; 0 = auto (autotuner "
+       "knob reshard_chunk_bytes, 4 MiB default), always clamped to "
+       "PEAK_BYTES/4.",
+       "RESHARD.md"),
+    _v("HOROVOD_RESHARD_WIRE", "none", "reshard",
+       "Wire format of reshard chunk payloads: none (exact, the "
+       "bitwise default) or a cast wire (bf16/fp16) when the handoff "
+       "tolerates precision loss (train-to-serve).",
+       "RESHARD.md"),
+    _v("HOROVOD_RESHARD_TIMEOUT", "60", "reshard",
+       "Seconds a reshard fetch waits for a peer's chunk or verdict "
+       "before declaring the peer dead and falling back to the "
+       "checkpoint-restore path.",
+       "RESHARD.md"),
 )
 
 #: Literal prefixes that legitimately appear in code (startswith filters

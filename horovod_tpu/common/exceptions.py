@@ -56,6 +56,16 @@ class DuplicateTensorNameError(HorovodTpuError):
     controller.cc "Duplicate tensor name" semantic race detector)."""
 
 
+class ReshardError(HorovodTpuError):
+    """A live reshard (parallel/reshard.py) could not complete or
+    verify: a peer died mid-transfer, a chunk failed its sha256, a
+    stream's bit-pattern digest did not combine, or staging exceeded
+    the HOROVOD_RESHARD_PEAK_BYTES ceiling.  The resharded state is
+    discarded and the caller falls back to the legacy checkpoint-
+    restore path — this error must never be swallowed into partially
+    resharded state."""
+
+
 class InvalidRequestError(HorovodTpuError, ValueError):
     """A caller handed the decode/serve stack an impossible request:
     non-positive batch, max_len shorter than the prompt, a prompt

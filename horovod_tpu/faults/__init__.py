@@ -98,6 +98,17 @@ CATALOG = {
         "Each serving-replica work-loop iteration: exit kills the "
         "replica process mid-stream (the manager's lease/respawn must "
         "recover its in-flight sequences), err raises in the loop.",
+    # live resharding (parallel/reshard.py); see docs/RESHARD.md
+    "reshard.peer_die":
+        "Before a rank publishes one stream's reshard chunks: err "
+        "abandons the reshard mid-publish (chunks already out), so "
+        "peers must time out on the missing keys and every rank falls "
+        "back to the checkpoint-restore path.",
+    "reshard.chunk_corrupt":
+        "Per published reshard chunk: err is TRANSLATED into payload "
+        "corruption after the sha256 is computed (like the guard "
+        "points) — the receiver must detect the mismatch and raise "
+        "ReshardError, never assemble corrupt state.",
 }
 
 _lock = threading.Lock()

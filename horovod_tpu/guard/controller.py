@@ -208,6 +208,15 @@ class TrainingGuard:
         d = _digest.param_digests(params, parts=self._digest_parts)
         return _digest.check_replica_divergence(d, process_set=self._ps)
 
+    def verify_state(self, state: Any) -> Optional[int]:
+        """Cross-replica digest check over an arbitrary state pytree —
+        the post-reshard gate (docs/RESHARD.md): after a live reshard
+        restacks params on the new world, the generation must not
+        commit until every replica's digest agrees.  Returns the
+        diverged bucket index, or None when replicas agree (also when
+        running single-process, where there is nothing to compare)."""
+        return self._check_digests(state)
+
     # -- checkpoint / rollback ------------------------------------------
     def checkpoint(self, step: int, state: Any) -> bool:
         """Digest-verify `state`'s params across replicas, then save.

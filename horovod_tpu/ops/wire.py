@@ -227,6 +227,40 @@ def compressor_wire(compression) -> str:
     return get_codec(name).name
 
 
+def host_encode(chunk, wire: Optional[str]) -> bytes:
+    """Host-side (numpy) wire encode of one reshard chunk
+    (parallel/reshard.py transport): exact → raw bytes, cast wires →
+    the cast dtype's bytes.  Cooperative codecs (int8/int4/fp8_*) are
+    refused — their block-scaled payloads are collective-layout
+    transforms, and a lossy reshard wire would also break the bitwise
+    reshard-vs-restore contract (docs/RESHARD.md)."""
+    import numpy as np
+    codec = get_codec(wire)
+    arr = np.ascontiguousarray(chunk)
+    if codec.exact:
+        return arr.tobytes()
+    if codec.cast_dtype is None:
+        raise HorovodTpuError(
+            f"HOROVOD_RESHARD_WIRE={codec.name!r} is a cooperative "
+            "codec; the host-side reshard transport supports the exact "
+            f"wire and the cast wires ({', '.join(cast_wire_names())})")
+    return arr.astype(codec.cast_dtype).tobytes()
+
+
+def host_decode(buf: bytes, dtype, wire: Optional[str]):
+    """Inverse of `host_encode`: bytes → numpy array of `dtype`."""
+    import numpy as np
+    codec = get_codec(wire)
+    if codec.exact:
+        return np.frombuffer(buf, dtype=np.dtype(dtype)).copy()
+    if codec.cast_dtype is None:
+        raise HorovodTpuError(
+            f"reshard wire {codec.name!r} has no host-side decode "
+            "(cooperative codec) — see host_encode")
+    return np.frombuffer(
+        buf, dtype=codec.cast_dtype).astype(np.dtype(dtype))
+
+
 def local_roundtrip(v: jax.Array, wire: str = "int8") -> jax.Array:
     """encode→decode through the local codec (same blockwise scales the
     ring's first hop uses) — the compression operator C whose error

@@ -172,6 +172,25 @@ def sharded_state_specs(state: DistributedOptState, axis_name=GLOBAL_AXIS):
                                wire_ef)
 
 
+def zero_group_elems(params, compression=Compression.none,
+                     fusion_threshold_bytes: Optional[int] = None,
+                     bucket_order=None) -> tuple:
+    """Per-shard-group UNPADDED element counts of `params` under the
+    same `shard_group_partition` the sharded optimizer and
+    `zero3_placement` bake — the group geometry every reshard
+    (parallel/reshard.py) is planned against.  Pass the SAME tunables
+    as the optimizer so the partitions agree; a reshard planned
+    against a drifted partition would fail the drift checks loudly,
+    never move the wrong bytes silently."""
+    leaves = jax.tree_util.tree_leaves(params)
+    return tuple(
+        sum(leaves[i].size for i in idxs)
+        for idxs in shard_group_partition(
+            leaves, compression=compression,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            bucket_order=bucket_order))
+
+
 def DistributedGradientTransformation(
     optimizer: optax.GradientTransformation,
     op: C.ReduceOp = C.Average,

@@ -1,0 +1,1152 @@
+"""Live resharding: move ZeRO shard state between partitions without a
+stop-the-world checkpoint restore (ROADMAP item 4, docs/RESHARD.md).
+
+A membership change (elastic shrink/grow), a train→serve handoff, or a
+checkpoint saved at world N and loaded at world M all reduce to the same
+problem: the state lives as 1/N flat shards over
+`shard_group_partition` (parallel/data_parallel.py) and must be re-cut
+into 1/M shards — pure data movement, checkable bitwise.  Following
+"Memory-efficient array redistribution through portable collective
+communication" (PAPERS.md, arXiv 2112.01075) the plan never materializes
+a full buffer on any host: every group's logical flat buffer is cut on
+a fixed chunk grid, each old owner publishes only the grid intervals it
+owns, and each new owner fetches only the intervals overlapping its new
+range — peak staging stays under the `HOROVOD_RESHARD_PEAK_BYTES`
+ceiling by construction (chunks are sized to at most a quarter of it)
+and is *measured*, not assumed (`ReshardReport.peak_bytes`,
+`hvd_reshard_peak_bytes`).
+
+Layout model (one shard group of L logical elements, the unpadded
+concatenation of its leaves):
+
+  - ``shard`` streams — zero3 param rows, fp32 master rows, per-element
+    optax state rows, ZeRO-2 accumulator rows: old rank r owns
+    ``[r*ceil(L/N) , min((r+1)*ceil(L/N), L))``; padding beyond L is
+    zeros on both sides and never travels.
+  - ``perrank`` streams — `_WireEF` sender-side residuals: every old
+    rank holds a FULL group-sized row, and shrink/grow folds rows
+    ``new[j] = Σ_{r<N, r ≡ j (mod M)} old[r]`` (ascending r, f32) so
+    the telescoped correction is conserved on shrink and joiners start
+    at zero on grow.  Fetch-side accumulation and the local
+    `reshard_checkpoint_state` use the same fold, so the live path and
+    the restore path stay bitwise-equal.
+  - ``replicated`` streams — rank-stacked scalars (adam's count): the
+    rows are identical by construction, so row 0 travels once and is
+    tiled to M.
+
+Integrity is layered: every published interval carries a sha256 of its
+payload (detects `reshard.chunk_corrupt`); every stream carries an
+order-free bit-pattern digest (uint64 sum+xor of the raw words, exact
+and associative) whose per-old-rank partials must combine to the
+assembled buffer's digest; and every participant publishes an ok/fail
+verdict the others wait on, so a dead peer (`reshard.peer_die`) turns
+into a `ReshardError` after `HOROVOD_RESHARD_TIMEOUT` — the caller then
+falls back to the legacy checkpoint-restore path (the TrainingGuard
+ladder), never to silently corrupted state.  After an elastic reshard
+the new world additionally runs the guard's cross-replica param-digest
+check before the generation commits (docs/RESHARD.md §failure).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from .. import faults as _faults
+from ..common import util
+from ..common.exceptions import HorovodTpuError, ReshardError
+from ..metrics import catalog as _met
+from ..ops import wire as _wire
+
+__all__ = [
+    "KVTransport", "LocalTransport", "ReshardError", "ReshardPlan",
+    "ReshardReport", "StreamSpec", "bitsum_digest", "decode_leaf_slices",
+    "default_chunk_bytes", "default_peak_bytes", "fetch_streams",
+    "publish_streams", "reshard_ef_rows", "reshard_opt_state",
+    "reshard_replicated_rows", "reshard_shard_rows", "reshard_streams",
+]
+
+
+# ---------------------------------------------------------------------------
+# knobs
+
+def default_peak_bytes() -> int:
+    """The per-host staging ceiling: HOROVOD_RESHARD_PEAK_BYTES
+    (64 MiB).  The planner sizes chunks to at most a quarter of it
+    (raw slice + encoded payload + base64 text + decode copy can be
+    live at once), and the executor asserts the measured peak."""
+    return max(4096, util.env_int("RESHARD_PEAK_BYTES", 64 << 20))
+
+
+def default_chunk_bytes(peak_bytes: Optional[int] = None) -> int:
+    """The chunk-grid cell size: HOROVOD_RESHARD_CHUNK_BYTES pins it,
+    otherwise the `reshard_chunk_bytes` autotuner knob (4 MiB default),
+    always clamped to peak_bytes // 4."""
+    if peak_bytes is None:
+        peak_bytes = default_peak_bytes()
+    env = util.env_int("RESHARD_CHUNK_BYTES", 0)
+    if env <= 0:
+        from ..utils.autotune import current_reshard_chunk_bytes
+        env = current_reshard_chunk_bytes()
+    return max(1, min(env, peak_bytes // 4))
+
+
+def default_timeout() -> float:
+    """How long a fetch waits for a peer's chunk / verdict before
+    declaring it dead: HOROVOD_RESHARD_TIMEOUT (60 s)."""
+    return util.env_float("RESHARD_TIMEOUT", 60.0)
+
+
+# ---------------------------------------------------------------------------
+# plan
+
+class StreamSpec(NamedTuple):
+    """One named flat buffer to redistribute.  `elems` is the logical
+    (unpadded) length L; `kind` picks the ownership model documented in
+    the module docstring."""
+    name: str
+    elems: int
+    dtype: str          # np dtype name ("float32"); str so specs are JSON
+    kind: str           # "shard" | "perrank" | "replicated"
+
+
+class Interval(NamedTuple):
+    """One published payload: `[start, stop)` of a stream's logical
+    buffer, owned by old rank `src` (grid cell ∩ src's old range)."""
+    src: int
+    start: int
+    stop: int
+
+
+def _shard_sz(elems: int, n: int) -> int:
+    return (elems + (-elems) % n) // n if n else 0
+
+
+def _owned_range(elems: int, n: int, rank: int) -> Tuple[int, int]:
+    """Old/new owner rank's logical (unpadded) range in a shard stream."""
+    s = _shard_sz(elems, n)
+    return min(rank * s, elems), min((rank + 1) * s, elems)
+
+
+class ReshardPlan:
+    """The deterministic movement plan for one (old partition, new
+    partition) pair over a set of streams.  Every rank computes the
+    identical plan from (specs, n_old, n_new, chunk_bytes), so publish
+    keys and fetch keys agree with no negotiation."""
+
+    def __init__(self, specs: List[StreamSpec], n_old: int, n_new: int,
+                 chunk_bytes: Optional[int] = None,
+                 peak_bytes: Optional[int] = None):
+        if n_old < 1 or n_new < 1:
+            raise ValueError(
+                f"reshard needs n_old >= 1 and n_new >= 1, got "
+                f"({n_old}, {n_new})")
+        self.specs = list(specs)
+        self.n_old = int(n_old)
+        self.n_new = int(n_new)
+        self.peak_bytes = int(peak_bytes if peak_bytes is not None
+                              else default_peak_bytes())
+        self.chunk_bytes = int(chunk_bytes if chunk_bytes is not None
+                               else default_chunk_bytes(self.peak_bytes))
+        self.chunk_bytes = max(1, min(self.chunk_bytes,
+                                      self.peak_bytes // 4))
+
+    def _chunk_elems(self, spec: StreamSpec) -> int:
+        return max(1, self.chunk_bytes // np.dtype(spec.dtype).itemsize)
+
+    def _grid_cut(self, spec: StreamSpec, start: int,
+                  stop: int) -> List[Tuple[int, int]]:
+        """Cut `[start, stop)` at the stream's fixed chunk-grid
+        boundaries (grid anchored at 0, so both sides agree)."""
+        ce = self._chunk_elems(spec)
+        out = []
+        a = start
+        while a < stop:
+            b = min(stop, (a // ce + 1) * ce)
+            out.append((a, b))
+            a = b
+        return out
+
+    def publish_intervals(self, spec: StreamSpec,
+                          old_rank: int) -> List[Interval]:
+        """The payloads old rank `old_rank` publishes for one stream."""
+        if spec.kind == "replicated":
+            if old_rank != 0 or spec.elems == 0:
+                return []
+            return [Interval(0, a, b)
+                    for a, b in self._grid_cut(spec, 0, spec.elems)]
+        if spec.kind == "perrank":
+            return [Interval(old_rank, a, b)
+                    for a, b in self._grid_cut(spec, 0, spec.elems)]
+        lo, hi = _owned_range(spec.elems, self.n_old, old_rank)
+        return [Interval(old_rank, a, b)
+                for a, b in self._grid_cut(spec, lo, hi)]
+
+    def fetch_intervals(self, spec: StreamSpec,
+                        new_rank: int) -> List[Interval]:
+        """The published payloads new rank `new_rank` needs for one
+        stream (a superset of its new range — it slices locally)."""
+        if spec.kind == "replicated":
+            if spec.elems == 0:
+                return []
+            return self.publish_intervals(spec, 0)
+        if spec.kind == "perrank":
+            out = []
+            for r in range(new_rank % self.n_new, self.n_old,
+                           self.n_new):
+                out.extend(Interval(r, a, b)
+                           for a, b in self._grid_cut(spec, 0,
+                                                      spec.elems))
+            return out
+        lo, hi = _owned_range(spec.elems, self.n_new, new_rank)
+        out = []
+        for r in range(self.n_old):
+            olo, ohi = _owned_range(spec.elems, self.n_old, r)
+            a, b = max(lo, olo), min(hi, ohi)
+            if a < b:
+                out.extend(Interval(r, c, d)
+                           for c, d in self._grid_cut(spec, a, b))
+        return out
+
+    def publish_bytes(self, old_rank: int) -> int:
+        """Total payload bytes this old rank publishes (metrics)."""
+        return sum((iv.stop - iv.start) * np.dtype(s.dtype).itemsize
+                   for s in self.specs
+                   for iv in self.publish_intervals(s, old_rank))
+
+    def max_chunk_bytes(self) -> int:
+        return max(self._chunk_elems(s) * np.dtype(s.dtype).itemsize
+                   for s in self.specs) if self.specs else 0
+
+
+def _fix_grid_cut_overlap(plan: ReshardPlan, spec: StreamSpec,
+                          iv: Interval) -> Interval:
+    """Publish keys are grid-cell ∩ old-range; a fetch interval computed
+    from (new range ∩ old range) may start/stop mid-cell.  Re-expand it
+    to the containing published interval so the key matches."""
+    olo, ohi = (0, spec.elems) if spec.kind != "shard" else \
+        _owned_range(spec.elems, plan.n_old, iv.src)
+    ce = plan._chunk_elems(spec)
+    a = max(olo, (iv.start // ce) * ce)
+    b = min(ohi, (iv.start // ce + 1) * ce)
+    return Interval(iv.src, a, b)
+
+
+# ---------------------------------------------------------------------------
+# transports
+
+class LocalTransport:
+    """In-process key/value transport (unit tests, the local scenario-c
+    path, and bench.py's n=2 simulation).  Same contract as
+    `KVTransport`: string values, blocking `wait`."""
+
+    def __init__(self):
+        self._kv: Dict[str, str] = {}
+        self._cv = threading.Condition()
+
+    def put(self, key: str, value: str) -> None:
+        with self._cv:
+            self._kv[key] = value
+            self._cv.notify_all()
+
+    def wait(self, key: str, timeout: float = 30.0) -> str:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while key not in self._kv:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=left):
+                    raise ReshardError(
+                        f"timed out after {timeout:.1f}s waiting for "
+                        f"reshard key {key!r} (peer dead?)")
+            return self._kv[key]
+
+    def get(self, key: str) -> Optional[str]:
+        with self._cv:
+            return self._kv.get(key)
+
+    def delete(self, key: str) -> None:
+        with self._cv:
+            self._kv.pop(key, None)
+
+    def keys(self, prefix: str = "") -> List[str]:
+        with self._cv:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+
+class KVTransport:
+    """Reshard transport over the elastic control plane's rendezvous
+    KV store (`runner.rendezvous.RendezvousClient`) — available to
+    every worker of a runner/elastic launch via the
+    HOROVOD_RENDEZVOUS_* env contract (`client_from_env`).  Payloads
+    are base64 text; a WAIT timeout (dead peer) surfaces as
+    `ReshardError` so the caller can fall back to restore."""
+
+    def __init__(self, client, namespace: str = "reshard"):
+        self._c = client
+        self._ns = namespace.rstrip("/")
+
+    @classmethod
+    def from_env(cls, namespace: str = "reshard"
+                 ) -> Optional["KVTransport"]:
+        """Build from the worker env contract, or None outside an
+        elastic/runner launch."""
+        from ..runner.elastic_worker import client_from_env
+        client = client_from_env()
+        return None if client is None else cls(client,
+                                               namespace=namespace)
+
+    def _k(self, key: str) -> str:
+        return f"{self._ns}/{key}"
+
+    def put(self, key: str, value: str) -> None:
+        self._c.put(self._k(key), value)
+
+    def wait(self, key: str, timeout: float = 30.0) -> str:
+        try:
+            return self._c.wait(self._k(key), timeout=timeout)
+        except HorovodTpuError as e:
+            raise ReshardError(
+                f"timed out after {timeout:.1f}s waiting for reshard "
+                f"key {key!r} (peer dead?): {e}") from e
+
+    def get(self, key: str) -> Optional[str]:
+        return self._c.get(self._k(key))
+
+    def delete(self, key: str) -> None:
+        self._c.delete(self._k(key))
+
+    def keys(self, prefix: str = "") -> List[str]:
+        ns = self._k(prefix)
+        return [k[len(self._ns) + 1:] for k in self._c.keys(ns)]
+
+
+# ---------------------------------------------------------------------------
+# integrity
+
+def bitsum_digest(arr: np.ndarray) -> Tuple[int, int]:
+    """Order-free exact digest of an array's raw bit pattern:
+    (sum mod 2^64, xor) over PER-ELEMENT bit patterns widened to
+    uint64.  Element-wise (not byte-word-wise) so partials of disjoint
+    slices combine to the full buffer's digest at ANY element boundary
+    — and unlike float sums there is no rounding-order ambiguity."""
+    a = np.ascontiguousarray(arr).reshape(-1)
+    size = a.dtype.itemsize
+    if size == 8:
+        w = a.view(np.uint64)
+    elif size == 4:
+        w = a.view(np.uint32).astype(np.uint64)
+    elif size == 2:
+        w = a.view(np.uint16).astype(np.uint64)
+    else:  # bytes/bools and exotic widths: one word per raw byte
+        w = np.frombuffer(a.tobytes(), np.uint8).astype(np.uint64)
+    s = int(np.sum(w, dtype=np.uint64))
+    x = int(np.bitwise_xor.reduce(w)) if w.size else 0
+    return s & 0xFFFFFFFFFFFFFFFF, x
+
+
+def _combine_digests(parts: List[Tuple[int, int]]) -> Tuple[int, int]:
+    s = 0
+    x = 0
+    for ps, px in parts:
+        s = (s + ps) & 0xFFFFFFFFFFFFFFFF
+        x ^= px
+    return s, x
+
+
+class _PeakTracker:
+    """Measured peak of transiently staged reshard bytes on this host
+    (the asserted bound, not the planned one)."""
+
+    def __init__(self):
+        self.cur = 0
+        self.peak = 0
+
+    def add(self, n: int) -> None:
+        self.cur += n
+        self.peak = max(self.peak, self.cur)
+
+    def sub(self, n: int) -> None:
+        self.cur = max(0, self.cur - n)
+
+
+def _encode_payload(chunk: np.ndarray, wire: Optional[str],
+                    tracker: _PeakTracker) -> str:
+    """`sha:wire:base64(payload)` for one interval.  The sha covers the
+    wire payload, so corruption anywhere between encode and decode is
+    caught; `reshard.chunk_corrupt`'s err mode flips a payload byte
+    AFTER the sha is computed — translated corruption the receiver
+    must detect, like the guard's fault points."""
+    raw = _wire.host_encode(chunk, wire)
+    tracker.add(len(raw))
+    sha = hashlib.sha256(raw).hexdigest()[:32]
+    try:
+        _faults.point("reshard.chunk_corrupt")
+    except _faults.FaultInjected:
+        flipped = bytearray(raw)
+        if flipped:
+            flipped[0] ^= 0x40
+        raw = bytes(flipped)
+    text = base64.b64encode(raw).decode("ascii")
+    tracker.sub(len(raw))
+    return f"{sha}:{wire or 'none'}:{text}"
+
+
+def _decode_payload(value: str, dtype, tracker: _PeakTracker
+                    ) -> np.ndarray:
+    sha, wire, text = value.split(":", 2)
+    raw = base64.b64decode(text)
+    tracker.add(len(raw))
+    try:
+        if hashlib.sha256(raw).hexdigest()[:32] != sha:
+            raise ReshardError(
+                "reshard chunk payload failed its sha256 check "
+                "(corrupt in transit)")
+        return _wire.host_decode(raw, dtype,
+                                 None if wire == "none" else wire)
+    finally:
+        tracker.sub(len(raw))
+
+
+class ReshardReport(NamedTuple):
+    """What one executed reshard cost on this host."""
+    bytes_moved: int     # payload bytes published + fetched here
+    peak_bytes: int      # measured max staged bytes (<= the ceiling)
+    wall_ms: float
+    chunks: int          # intervals published + fetched here
+
+
+# ---------------------------------------------------------------------------
+# executor
+
+def _iv_key(stream: str, iv: Interval) -> str:
+    return f"{stream}/r{iv.src}/{iv.start}-{iv.stop}"
+
+
+def publish_streams(plan: ReshardPlan, streams: Dict[str, np.ndarray],
+                    old_rank: int, transport, tag: str = "g",
+                    wire: Optional[str] = None,
+                    tracker: Optional[_PeakTracker] = None
+                    ) -> Tuple[int, int]:
+    """The send half: publish this old rank's intervals of every
+    stream, chunk by chunk (one staged payload at a time), then this
+    rank's per-stream digest partials and its `done` marker.  `streams`
+    maps spec name → this rank's LOCAL data: the owned slice for
+    "shard" kinds, the full row for "perrank", the scalar row for
+    "replicated" (rank 0 only).  Fires `reshard.peer_die` once per
+    stream — an injected death aborts mid-publish with chunks already
+    out, exactly the partial failure the fetch side must survive."""
+    tracker = tracker or _PeakTracker()
+    nbytes = 0
+    chunks = 0
+    for spec in plan.specs:
+        ivs = plan.publish_intervals(spec, old_rank)
+        if not ivs:
+            continue
+        _faults.point("reshard.peer_die")
+        arr = np.ascontiguousarray(
+            np.asarray(streams[spec.name]).reshape(-1))
+        base = ivs[0].start if spec.kind == "shard" else 0
+        digest = []
+        for iv in ivs:
+            chunk = arr[iv.start - base:iv.stop - base]
+            if chunk.size != iv.stop - iv.start:
+                raise ReshardError(
+                    f"stream {spec.name!r}: local data ({arr.size} "
+                    f"elems from {base}) does not cover published "
+                    f"interval [{iv.start}, {iv.stop})")
+            digest.append(bitsum_digest(chunk))
+            transport.put(f"{tag}/{_iv_key(spec.name, iv)}",
+                          _encode_payload(chunk, wire, tracker))
+            nbytes += chunk.size * chunk.dtype.itemsize
+            chunks += 1
+        s, x = _combine_digests(digest)
+        transport.put(f"{tag}/digest/{spec.name}/r{old_rank}",
+                      f"{s}:{x}")
+    transport.put(f"{tag}/done/r{old_rank}", "ok")
+    return nbytes, chunks
+
+
+def fetch_streams(plan: ReshardPlan, new_rank: int, transport,
+                  tag: str = "g", timeout: Optional[float] = None,
+                  tracker: Optional[_PeakTracker] = None
+                  ) -> Tuple[Dict[str, np.ndarray], int, int]:
+    """The receive half: fetch, verify, and assemble this new rank's
+    rows for every stream.  Returns (streams, bytes, chunks) where
+    each "shard"/"replicated" stream is this rank's new owned slice
+    and each "perrank" stream is the folded residual row.  Raises
+    `ReshardError` on a missing peer (timeout), a sha mismatch, or a
+    stream digest that does not combine — the caller falls back to the
+    checkpoint-restore path."""
+    timeout = default_timeout() if timeout is None else timeout
+    tracker = tracker or _PeakTracker()
+    out: Dict[str, np.ndarray] = {}
+    nbytes = 0
+    chunks = 0
+    for spec in plan.specs:
+        dt = np.dtype(spec.dtype)
+        if spec.kind == "perrank":
+            buf = np.zeros((spec.elems,), np.float32)
+            srcs = sorted({iv.src
+                           for iv in plan.fetch_intervals(spec,
+                                                          new_rank)})
+            # Ascending-src accumulation = the fold's defined order.
+            for r in srcs:
+                part = []
+                for a, b in plan._grid_cut(spec, 0, spec.elems):
+                    v = transport.wait(
+                        f"{tag}/{_iv_key(spec.name, Interval(r, a, b))}",
+                        timeout=timeout)
+                    chunk = _decode_payload(v, dt, tracker)
+                    part.append(bitsum_digest(chunk))
+                    buf[a:b] += chunk.astype(np.float32)
+                    nbytes += chunk.size * chunk.dtype.itemsize
+                    chunks += 1
+                _verify_stream_digest(transport, tag, spec, [r],
+                                      part, timeout)
+            out[spec.name] = buf
+            continue
+        lo, hi = (0, spec.elems) if spec.kind == "replicated" else \
+            _owned_range(spec.elems, plan.n_new, new_rank)
+        buf = np.zeros((hi - lo,), dt)
+        srcs_seen = set()
+        for iv in plan.fetch_intervals(spec, new_rank):
+            pub = _fix_grid_cut_overlap(plan, spec, iv)
+            v = transport.wait(f"{tag}/{_iv_key(spec.name, pub)}",
+                               timeout=timeout)
+            chunk = _decode_payload(v, dt, tracker)
+            a, b = max(iv.start, lo), min(iv.stop, hi)
+            buf[a - lo:b - lo] = chunk[a - pub.start:b - pub.start]
+            nbytes += (b - a) * dt.itemsize
+            chunks += 1
+            srcs_seen.add(pub.src)
+        # Stream digest: only checkable when this rank fetched the
+        # source's FULL published extent (shrink to fewer ranks, or the
+        # replicated stream).  Partial fetches are covered per-chunk by
+        # the sha; the cross-replica guard digest covers the rest.
+        if spec.kind == "replicated":
+            _verify_stream_digest(
+                transport, tag, spec, [0],
+                [bitsum_digest(buf)], timeout)
+        else:
+            for r in (r for r in sorted(srcs_seen)
+                      if _covers(plan, spec, r, lo, hi)):
+                olo, ohi = _owned_range(spec.elems, plan.n_old, r)
+                _verify_stream_digest(
+                    transport, tag, spec, [r],
+                    [bitsum_digest(buf[olo - lo:ohi - lo])], timeout)
+        out[spec.name] = buf
+    return out, nbytes, chunks
+
+
+def _covers(plan: ReshardPlan, spec: StreamSpec, src: int, lo: int,
+            hi: int) -> bool:
+    olo, ohi = _owned_range(spec.elems, plan.n_old, src)
+    return lo <= olo and ohi <= hi and olo < ohi
+
+
+def _verify_stream_digest(transport, tag: str, spec: StreamSpec,
+                          srcs: List[int],
+                          local: List[Tuple[int, int]],
+                          timeout: float) -> None:
+    parts = []
+    for r in srcs:
+        v = transport.wait(f"{tag}/digest/{spec.name}/r{r}",
+                           timeout=timeout)
+        s, x = v.split(":")
+        parts.append((int(s), int(x)))
+    if _combine_digests(parts) != _combine_digests(local):
+        raise ReshardError(
+            f"stream {spec.name!r}: assembled bit-pattern digest does "
+            f"not match the publishers' partial digests (ranks "
+            f"{srcs}) — resharded state would be corrupt")
+
+
+def reshard_streams(specs: List[StreamSpec],
+                    streams: Optional[Dict[str, np.ndarray]],
+                    n_old: int, n_new: int,
+                    old_rank: Optional[int], new_rank: Optional[int],
+                    transport, tag: str = "g",
+                    chunk_bytes: Optional[int] = None,
+                    peak_bytes: Optional[int] = None,
+                    timeout: Optional[float] = None,
+                    wire: Optional[str] = None,
+                    ) -> Tuple[Optional[Dict[str, np.ndarray]],
+                               ReshardReport]:
+    """Full reshard on one host: publish (when this host is an old
+    owner), fetch (when it is a new owner), then exchange verdicts —
+    every new rank waits for every old rank's `done` and every new
+    rank's `recv_ok` before trusting the result, so one dead or failed
+    peer fails ALL of them deterministically into the fallback path.
+    Returns (new streams or None for a leaving rank, report); the
+    measured staging peak is asserted against the ceiling."""
+    t0 = time.perf_counter()
+    plan = ReshardPlan(specs, n_old, n_new, chunk_bytes=chunk_bytes,
+                       peak_bytes=peak_bytes)
+    timeout = default_timeout() if timeout is None else timeout
+    tracker = _PeakTracker()
+    nbytes = 0
+    chunks = 0
+    out = None
+    try:
+        if old_rank is not None:
+            if streams is None:
+                raise ValueError("old owner needs its local streams")
+            b, c = publish_streams(plan, streams, old_rank, transport,
+                                   tag=tag, wire=wire, tracker=tracker)
+            nbytes += b
+            chunks += c
+        if new_rank is not None:
+            out, b, c = fetch_streams(plan, new_rank, transport,
+                                      tag=tag, timeout=timeout,
+                                      tracker=tracker)
+            nbytes += b
+            chunks += c
+            transport.put(f"{tag}/recv_ok/r{new_rank}", "ok")
+    except Exception as e:
+        # Best-effort fail marker so live peers fail fast instead of
+        # burning the full timeout (a genuinely dead peer writes
+        # nothing and peers time out — same outcome, slower).
+        try:
+            who = new_rank if new_rank is not None else old_rank
+            transport.put(f"{tag}/fail/r{who}", str(e)[:200])
+        except Exception:  # lint: allow-swallow(peer may be gone)
+            pass
+        raise
+    if new_rank is not None:
+        _await_verdicts(plan, transport, tag, timeout)
+    report = ReshardReport(
+        bytes_moved=nbytes, peak_bytes=tracker.peak,
+        wall_ms=(time.perf_counter() - t0) * 1e3, chunks=chunks)
+    if report.peak_bytes > plan.peak_bytes:
+        raise ReshardError(
+            f"reshard staging peaked at {report.peak_bytes} bytes, "
+            f"over the HOROVOD_RESHARD_PEAK_BYTES ceiling "
+            f"{plan.peak_bytes} — planner bug, not a transient")
+    if _met.enabled():
+        _met.reshard_bytes.set(report.bytes_moved)
+        _met.reshard_peak_bytes.set(report.peak_bytes)
+        _met.reshard_ms.set(report.wall_ms)
+    return out, report
+
+
+def _await_verdicts(plan: ReshardPlan, transport, tag: str,
+                    timeout: float) -> None:
+    deadline = time.monotonic() + timeout
+    for r in range(plan.n_old):
+        left = max(0.5, deadline - time.monotonic())
+        try:
+            transport.wait(f"{tag}/done/r{r}", timeout=left)
+        except ReshardError:
+            fail = transport.get(f"{tag}/fail/r{r}")
+            raise ReshardError(
+                f"old rank {r} never finished publishing"
+                + (f" (reported: {fail})" if fail else
+                   " (dead peer?)"))
+    for r in range(plan.n_new):
+        left = max(0.5, deadline - time.monotonic())
+        try:
+            transport.wait(f"{tag}/recv_ok/r{r}", timeout=left)
+        except ReshardError:
+            fail = transport.get(f"{tag}/fail/r{r}")
+            raise ReshardError(
+                f"new rank {r} did not verify its fetch"
+                + (f" (reported: {fail})" if fail else
+                   " (dead peer?)"))
+
+
+def cleanup(transport, tag: str = "g") -> None:
+    """Best-effort deletion of a finished (or abandoned) reshard's
+    keys.  Call from the new rank 0 after the verdict, or from the
+    driver when a generation is torn down."""
+    try:
+        for k in transport.keys(f"{tag}/"):
+            transport.delete(k)
+    except Exception:  # lint: allow-swallow(cleanup is best-effort)
+        pass
+
+
+# ---------------------------------------------------------------------------
+# local (single-host) restack — scenario (c) and the fallback path
+
+def reshard_shard_rows(rows: np.ndarray, elems: int,
+                       n_new: int) -> np.ndarray:
+    """Restack one group's (n_old, shard_old) rows to
+    (n_new, shard_new): concat → truncate padding → repad → recut.
+    Pure data movement; bitwise."""
+    rows = np.asarray(rows)
+    flat = rows.reshape(-1)[:elems]
+    s = _shard_sz(elems, n_new)
+    out = np.zeros((n_new * s,), rows.dtype)
+    out[:elems] = flat
+    return out.reshape(n_new, s)
+
+
+def reshard_ef_rows(rows: np.ndarray, elems: int,
+                    n_new: int) -> np.ndarray:
+    """Fold one group's (n_old, W_old) EF residual rows to
+    (n_new, W_new): `new[j] = Σ_{r ≡ j (mod n_new)} old[r]` over the
+    logical extent (ascending r, f32 — the same order the distributed
+    fetch accumulates in), zeros beyond.  Conserves the total residual
+    on shrink; joiners start clean on grow."""
+    rows = np.asarray(rows, np.float32)
+    n_old = rows.shape[0]
+    w_new = elems + (-elems) % n_new
+    out = np.zeros((n_new, w_new), np.float32)
+    for r in range(n_old):
+        out[r % n_new, :elems] += rows[r, :elems]
+    return out
+
+
+def reshard_replicated_rows(rows: np.ndarray,
+                            n_new: int) -> np.ndarray:
+    """Resize a rank-stacked replicated (n_old, ...) leaf (adam's
+    count) to (n_new, ...): the rows must be identical — verified, not
+    assumed — and row 0 is tiled."""
+    rows = np.asarray(rows)
+    if rows.shape[0] > 1 and not all(
+            np.array_equal(rows[0], rows[r])
+            for r in range(1, rows.shape[0])):
+        raise ReshardError(
+            "rank-stacked scalar optimizer leaf has diverged rows — "
+            "cannot reshard a replicated stream that is not "
+            "replicated")
+    return np.broadcast_to(
+        rows[0], (n_new,) + rows.shape[1:]).copy()
+
+
+def reshard_opt_state(opt_state, group_elems: Tuple[int, ...],
+                      n_new: int):
+    """Scenario (c): locally restack a COMPAT-mode
+    `DistributedOptState` (every stacked leaf (n_old, ...) present)
+    from its n_old partition to n_new — e.g. a checkpoint saved at N
+    loaded at M.  `group_elems` is the per-shard-group unpadded length
+    (`zero_group_elems(params)`); counter and guard state are
+    world-size independent and pass through.  Group by group, so peak
+    extra memory is one group's stack, not the model's."""
+    import jax
+
+    from .optimizer import (_ShardSlot, _WireEF, _ZeroAccum,
+                            DistributedOptState)
+    if not isinstance(opt_state, DistributedOptState) or \
+            not isinstance(opt_state.inner, tuple) or \
+            not all(isinstance(s, _ShardSlot) for s in opt_state.inner):
+        raise HorovodTpuError(
+            "reshard_opt_state needs a shard_optimizer_states=True "
+            "DistributedOptState (ZeRO 1-3) in compat layout")
+    if len(group_elems) != len(opt_state.inner):
+        raise HorovodTpuError(
+            f"group_elems covers {len(group_elems)} groups but the "
+            f"state has {len(opt_state.inner)} — recompute it with "
+            "the same tunables the optimizer was built with")
+    n_old = int(np.asarray(jax.tree_util.tree_leaves(
+        opt_state.inner[0].state)[0]).shape[0])
+
+    def _restack_leaf(leaf, elems):
+        a = np.asarray(leaf)
+        if a.ndim >= 2 and a.shape[0] == n_old and \
+                a.shape[-1] == _shard_sz(elems, n_old):
+            return reshard_shard_rows(a, elems, n_new)
+        if a.ndim == 1 and a.shape[0] == n_old:
+            return reshard_replicated_rows(a, n_new)
+        raise HorovodTpuError(
+            f"unrecognized stacked optimizer leaf shape {a.shape} for "
+            f"a group of {elems} elems over n_old={n_old}")
+
+    slots = []
+    for slot, elems in zip(opt_state.inner, group_elems):
+        st = jax.tree_util.tree_map(
+            lambda leaf, e=elems: _restack_leaf(leaf, e), slot.state)
+        master = None if slot.master is None else \
+            reshard_shard_rows(np.asarray(slot.master), elems, n_new)
+        slots.append(_ShardSlot(st, master))
+    accum = opt_state.accum
+    if isinstance(accum, _ZeroAccum):
+        accum = _ZeroAccum(tuple(
+            reshard_shard_rows(np.asarray(r), elems, n_new)
+            for r, elems in zip(accum.rows, group_elems)))
+    wef = opt_state.wire_ef
+    if isinstance(wef, _WireEF):
+        wef = _WireEF(tuple(
+            None if r is None else
+            reshard_ef_rows(np.asarray(r), elems, n_new)
+            for r, elems in zip(wef.rows, group_elems)),
+            np.asarray(_wire.error_feedback_generation(), np.int32))
+    return DistributedOptState(tuple(slots), accum,
+                               np.asarray(opt_state.counter),
+                               opt_state.guard, wef)
+
+
+# ---------------------------------------------------------------------------
+# state <-> streams (the elastic scenario-a vocabulary)
+
+def opt_state_streams(opt_state, group_elems: Tuple[int, ...],
+                      n_old: int, old_rank: int
+                      ) -> Tuple[List[StreamSpec],
+                                 Dict[str, np.ndarray]]:
+    """Decompose a compat-mode sharded `DistributedOptState` into this
+    rank's stream slices for `reshard_streams`: per-element leaves →
+    "shard" rows, EF residuals → "perrank" rows, rank-stacked scalars
+    → "replicated" (rank 0 carries them).  The inverse is
+    `streams_to_opt_state`."""
+    import jax
+
+    from .optimizer import _WireEF, _ZeroAccum
+    specs: List[StreamSpec] = []
+    data: Dict[str, np.ndarray] = {}
+
+    def _add(name, arr, elems):
+        a = np.asarray(arr)
+        if a.ndim >= 2 and a.shape[0] == n_old and \
+                a.shape[-1] == _shard_sz(elems, n_old):
+            specs.append(StreamSpec(name, elems, str(a.dtype), "shard"))
+            lo, hi = _owned_range(elems, n_old, old_rank)
+            # own row, padding truncated (lo = old_rank * shard_sz)
+            data[name] = a[old_rank].reshape(-1)[:hi - lo]
+        elif a.ndim == 1 and a.shape[0] == n_old:
+            specs.append(StreamSpec(name, 1, str(a.dtype),
+                                    "replicated"))
+            if old_rank == 0:
+                data[name] = a[:1].copy()
+        else:
+            raise HorovodTpuError(
+                f"unrecognized stacked leaf shape {a.shape} for "
+                f"stream {name!r}")
+
+    for gi, slot in enumerate(opt_state.inner):
+        leaves = jax.tree_util.tree_leaves(slot.state)
+        for li, leaf in enumerate(leaves):
+            _add(f"o{gi}.{li}", leaf, group_elems[gi])
+        if slot.master is not None:
+            _add(f"m{gi}", slot.master, group_elems[gi])
+    if isinstance(opt_state.accum, _ZeroAccum):
+        for gi, r in enumerate(opt_state.accum.rows):
+            _add(f"a{gi}", r, group_elems[gi])
+    if isinstance(opt_state.wire_ef, _WireEF):
+        for gi, r in enumerate(opt_state.wire_ef.rows):
+            if r is None:
+                continue
+            elems = group_elems[gi]
+            specs.append(StreamSpec(f"e{gi}", elems, "float32",
+                                    "perrank"))
+            data[f"e{gi}"] = np.asarray(r)[old_rank, :elems].astype(
+                np.float32)
+    # the sync counter travels too — a joining rank's freshly-init
+    # template would otherwise smuggle a zero counter into the new
+    # generation
+    c = np.asarray(opt_state.counter)
+    specs.append(StreamSpec("c", 1, str(c.dtype), "replicated"))
+    if old_rank == 0:
+        data["c"] = c.reshape(1).copy()
+    return specs, data
+
+
+def streams_to_opt_state(template, streams: Dict[str, np.ndarray],
+                         group_elems: Tuple[int, ...], n_new: int,
+                         new_rank: int):
+    """Rebuild this new rank's COMPAT-ROW view of the optimizer state
+    from fetched streams: every stacked leaf comes back (n_new, ...)
+    with only row `new_rank` meaningful for "shard" kinds (restack
+    across the new world — `F.allgather` in compat mode, or keep the
+    (1, ...) row under `sharded_state_specs` placement).  For n_new=1
+    the result is immediately the full compat state."""
+    import jax
+
+    from .optimizer import (_ShardSlot, _WireEF, _ZeroAccum,
+                            DistributedOptState)
+
+    def _expand(name, leaf, elems):
+        a = np.asarray(leaf)
+        if name in streams and a.ndim >= 2:
+            s = _shard_sz(elems, n_new)
+            lo, hi = _owned_range(elems, n_new, new_rank)
+            row = np.zeros((s,), a.dtype)
+            row[:hi - lo] = streams[name].astype(a.dtype)
+            out = np.zeros((n_new, s), a.dtype)
+            out[new_rank] = row
+            return out
+        if name in streams:  # replicated scalar
+            return np.broadcast_to(
+                streams[name].astype(a.dtype).reshape(
+                    a.shape[1:] if a.ndim else ()),
+                (n_new,) + a.shape[1:]).copy()
+        raise HorovodTpuError(f"missing fetched stream {name!r}")
+
+    slots = []
+    for gi, slot in enumerate(template.inner):
+        leaves, treedef = jax.tree_util.tree_flatten(slot.state)
+        new_leaves = [
+            _expand(f"o{gi}.{li}", leaf, group_elems[gi])
+            for li, leaf in enumerate(leaves)]
+        st = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        master = None if slot.master is None else \
+            _expand(f"m{gi}", slot.master, group_elems[gi])
+        slots.append(_ShardSlot(st, master))
+    accum = template.accum
+    if isinstance(accum, _ZeroAccum):
+        accum = _ZeroAccum(tuple(
+            _expand(f"a{gi}", r, group_elems[gi])
+            for gi, r in enumerate(accum.rows)))
+    wef = template.wire_ef
+    if isinstance(wef, _WireEF):
+        rows = []
+        for gi, r in enumerate(wef.rows):
+            if r is None:
+                rows.append(None)
+                continue
+            elems = group_elems[gi]
+            w_new = elems + (-elems) % n_new
+            full = np.zeros((n_new, w_new), np.float32)
+            full[new_rank, :elems] = streams[f"e{gi}"]
+            rows.append(full)
+        wef = _WireEF(tuple(rows),
+                      np.asarray(_wire.error_feedback_generation(),
+                                 np.int32))
+    tc = np.asarray(template.counter)
+    counter = (streams["c"].astype(tc.dtype).reshape(tc.shape)
+               if "c" in streams else tc)
+    return DistributedOptState(tuple(slots), accum, counter,
+                               template.guard, wef)
+
+
+def param_streams(rows, group_elems: Tuple[int, ...], n_old: int,
+                  old_rank: int, dtypes=None
+                  ) -> Tuple[List[StreamSpec], Dict[str, np.ndarray]]:
+    """zero3 param rows (compat (n, shard) stacks or this rank's
+    (shard,) slices) → "shard" streams `p{g}`."""
+    specs = []
+    data = {}
+    for gi, (r, elems) in enumerate(zip(rows, group_elems)):
+        a = np.asarray(r)
+        row = a[old_rank] if a.ndim == 2 and a.shape[0] == n_old \
+            else a.reshape(-1)
+        lo, hi = _owned_range(elems, n_old, old_rank)
+        specs.append(StreamSpec(f"p{gi}", elems, str(row.dtype),
+                                "shard"))
+        data[f"p{gi}"] = row.reshape(-1)[:hi - lo]
+    return specs, data
+
+
+def streams_to_param_rows(streams: Dict[str, np.ndarray],
+                          group_elems: Tuple[int, ...],
+                          dtypes: Tuple[Any, ...], n_new: int,
+                          new_rank: int) -> Tuple[np.ndarray, ...]:
+    """Fetched `p{g}` streams → this rank's (n_new, shard_new) compat
+    rows (only row `new_rank` filled; restack across the new world to
+    complete compat mode, or slice row `new_rank` for placed mode)."""
+    out = []
+    for gi, (elems, dt) in enumerate(zip(group_elems, dtypes)):
+        s = _shard_sz(elems, n_new)
+        lo, hi = _owned_range(elems, n_new, new_rank)
+        rows = np.zeros((n_new, s), np.dtype(dt))
+        rows[new_rank, :hi - lo] = streams[f"p{gi}"]
+        out.append(rows)
+    return tuple(out)
+
+
+def merge_rank_streams(specs: List[StreamSpec],
+                       per_rank: List[Dict[str, np.ndarray]],
+                       n_new: int) -> Dict[str, np.ndarray]:
+    """Merge every new rank's fetched streams (e.g. from an eager
+    `allgather_object` across the new world) into full COMPAT-mode
+    buffers: "shard" → the (elems,) logical buffer, "perrank" → the
+    (n_new, elems) row matrix, "replicated" → the shared scalar row.
+    The compat restack is the one place the full buffer exists — the
+    reshard transport itself never holds more than a chunk."""
+    out: Dict[str, np.ndarray] = {}
+    for spec in specs:
+        if spec.kind == "replicated":
+            out[spec.name] = np.asarray(per_rank[0][spec.name])
+            continue
+        if spec.kind == "perrank":
+            out[spec.name] = np.stack(
+                [np.asarray(per_rank[r][spec.name])
+                 for r in range(n_new)])
+            continue
+        buf = np.zeros((spec.elems,), np.dtype(spec.dtype))
+        for r in range(n_new):
+            lo, hi = _owned_range(spec.elems, n_new, r)
+            buf[lo:hi] = np.asarray(per_rank[r][spec.name])[:hi - lo]
+        out[spec.name] = buf
+    return out
+
+
+def compat_opt_state_from_streams(template,
+                                  merged: Dict[str, np.ndarray],
+                                  group_elems: Tuple[int, ...],
+                                  n_new: int):
+    """Full compat-mode `DistributedOptState` at n_new from MERGED
+    streams (`merge_rank_streams`) — the restacked state every rank
+    holds after an elastic reshard in compat mode.  `template` only
+    provides tree structure, dtypes, counter, and guard (an
+    `init_fn`-fresh state at any world size works)."""
+    import jax
+
+    from .optimizer import (_ShardSlot, _WireEF, _ZeroAccum,
+                            DistributedOptState)
+
+    def _stack(name, leaf, elems):
+        a = np.asarray(leaf)
+        if a.ndim >= 2:
+            return reshard_shard_rows(
+                merged[name].astype(a.dtype).reshape(1, -1), elems,
+                n_new)
+        return np.broadcast_to(
+            merged[name].astype(a.dtype).reshape(()),
+            (n_new,)).copy()
+
+    slots = []
+    for gi, slot in enumerate(template.inner):
+        leaves, treedef = jax.tree_util.tree_flatten(slot.state)
+        st = jax.tree_util.tree_unflatten(treedef, [
+            _stack(f"o{gi}.{li}", leaf, group_elems[gi])
+            for li, leaf in enumerate(leaves)])
+        master = None if slot.master is None else \
+            _stack(f"m{gi}", slot.master, group_elems[gi])
+        slots.append(_ShardSlot(st, master))
+    accum = template.accum
+    if isinstance(accum, _ZeroAccum):
+        accum = _ZeroAccum(tuple(
+            _stack(f"a{gi}", r, group_elems[gi])
+            for gi, r in enumerate(accum.rows)))
+    wef = template.wire_ef
+    if isinstance(wef, _WireEF):
+        rows = []
+        for gi, r in enumerate(wef.rows):
+            if r is None:
+                rows.append(None)
+                continue
+            elems = group_elems[gi]
+            w_new = elems + (-elems) % n_new
+            full = np.zeros((n_new, w_new), np.float32)
+            full[:, :elems] = merged[f"e{gi}"]
+            rows.append(full)
+        wef = _WireEF(tuple(rows),
+                      np.asarray(_wire.error_feedback_generation(),
+                                 np.int32))
+    tc = np.asarray(template.counter)
+    counter = (merged["c"].astype(tc.dtype).reshape(tc.shape)
+               if "c" in merged else tc)
+    return DistributedOptState(tuple(slots), accum, counter,
+                               template.guard, wef)
+
+
+def compat_param_rows_from_streams(merged: Dict[str, np.ndarray],
+                                   group_elems: Tuple[int, ...],
+                                   dtypes: Tuple[Any, ...],
+                                   n_new: int) -> Tuple[np.ndarray, ...]:
+    """Full compat (n_new, shard) zero3 row stacks from MERGED `p{g}`
+    streams."""
+    return tuple(
+        reshard_shard_rows(
+            merged[f"p{gi}"].astype(np.dtype(dt)).reshape(1, -1),
+            elems, n_new)
+        for gi, (elems, dt) in enumerate(zip(group_elems, dtypes)))
+
+
+# ---------------------------------------------------------------------------
+# scenario (b): train→serve decode-layout handoff
+
+def _leaf_flat_intervals(shape: Tuple[int, ...], axis: int, tp: int,
+                         tp_rank: int) -> List[Tuple[int, int, int]]:
+    """(leaf-flat start, stop, dest offset) covering this tp rank's
+    slice of `axis` in row-major order — one contiguous interval when
+    axis 0 is sharded, `prod(shape[:axis])` strided intervals
+    otherwise."""
+    if axis is None:
+        total = int(np.prod(shape, dtype=int)) if shape else 1
+        return [(0, total, 0)]
+    d = shape[axis]
+    if d % tp:
+        raise HorovodTpuError(
+            f"decode handoff: axis {axis} of {shape} does not divide "
+            f"tp={tp}")
+    per = d // tp
+    inner = int(np.prod(shape[axis + 1:], dtype=int))
+    outer = int(np.prod(shape[:axis], dtype=int))
+    run = per * inner
+    out = []
+    for o in range(outer):
+        start = o * d * inner + tp_rank * run
+        out.append((start, start + run, o * run))
+    return out
+
+
+def decode_leaf_slices(leaf_meta, groups, streams_fetch: Callable,
+                       tp: int, tp_rank: int):
+    """Assemble each decode leaf's tp slice from group-logical
+    intervals.  `leaf_meta` is [(shape, dtype, tp_axis or None)] in
+    leaf order; `groups` is [(idxs, sizes)] per shard group (the
+    training partition); `streams_fetch(g, start, stop)` returns the
+    logical `[start, stop)` slice of group g's param buffer (the
+    fetching transport hides behind it).  No host ever materializes a
+    full leaf it only needs 1/tp of."""
+    leaves = []
+    offsets = {}
+    for gi, (idxs, sizes) in enumerate(groups):
+        off = 0
+        for i, sz in zip(idxs, sizes):
+            offsets[i] = (gi, off)
+            off += sz
+    for li, (shape, dt, axis) in enumerate(leaf_meta):
+        gi, base = offsets[li]
+        ivs = _leaf_flat_intervals(tuple(shape), axis, tp, tp_rank)
+        out_shape = list(shape)
+        if axis is not None:
+            out_shape[axis] = shape[axis] // tp
+        buf = np.zeros((int(np.prod(out_shape, dtype=int)),),
+                       np.dtype(dt))
+        for start, stop, dest in ivs:
+            buf[dest:dest + (stop - start)] = streams_fetch(
+                gi, base + start, base + stop).astype(np.dtype(dt))
+        leaves.append(buf.reshape(out_shape))
+    return leaves
+
+
+def fetch_group_slice(plan: ReshardPlan, spec: StreamSpec, transport,
+                      tag: str, start: int, stop: int,
+                      timeout: Optional[float] = None,
+                      tracker: Optional[_PeakTracker] = None
+                      ) -> np.ndarray:
+    """Fetch an arbitrary logical `[start, stop)` slice of one "shard"
+    stream from whatever old owners published it — the serve-side
+    primitive behind `decode_leaf_slices` (chunk-bounded: one payload
+    staged at a time)."""
+    timeout = default_timeout() if timeout is None else timeout
+    tracker = tracker or _PeakTracker()
+    dt = np.dtype(spec.dtype)
+    out = np.zeros((stop - start,), dt)
+    for r in range(plan.n_old):
+        olo, ohi = _owned_range(spec.elems, plan.n_old, r)
+        a, b = max(start, olo), min(stop, ohi)
+        if a >= b:
+            continue
+        for c, d in plan._grid_cut(spec, a, b):
+            pub = _fix_grid_cut_overlap(plan, spec,
+                                        Interval(r, c, d))
+            v = transport.wait(f"{tag}/{_iv_key(spec.name, pub)}",
+                               timeout=timeout)
+            chunk = _decode_payload(v, dt, tracker)
+            out[c - start:d - start] = chunk[c - pub.start:d - pub.start]
+    return out
+
+
+def plan_meta_json(specs: List[StreamSpec], n_old: int) -> str:
+    """Deterministic serialization of (specs, n_old) — the publish side
+    writes it under `{tag}/meta` so a fetch side that was not present
+    at publish time (a joining rank, a serve host) can rebuild the
+    identical plan."""
+    return json.dumps(
+        {"n_old": n_old,
+         "specs": [list(s) for s in specs]},
+        sort_keys=True, separators=(",", ":"))
+
+
+def plan_meta_parse(text: str) -> Tuple[List[StreamSpec], int]:
+    d = json.loads(text)
+    return [StreamSpec(*s) for s in d["specs"]], int(d["n_old"])

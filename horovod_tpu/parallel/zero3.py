@@ -213,6 +213,32 @@ class ZeroParamPlacement:
             out.append(flat.reshape(self.n, g.shard_sz))
         return tuple(out)
 
+    @property
+    def group_elems(self) -> Tuple[int, ...]:
+        """Per-group UNPADDED element counts — the logical buffer
+        lengths a live reshard (parallel/reshard.py) is planned
+        against (padding depends on the world size and never
+        travels)."""
+        return tuple(sum(g.sizes) for g in self.groups)
+
+    def regroup(self, n_new: int) -> "ZeroParamPlacement":
+        """The same placement re-cut for a world of `n_new` ranks —
+        the post-reshard companion object after an elastic shrink/grow
+        (docs/RESHARD.md scenario a) or a cross-mesh checkpoint load
+        (scenario c).  The leaf tree, tunables, and shard-group
+        partition are carried over unchanged (the partition does not
+        depend on the world size); only the padded/shard_sz geometry
+        is recomputed, so `reshard_shard_rows(rows, elems, n_new)`
+        output drops straight into `regroup(n_new).gather(...)`."""
+        if n_new < 1:
+            raise ValueError(f"regroup needs n_new >= 1, got {n_new}")
+        clone = object.__new__(ZeroParamPlacement)
+        clone.__dict__.update(self.__dict__)
+        clone.n = int(n_new)
+        clone.groups = tuple(
+            clone._group_meta(g.idxs) for g in self.groups)
+        return clone
+
     # -- just-in-time gather ----------------------------------------------
 
     def _own_row(self, r: jax.Array, idx) -> jax.Array:

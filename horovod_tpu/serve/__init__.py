@@ -8,9 +8,11 @@ Layout (docs/SERVING.md):
   - loadgen.py   seeded load generator + bench stats (make_trace, ...)
   - replica.py   elastic multi-replica serving (ReplicaManager)
   - flightrec.py always-on crash/breach flight recorder (FlightRecorder)
+  - handoff.py   train→serve reshard without full gather (docs/RESHARD.md)
 """
 
 from .flightrec import FlightRecorder
+from .handoff import fetch_decode_params, handoff_meta, publish_for_serve
 from .pool import PagedKVPool, PoolExhaustedError
 from .scheduler import ActiveSeq, ContinuousScheduler, POLICIES, Request
 from .server import InferenceServer
@@ -21,6 +23,9 @@ __all__ = [
     "ContinuousScheduler",
     "FlightRecorder",
     "InferenceServer",
+    "fetch_decode_params",
+    "handoff_meta",
+    "publish_for_serve",
     "POLICIES",
     "PagedKVPool",
     "PoolExhaustedError",

@@ -1,0 +1,156 @@
+"""Train→serve handoff: reshard the ZeRO-3 training layout into the
+dp×tp decode layout WITHOUT a full gather (docs/RESHARD.md, scenario b).
+
+The training side owns params as zero3 compat rows — per shard group, a
+flat dtype buffer cut into `n_train` rows (`parallel.zero3`).  The
+decode side wants each leaf sliced along its tensor-parallel axis
+(`models.transformer.transformer_pspecs`): a serve host holding tp rank
+`j` of `tp` needs exactly `1/tp` of every sharded leaf and all of every
+replicated one.  Those are different partitions of the SAME logical
+buffers, so the handoff is a reshard, not a gather: the trainer
+publishes its rows in peak-bounded chunks (`publish_for_serve`), and
+each serve host fetches only the group-logical intervals its decode
+slices cover (`fetch_decode_params`) — chunk-by-chunk, never holding a
+full leaf it only needs a slice of.
+
+Integrity is the reshard module's: per-chunk sha256 plus the publish
+side's per-stream bit-pattern digests.  A dead trainer or corrupt chunk
+surfaces as `ReshardError`; the serve caller falls back to loading a
+checkpoint the slow way.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..common.exceptions import HorovodTpuError
+from ..ops.compression import Compression
+from ..parallel import reshard as _rs
+from ..parallel.data_parallel import shard_group_partition
+
+logger = logging.getLogger("horovod_tpu.serve.handoff")
+
+
+def _tp_axis(spec) -> Optional[int]:
+    """Position of the 'tp' axis in one PartitionSpec, or None."""
+    if spec is None:
+        return None
+    for ax, entry in enumerate(spec):
+        if entry == "tp" or (isinstance(entry, tuple) and "tp" in entry):
+            return ax
+    return None
+
+
+def handoff_meta(params_template: Any, pspecs: Any,
+                 compression=Compression.none,
+                 fusion_threshold_bytes: Optional[int] = None,
+                 bucket_order=None
+                 ) -> Tuple[List[Tuple[Tuple[int, ...], str,
+                                       Optional[int]]],
+                            List[Tuple[List[int], List[int]]]]:
+    """(leaf_meta, groups) for the decode handoff.
+
+    `leaf_meta[i]` is (shape, dtype, tp_axis or None) for leaf i in
+    tree-leaves order; `groups` is [(idxs, sizes)] straight from the
+    TRAINING shard-group partition — pass the same tunables training
+    used, or the group-logical offsets will not line up (the published
+    plan meta cross-checks this, see `fetch_decode_params`)."""
+    from jax.sharding import PartitionSpec
+
+    leaves = jax.tree_util.tree_leaves(params_template)
+    spec_leaves = jax.tree_util.tree_leaves(
+        pspecs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    if len(spec_leaves) != len(leaves):
+        raise HorovodTpuError(
+            f"pspec tree has {len(spec_leaves)} leaves but params have "
+            f"{len(leaves)} — structures must match")
+    leaf_meta = [
+        (tuple(int(d) for d in l.shape), str(np.dtype(l.dtype)),
+         _tp_axis(s))
+        for l, s in zip(leaves, spec_leaves)]
+    fakes = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+    groups = [
+        (list(idxs),
+         [int(np.prod(leaves[i].shape, dtype=int)) for i in idxs])
+        for idxs in shard_group_partition(
+            fakes, compression=compression,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            bucket_order=bucket_order)]
+    return leaf_meta, groups
+
+
+def publish_for_serve(rows, group_elems: Tuple[int, ...], n_old: int,
+                      old_rank: int, transport, tag: str = "serve",
+                      chunk_bytes: Optional[int] = None,
+                      peak_bytes: Optional[int] = None,
+                      wire: Optional[str] = None) -> "_rs.ReshardReport":
+    """Training side: publish this rank's zero3 param rows (compat
+    stacks or the (shard,) slice) for serve hosts to fetch.  Every old
+    rank calls this; rank 0 also writes the plan meta.  Returns the
+    publish report."""
+    specs, data = _rs.param_streams(rows, group_elems, n_old, old_rank)
+    if old_rank == 0:
+        transport.put(f"{tag}/meta", _rs.plan_meta_json(specs, n_old))
+    _, report = _rs.reshard_streams(
+        specs, data, n_old, n_old, old_rank, None, transport, tag=tag,
+        chunk_bytes=chunk_bytes, peak_bytes=peak_bytes, wire=wire)
+    logger.info(
+        "serve handoff: rank %d/%d published %d group(s), %d bytes",
+        old_rank, n_old, len(specs), report.bytes_moved)
+    return report
+
+
+def fetch_decode_params(params_template: Any, pspecs: Any, transport,
+                        tag: str = "serve", tp: int = 1,
+                        tp_rank: int = 0,
+                        compression=Compression.none,
+                        fusion_threshold_bytes: Optional[int] = None,
+                        bucket_order=None,
+                        chunk_bytes: Optional[int] = None,
+                        peak_bytes: Optional[int] = None,
+                        timeout: Optional[float] = None) -> Any:
+    """Serve side: rebuild this host's tp slice of every decode leaf
+    from the trainer's published rows.  Returns a pytree shaped like
+    `params_template` with each tp-sharded leaf cut to `1/tp` along its
+    axis — ready for `make_decode_step`'s placement."""
+    leaf_meta, groups = handoff_meta(
+        params_template, pspecs, compression=compression,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        bucket_order=bucket_order)
+    timeout = _rs.default_timeout() if timeout is None else timeout
+    specs, n_old = _rs.plan_meta_parse(
+        transport.wait(f"{tag}/meta", timeout=timeout))
+    by_name = {s.name: s for s in specs}
+    for gi, (idxs, sizes) in enumerate(groups):
+        spec = by_name.get(f"p{gi}")
+        if spec is None or spec.elems != sum(sizes):
+            raise HorovodTpuError(
+                f"serve handoff drift: local group {gi} "
+                f"({sum(sizes)} elems) does not match the published "
+                f"plan ({spec.elems if spec else 'missing'}) — "
+                "recompute handoff_meta with the trainer's tunables")
+    plan = _rs.ReshardPlan(specs, n_old, 1, chunk_bytes=chunk_bytes,
+                           peak_bytes=peak_bytes)
+    tracker = _rs._PeakTracker()
+
+    def _fetch(gi: int, start: int, stop: int) -> np.ndarray:
+        return _rs.fetch_group_slice(
+            plan, by_name[f"p{gi}"], transport, tag, start, stop,
+            timeout=timeout, tracker=tracker)
+
+    leaves = _rs.decode_leaf_slices(leaf_meta, groups, _fetch, tp,
+                                    tp_rank)
+    treedef = jax.tree_util.tree_structure(params_template)
+    out = jax.tree_util.tree_unflatten(treedef, leaves)
+    logger.info(
+        "serve handoff: tp rank %d/%d fetched %d leaf slices from "
+        "old world %d (staging peak %d bytes)", tp_rank, tp,
+        len(leaves), n_old, tracker.peak)
+    return out
+
+
+__all__ = ["fetch_decode_params", "handoff_meta", "publish_for_serve"]

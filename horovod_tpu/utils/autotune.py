@@ -437,6 +437,16 @@ def init_from_env() -> Optional[ParameterManager]:
                 integer=True, host_only=True,
                 initial=max(64, util.env_int("SERVE_FLIGHTREC_DEPTH",
                                              512)))
+    # Live-reshard chunk-grid cell size (docs/RESHARD.md): smaller
+    # chunks lower the staging peak and sharpen failure granularity,
+    # larger ones amortize per-chunk transport overhead.  Host-side
+    # data movement only, so host_only keeps it out of the program-
+    # cache key; the executor clamps it to RESHARD_PEAK_BYTES/4
+    # regardless of what the tuner proposes.
+    pm.register("reshard_chunk_bytes", 4 << 10, 64 << 20,
+                log_scale=True, integer=True, host_only=True,
+                initial=(util.env_int("RESHARD_CHUNK_BYTES", 0)
+                         or (4 << 20)))
     _manager = pm
     logger.info("autotune enabled: %s", pm.values())
     return pm
@@ -725,6 +735,25 @@ def current_serve_flightrec_depth() -> int:
     if env <= 0:
         return 0
     return tuned_serve_flightrec_depth(env)
+
+
+def tuned_reshard_chunk_bytes(default: int) -> int:
+    """Reshard chunk size honoring the autotuner when active
+    (host_only: never in `values()` / the program-cache key)."""
+    if _manager is not None and \
+            "reshard_chunk_bytes" in _manager._tunables:
+        return max(1, int(_manager.value("reshard_chunk_bytes")))
+    return default
+
+
+def current_reshard_chunk_bytes() -> int:
+    """The live reshard chunk-grid cell size:
+    HOROVOD_RESHARD_CHUNK_BYTES (0 = auto: the tuner's value, 4 MiB
+    default), before the executor's RESHARD_PEAK_BYTES/4 clamp."""
+    env = util.env_int("RESHARD_CHUNK_BYTES", 0)
+    if env > 0:
+        return env
+    return tuned_reshard_chunk_bytes(4 << 20)
 
 
 def current_serve_pool_pages() -> int:

@@ -1,0 +1,240 @@
+"""Worker main for the REAL cross-process live-resharding test
+(docs/RESHARD.md): two processes, gloo collectives, the rendezvous KV
+store as the reshard transport.
+
+One np=2 launch simulates every scenario the planner must survive:
+
+  - shrink 2→1: both ranks publish their ZeRO-3 shards / optimizer
+    rows / EF residuals in peak-bounded chunks; rank 0 (the survivor)
+    fetches — the result must be BITWISE-identical both to a local
+    restack and to the legacy checkpoint-restore-then-restack path,
+    with the measured staging peak asserted under the configured
+    ceiling;
+  - grow 1→2: both ranks fetch their new shards from rank 0's world-1
+    state, restack via allgather, and must agree with the local fold —
+    and round-trip bitwise back to the original 2-rank rows;
+  - the `ShardedTpuState` elastic API end to end (publish on
+    `on_hosts_updated`, fetch + guard digest + scalar broadcast on
+    `sync`);
+  - `reshard.peer_die` armed on rank 1 mid-publish: every rank must
+    degrade to the checkpoint-restore path, and the guard digest must
+    verify the restored state.
+
+Results go to $HVD_TEST_OUT/rank{r}.json.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.faults as faults  # noqa: E402
+from horovod_tpu.guard import digest as gdigest  # noqa: E402
+from horovod_tpu.ops import functions as F  # noqa: E402
+from horovod_tpu.ops import wire as wire_mod  # noqa: E402
+from horovod_tpu.parallel import reshard as rs  # noqa: E402
+from horovod_tpu.parallel.optimizer import (  # noqa: E402
+    _WireEF, zero_group_elems,
+)
+from horovod_tpu.utils.checkpoint import CheckpointManager  # noqa: E402
+
+SHAPES = [(6,), (4, 2)]
+FUSION = 16   # bytes: two leaves → two shard groups
+PEAK = 4096   # staging ceiling under test (asserted by the executor)
+CHUNK = 16    # forces multi-chunk streams
+
+
+def tree_eq(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    if len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.dtype != y.dtype or x.shape != y.shape or \
+                x.tobytes() != y.tobytes():
+            return False
+    return True
+
+
+def main():
+    hvd.init()
+    rank, n = hvd.rank(), hvd.size()
+    assert n == 2, n
+    res = {"rank": rank, "size": n, "peak_ceiling": PEAK}
+
+    mesh = hvd.global_mesh()
+    spec = P(hvd.GLOBAL_AXIS)
+    rng = np.random.RandomState(0)
+    data = [np.round(rng.randn(n, 3, *s) * 4).astype(np.float32)
+            for s in SHAPES]
+    garrs = [jax.make_array_from_callback(
+        d.shape, NamedSharding(mesh, spec), lambda idx, d=d: d[idx])
+        for d in data]
+    params = [jnp.asarray(np.round(rng.randn(*s) * 2), jnp.float32)
+              for s in SHAPES]
+    ge = zero_group_elems(params, fusion_threshold_bytes=FUSION)
+    assert len(ge) == 2, ge
+
+    # Real ZeRO-3 state: params sharded at rest, adam rows sharded,
+    # 3 micro-steps at K=2 stops MID-window → nonzero stage-2 accum.
+    pl = hvd.zero3_placement(params, fusion_threshold_bytes=FUSION)
+    opt = hvd.DistributedOptimizer(
+        optax.adam(0.25), zero_stage=3, backward_passes_per_step=2,
+        fusion_threshold_bytes=FUSION, axis_name=hvd.GLOBAL_AXIS)
+
+    def body(rows, *xs):
+        rows = tuple(rows)
+        p = pl.gather(rows)
+        state = opt.init(p)
+        for j in range(3):
+            g = [x[0, j] for x in xs]
+            u, state = opt.update(g, state, p)
+            rows = pl.apply_updates(rows, u)
+            p = pl.gather(rows)
+        return rows, state
+
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P(),) + tuple(spec for _ in SHAPES),
+                       out_specs=(P(), P()), check_vma=False)
+    rows_d, state_d = jax.jit(sm)(pl.shard(params), *garrs)
+    rows = tuple(np.asarray(r) for r in rows_d)
+    dtypes = tuple(r.dtype for r in rows)
+    state = jax.tree_util.tree_map(np.asarray, state_d)
+
+    # Synthesize generation-stamped wire-EF residuals on group 0 (the
+    # cooperative-codec case) — integer-valued, zero-padded like init.
+    efr = np.random.RandomState(7)
+    w0 = ge[0] + (-ge[0]) % n
+    e0 = np.zeros((n, w0), np.float32)
+    e0[:, :ge[0]] = efr.randint(-5, 5, size=(n, ge[0]))
+    state = state._replace(wire_ef=_WireEF(
+        (e0, None),
+        np.asarray(wire_mod.error_feedback_generation(), np.int32)))
+
+    out_dir = os.environ["HVD_TEST_OUT"]
+    mgr = CheckpointManager(os.path.join(out_dir, "ckpt"))
+    mgr.save(3, {"params": rows, "opt_state": state}, force=True)
+
+    t = rs.KVTransport.from_env("reshard-test")
+    assert t is not None, "no rendezvous env — not a runner launch?"
+
+    specs, sdata = rs.opt_state_streams(state, ge, n, rank)
+    ps, pd = rs.param_streams(rows, ge, n, rank)
+    specs, sdata = specs + ps, {**sdata, **pd}
+
+    # ---- shrink 2 → 1 --------------------------------------------------
+    if rank == 0:
+        streams, rep = rs.reshard_streams(
+            specs, sdata, 2, 1, 0, 0, t, tag="shrink",
+            chunk_bytes=CHUNK, peak_bytes=PEAK, timeout=60)
+        live_state = rs.streams_to_opt_state(state, streams, ge, 1, 0)
+        live_rows = rs.streams_to_param_rows(streams, ge, dtypes, 1, 0)
+        local_state = rs.reshard_opt_state(state, ge, 1)
+        local_rows = tuple(rs.reshard_shard_rows(r, e, 1)
+                           for r, e in zip(rows, ge))
+        res["shrink_live_eq_local"] = tree_eq(
+            (live_rows, live_state), (local_rows, local_state))
+        res["shrink_chunks"] = rep.chunks
+        res["shrink_peak"] = rep.peak_bytes
+        res["shrink_bytes"] = rep.bytes_moved
+    else:
+        _, rep = rs.reshard_streams(
+            specs, sdata, 2, 1, 1, None, t, tag="shrink",
+            chunk_bytes=CHUNK, peak_bytes=PEAK, timeout=60)
+        res["shrink_peak"] = rep.peak_bytes
+        res["shrink_chunks"] = rep.chunks
+    res["shrink_peak_ok"] = rep.peak_bytes <= PEAK
+    res["shrink_multichunk"] = rep.chunks > 1
+
+    # The legacy path: restore the checkpoint (rank-0 pickle broadcast
+    # — collective, both ranks) and restack locally.  The live reshard
+    # must equal it BITWISE, EF residuals and optimizer state included.
+    restored = mgr.restore_latest()
+    ck_state = rs.reshard_opt_state(restored["opt_state"], ge, 1)
+    ck_rows = tuple(rs.reshard_shard_rows(np.asarray(r), e, 1)
+                    for r, e in zip(restored["params"], ge))
+    if rank == 0:
+        res["shrink_live_eq_restore"] = tree_eq(
+            (live_rows, live_state), (ck_rows, ck_state))
+
+    # ---- grow 1 → 2 ----------------------------------------------------
+    state1 = rs.reshard_opt_state(state, ge, 1)
+    rows1 = tuple(rs.reshard_shard_rows(r, e, 1)
+                  for r, e in zip(rows, ge))
+    specs1, data1 = rs.opt_state_streams(state1, ge, 1, 0)
+    ps1, pd1 = rs.param_streams(rows1, ge, 1, 0)
+    specs1, data1 = specs1 + ps1, {**data1, **pd1}
+    streams, _ = rs.reshard_streams(
+        specs1, data1 if rank == 0 else None, 1, 2,
+        0 if rank == 0 else None, rank, t, tag="grow",
+        chunk_bytes=CHUNK, peak_bytes=PEAK, timeout=60)
+    merged = rs.merge_rank_streams(
+        specs1, F.allgather_object(streams), 2)
+    full_state = rs.compat_opt_state_from_streams(state, merged, ge, 2)
+    full_rows = rs.compat_param_rows_from_streams(merged, ge, dtypes, 2)
+    res["grow_bitwise"] = tree_eq(
+        (full_rows, full_state),
+        (tuple(rs.reshard_shard_rows(r, e, 2)
+               for r, e in zip(rows1, ge)),
+         rs.reshard_opt_state(state1, ge, 2)))
+    # shard rows round-trip 2→1→2 bitwise (EF is deliberately folded)
+    res["grow_rows_roundtrip"] = tree_eq(full_rows, rows)
+    mism = gdigest.check_replica_divergence(
+        gdigest.param_digests(list(full_rows)))
+    res["grow_digest_mismatch"] = mism
+
+    # ---- the elastic API end to end (2 → 2) ----------------------------
+    st = hvd.elastic.ShardedTpuState(
+        params=rows, opt_state=state, group_elems=ge,
+        transport=rs.KVTransport.from_env("cls"), chunk_bytes=CHUNK,
+        peak_bytes=PEAK, reshard_timeout=60, step=7)
+    st.on_hosts_updated()
+    st.sync()
+    res["class_rows_bitwise"] = tree_eq(st.params, rows)
+    res["class_state_bitwise"] = tree_eq(st.opt_state, state)
+    res["class_step"] = st.step
+
+    # ---- peer death mid-reshard degrades to checkpoint restore ---------
+    if rank == 1:
+        faults.install("reshard.peer_die:err")
+    degraded = False
+    try:
+        rs.reshard_streams(
+            specs, sdata, 2, 1, rank, 0 if rank == 0 else None, t,
+            tag="die", chunk_bytes=CHUNK, peak_bytes=PEAK, timeout=6)
+    except (rs.ReshardError, faults.FaultInjected) as e:
+        degraded = True
+        res["die_error"] = type(e).__name__
+    res["die_points_hit"] = faults.points_hit("reshard.peer_die")
+    faults.clear()
+    res["die_degraded"] = degraded
+
+    # the old restore path, guard-digest-verified
+    restored2 = mgr.restore_latest()
+    res["die_restore_bitwise"] = tree_eq(
+        (tuple(np.asarray(r) for r in restored2["params"]),
+         restored2["opt_state"]),
+        (rows, state))
+    res["die_restore_digest_mismatch"] = gdigest.check_replica_divergence(
+        gdigest.param_digests(list(restored2["params"])))
+
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(res, f)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

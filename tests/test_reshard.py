@@ -1,0 +1,469 @@
+"""Live resharding unit tests (parallel/reshard.py, docs/RESHARD.md):
+plan geometry, the peak-bounded LocalTransport exchange, integrity
+failures (corrupt chunk, dead peer), the EF fold rule, scenario (c)
+local restack, and the scenario (b) decode handoff."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu.faults as faults
+from horovod_tpu.common.exceptions import HorovodTpuError, ReshardError
+from horovod_tpu.parallel import reshard as rs
+from horovod_tpu.parallel.optimizer import (
+    DistributedOptState, _ShardSlot, _WireEF, _ZeroAccum,
+)
+
+
+def _ranges(elems, n):
+    return [rs._owned_range(elems, n, r) for r in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# plan geometry
+
+
+@pytest.mark.parametrize("elems,n_old,n_new", [
+    (10, 2, 3), (10, 3, 2), (10, 4, 4), (5, 8, 2), (5, 2, 8),
+    (1, 2, 3), (64, 1, 4), (64, 4, 1), (7, 3, 5),
+])
+def test_plan_fetch_covers_new_range_exactly(elems, n_old, n_new):
+    spec = rs.StreamSpec("p0", elems, "float32", "shard")
+    plan = rs.ReshardPlan([spec], n_old, n_new, chunk_bytes=12,
+                          peak_bytes=1 << 20)
+    published = {
+        (iv.src, iv.start, iv.stop)
+        for r in range(n_old)
+        for iv in plan.publish_intervals(spec, r)}
+    # published payloads tile each old rank's range exactly
+    for r in range(n_old):
+        lo, hi = rs._owned_range(elems, n_old, r)
+        ivs = sorted(i for i in published if i[0] == r)
+        assert sum(b - a for _, a, b in ivs) == hi - lo
+    for r in range(n_new):
+        lo, hi = rs._owned_range(elems, n_new, r)
+        got = plan.fetch_intervals(spec, r)
+        # disjoint, sorted coverage of [lo, hi)
+        covered = sorted((iv.start, iv.stop) for iv in got)
+        assert sum(b - a for a, b in covered) == hi - lo
+        if covered:
+            assert covered[0][0] == lo and covered[-1][1] == hi
+        # every fetch interval maps onto one published payload
+        for iv in got:
+            pub = rs._fix_grid_cut_overlap(plan, spec, iv)
+            assert (pub.src, pub.start, pub.stop) in published
+
+
+def test_perrank_fetch_sources_partition_old_ranks():
+    spec = rs.StreamSpec("e0", 9, "float32", "perrank")
+    plan = rs.ReshardPlan([spec], 5, 2, chunk_bytes=64)
+    srcs = [sorted({iv.src for iv in plan.fetch_intervals(spec, r)})
+            for r in range(2)]
+    assert srcs == [[0, 2, 4], [1, 3]]   # r ≡ j (mod n_new), ascending
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over LocalTransport
+
+
+def _fetch_all(specs, n_old, n_new, t, **kw):
+    """Run every new rank's fetch concurrently (the verdict barrier
+    needs every rank's recv_ok, so sequential fetches would deadlock —
+    exactly as they would in production)."""
+    import threading
+    outs = [None] * n_new
+    reports = [None] * n_new
+    errs = []
+
+    def _one(r):
+        try:
+            outs[r], reports[r] = rs.reshard_streams(
+                specs, None, n_old, n_new, None, r, t, **kw)
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            errs.append(e)
+
+    threads = [threading.Thread(target=_one, args=(r,))
+               for r in range(n_new)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    if errs:
+        raise errs[0]
+    return outs, reports
+
+
+def _move(specs, per_old_data, n_old, n_new, **kw):
+    """Single-process reshard: publish every old rank sequentially,
+    then fetch every new rank concurrently."""
+    t = rs.LocalTransport()
+    reports = []
+    for r in range(n_old):
+        _, rep = rs.reshard_streams(specs, per_old_data[r], n_old,
+                                    n_new, r, None, t, **kw)
+        reports.append(rep)
+    outs, freps = _fetch_all(specs, n_old, n_new, t, **kw)
+    return outs, reports + freps
+
+
+def _shard_data(spec, buf, n_old):
+    out = []
+    for r in range(n_old):
+        lo, hi = rs._owned_range(spec.elems, n_old, r)
+        out.append({spec.name: buf[lo:hi]})
+    return out
+
+
+@pytest.mark.parametrize("n_old,n_new", [(2, 1), (1, 2), (3, 2)])
+def test_transport_roundtrip_bitwise(n_old, n_new):
+    rng = np.random.RandomState(7)
+    buf = rng.uniform(-1, 1, size=(37,)).astype(np.float32)
+    spec = rs.StreamSpec("p0", buf.size, "float32", "shard")
+    outs, _ = _move([spec], _shard_data(spec, buf, n_old), n_old,
+                    n_new, chunk_bytes=16, peak_bytes=1 << 16)
+    got = np.concatenate([outs[r][spec.name] for r in range(n_new)])
+    assert got.tobytes() == buf.tobytes()
+
+
+def test_peak_is_measured_and_bounded():
+    buf = np.arange(4096, dtype=np.float32)
+    spec = rs.StreamSpec("p0", buf.size, "float32", "shard")
+    peak = 4096                                 # forces 1 KiB chunks
+    outs, reports = _move([spec], _shard_data(spec, buf, 2), 2, 1,
+                          chunk_bytes=None, peak_bytes=peak)
+    assert outs[0][spec.name].tobytes() == buf.tobytes()
+    assert all(r.chunks > 1 for r in reports)
+    assert all(0 < r.peak_bytes <= peak for r in reports)
+
+
+def test_peak_overrun_raises():
+    plan = rs.ReshardPlan(
+        [rs.StreamSpec("p0", 8, "float32", "shard")], 1, 1)
+    tr = rs._PeakTracker()
+    tr.add(plan.peak_bytes + 1)
+    assert tr.peak > plan.peak_bytes   # executor turns this into
+    #                                    ReshardError (exercised below
+    #                                    via the ceiling test)
+
+
+def test_chunk_corrupt_detected():
+    buf = np.arange(64, dtype=np.float32)
+    spec = rs.StreamSpec("p0", buf.size, "float32", "shard")
+    faults.install("reshard.chunk_corrupt:err")
+    try:
+        with pytest.raises(ReshardError, match="sha256|corrupt"):
+            _move([spec], _shard_data(spec, buf, 2), 2, 1,
+                  chunk_bytes=64, timeout=2.0)
+        assert faults.points_hit("reshard.chunk_corrupt") > 0
+    finally:
+        faults.clear()
+
+
+def test_peer_die_leaves_fetchers_timing_out():
+    buf = np.arange(64, dtype=np.float32)
+    spec = rs.StreamSpec("p0", buf.size, "float32", "shard")
+    t = rs.LocalTransport()
+    rs.reshard_streams([spec], {spec.name: buf[:32]}, 2, 1, 0, None, t,
+                       chunk_bytes=64)
+    faults.install("reshard.peer_die:err")
+    try:
+        with pytest.raises(faults.FaultInjected):
+            rs.reshard_streams([spec], {spec.name: buf[32:]}, 2, 1, 1,
+                               None, t, chunk_bytes=64)
+    finally:
+        faults.clear()
+    # rank 1 died mid-publish: the fetcher must NOT assemble state —
+    # it fails deterministically (fail marker or timeout).
+    with pytest.raises(ReshardError):
+        rs.reshard_streams([spec], None, 2, 1, None, 0, t,
+                           chunk_bytes=64, timeout=1.0)
+
+
+def test_digest_mismatch_detected():
+    buf = np.arange(16, dtype=np.float32)
+    spec = rs.StreamSpec("p0", buf.size, "float32", "shard")
+    t = rs.LocalTransport()
+    rs.reshard_streams([spec], {spec.name: buf[:8]}, 2, 1, 0, None, t,
+                       chunk_bytes=64)
+    rs.reshard_streams([spec], {spec.name: buf[8:]}, 2, 1, 1, None, t,
+                       chunk_bytes=64)
+    # Flip one payload for a chunk whose sha still verifies: re-encode
+    # different data under the same key (simulates a publisher bug /
+    # torn write the per-chunk sha cannot see).
+    key = [k for k in t.keys("g/p0/") if "digest" not in k][0]
+    evil = buf[:8].copy()
+    evil[0] += 1
+    t.put(key, rs._encode_payload(evil, None, rs._PeakTracker()))
+    with pytest.raises(ReshardError, match="digest"):
+        rs.reshard_streams([spec], None, 2, 1, None, 0, t,
+                           chunk_bytes=64, timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# digests
+
+
+def test_bitsum_digest_order_free_and_exact():
+    rng = np.random.RandomState(3)
+    a = rng.uniform(size=(1001,)).astype(np.float32)
+    whole = rs.bitsum_digest(a)
+    parts = [rs.bitsum_digest(a[:301]), rs.bitsum_digest(a[301:800]),
+             rs.bitsum_digest(a[800:])]
+    assert rs._combine_digests(parts) == whole
+    assert rs._combine_digests(list(reversed(parts))) == whole
+    b = a.copy()
+    b[500] = np.nextafter(b[500], 2.0, dtype=np.float32)
+    assert rs.bitsum_digest(b) != whole
+
+
+# ---------------------------------------------------------------------------
+# EF fold rule
+
+
+def test_ef_fold_conserves_residual_on_shrink():
+    rows = np.arange(4 * 12, dtype=np.float32).reshape(4, 12)
+    folded = rs.reshard_ef_rows(rows, elems=10, n_new=2)
+    assert folded.shape == (2, 10)
+    assert folded.dtype == np.float32
+    np.testing.assert_array_equal(folded[0], rows[0, :10] + rows[2, :10])
+    np.testing.assert_array_equal(folded[1], rows[1, :10] + rows[3, :10])
+    # total residual conserved (integer-valued → exact)
+    assert folded.sum() == rows[:, :10].sum()
+
+
+def test_ef_fold_zeroes_joiners_on_grow():
+    rows = np.arange(2 * 10, dtype=np.float32).reshape(2, 10)
+    grown = rs.reshard_ef_rows(rows, elems=10, n_new=4)
+    np.testing.assert_array_equal(grown[0, :10], rows[0])
+    np.testing.assert_array_equal(grown[1, :10], rows[1])
+    assert not grown[2:].any()
+
+
+def test_replicated_divergence_raises():
+    rows = np.array([3, 3, 4], dtype=np.int32)
+    with pytest.raises(ReshardError, match="replicated"):
+        rs.reshard_replicated_rows(rows, 2)
+    np.testing.assert_array_equal(
+        rs.reshard_replicated_rows(np.array([5, 5]), 3),
+        np.array([5, 5, 5]))
+
+
+# ---------------------------------------------------------------------------
+# scenario (c): local restack of a full compat optimizer state
+
+
+def _synthetic_state(n, group_elems=(10, 7), ef_gen=0):
+    """Hand-built compat DistributedOptState: adam-ish per-element
+    leaves + one replicated scalar per group, masters on group 0, EF on
+    group 0 only.  Integer-valued floats keep every fold exact."""
+    rng = np.random.RandomState(42 + n)
+
+    def _rows(lo, hi, elems, s):
+        # real init pads the flat buffer with zeros — mirror that, or
+        # a restack round trip would "lose" the garbage padding
+        a = rng.randint(lo, hi, size=(n * s,)).astype(np.float32)
+        a[elems:] = 0
+        return a.reshape(n, s)
+
+    slots, accum, ef = [], [], []
+    for gi, elems in enumerate(group_elems):
+        s = rs._shard_sz(elems, n)
+        mu = _rows(-50, 50, elems, s)
+        nu = _rows(0, 50, elems, s)
+        count = np.full((n,), 17, np.int32)
+        master = _rows(-50, 50, elems, s) if gi == 0 else None
+        slots.append(_ShardSlot({"mu": mu, "nu": nu, "count": count},
+                                master))
+        accum.append(_rows(-9, 9, elems, s))
+        if gi == 0:
+            w = elems + (-elems) % n
+            e = np.zeros((n, w), np.float32)
+            e[:, :elems] = rng.randint(-5, 5, size=(n, elems))
+            ef.append(e)
+        else:
+            ef.append(None)
+    return DistributedOptState(
+        tuple(slots), _ZeroAccum(tuple(accum)), np.asarray(3),
+        None, _WireEF(tuple(ef), np.asarray(ef_gen, np.int32)))
+
+
+def _assert_state_bitwise(a, b):
+    import jax
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype and x.shape == y.shape
+        assert x.tobytes() == y.tobytes()
+
+
+@pytest.mark.parametrize("n_old,n_new", [(4, 2), (2, 4), (4, 1), (1, 3)])
+def test_reshard_opt_state_geometry(n_old, n_new):
+    ge = (10, 7)
+    st = _synthetic_state(n_old, ge)
+    out = rs.reshard_opt_state(st, ge, n_new)
+    for gi, elems in enumerate(ge):
+        s = rs._shard_sz(elems, n_new)
+        assert np.asarray(out.inner[gi].state["mu"]).shape == (n_new, s)
+        assert np.asarray(out.inner[gi].state["count"]).shape == (n_new,)
+        # shard rows concat back to the same logical buffer
+        np.testing.assert_array_equal(
+            np.asarray(out.inner[gi].state["mu"]).reshape(-1)[:elems],
+            np.asarray(st.inner[gi].state["mu"]).reshape(-1)[:elems])
+    assert np.asarray(out.wire_ef.rows[0]).shape[0] == n_new
+    assert out.wire_ef.rows[1] is None
+
+
+def test_shard_rows_roundtrip_bitwise():
+    st = _synthetic_state(4)
+    back = rs.reshard_opt_state(rs.reshard_opt_state(st, (10, 7), 1),
+                                (10, 7), 4)
+    # EF fold is deliberately lossy across a round trip (residual is
+    # merged); everything else must round-trip bitwise.
+    _assert_state_bitwise(back._replace(wire_ef=None),
+                          st._replace(wire_ef=None))
+
+
+def test_live_reshard_matches_local_restack_bitwise():
+    """The scenario-(a) equivalence at the heart of the PR: moving an
+    optimizer state through the chunked transport must equal the
+    scenario-(c) local restack bit for bit — including the EF fold."""
+    ge = (10, 7)
+    n_old, n_new = 2, 1
+    st = _synthetic_state(n_old, ge)
+    expected = rs.reshard_opt_state(st, ge, n_new)
+
+    t = rs.LocalTransport()
+    per_old = [rs.opt_state_streams(st, ge, n_old, r)
+               for r in range(n_old)]
+    specs = per_old[0][0]
+    for r in range(n_old):
+        rs.reshard_streams(specs, per_old[r][1], n_old, n_new, r, None,
+                           t, chunk_bytes=32)
+    streams, _ = rs.reshard_streams(specs, None, n_old, n_new, None, 0,
+                                    t, chunk_bytes=32, timeout=5.0)
+    got = rs.streams_to_opt_state(st, streams, ge, n_new, 0)
+    _assert_state_bitwise(got, expected)
+
+
+def test_merge_rank_streams_grow_matches_restack():
+    ge = (10, 7)
+    st = _synthetic_state(1, ge)
+    expected = rs.reshard_opt_state(st, ge, 2)
+
+    t = rs.LocalTransport()
+    specs, data = rs.opt_state_streams(st, ge, 1, 0)
+    rs.reshard_streams(specs, data, 1, 2, 0, None, t, chunk_bytes=32)
+    per_new, _ = _fetch_all(specs, 1, 2, t, chunk_bytes=32,
+                            timeout=5.0)
+    merged = rs.merge_rank_streams(specs, per_new, 2)
+    got = rs.compat_opt_state_from_streams(st, merged, ge, 2)
+    _assert_state_bitwise(got, expected)
+
+
+def test_plan_meta_roundtrip():
+    specs = [rs.StreamSpec("p0", 10, "float32", "shard"),
+             rs.StreamSpec("e0", 10, "float32", "perrank"),
+             rs.StreamSpec("o0.2", 1, "int32", "replicated")]
+    back, n_old = rs.plan_meta_parse(rs.plan_meta_json(specs, 3))
+    assert back == specs and n_old == 3
+
+
+# ---------------------------------------------------------------------------
+# wire payload encoding
+
+
+def test_host_wire_exact_and_cast():
+    from horovod_tpu.ops import wire
+    x = np.arange(9, dtype=np.float32) / 3
+    for w in (None, "none"):
+        out = wire.host_decode(wire.host_encode(x, w), np.float32, w)
+        assert out.tobytes() == x.tobytes()
+    out = wire.host_decode(wire.host_encode(x, "fp16"), np.float32,
+                           "fp16")
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, x, rtol=1e-3)
+    with pytest.raises(HorovodTpuError, match="cooperative"):
+        wire.host_encode(x, "int8")
+
+
+# ---------------------------------------------------------------------------
+# zero3 regroup + scenario (b) decode handoff
+
+
+def test_zero3_regroup_geometry():
+    import jax.numpy as jnp
+
+    from horovod_tpu.parallel.zero3 import zero3_placement
+    params = {"w": jnp.zeros((6, 4), jnp.float32),
+              "b": jnp.zeros((5,), jnp.float32)}
+    pl = zero3_placement(params)
+    re2 = pl.regroup(2)
+    assert re2.n == 2
+    assert re2.group_elems == pl.group_elems
+    assert tuple(g.idxs for g in re2.groups) == \
+        tuple(g.idxs for g in pl.groups)
+    for g in re2.groups:
+        assert g.shard_sz * 2 >= sum(g.sizes)
+
+
+def test_decode_handoff_slices_bitwise():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.serve.handoff import (
+        fetch_decode_params, handoff_meta, publish_for_serve,
+    )
+    rng = np.random.RandomState(11)
+    params = {
+        "emb": jnp.asarray(rng.uniform(size=(5, 4)), jnp.float32),
+        "wi": jnp.asarray(rng.uniform(size=(4, 6)), jnp.float32),
+        "wo": jnp.asarray(rng.uniform(size=(6, 4)), jnp.float32),
+    }
+    pspecs = {"emb": P(), "wi": P(None, "tp"), "wo": P("tp", None)}
+    leaf_meta, groups = handoff_meta(params, pspecs)
+
+    # build the zero3 rows the trainer would hold (n_old = 2)
+    leaves = jax.tree_util.tree_leaves(params)
+    n_old = 2
+    rows, ge = [], []
+    for idxs, sizes in groups:
+        flat = np.concatenate(
+            [np.asarray(leaves[i]).reshape(-1) for i in idxs])
+        ge.append(flat.size)
+        s = rs._shard_sz(flat.size, n_old)
+        rows.append(np.pad(flat, (0, n_old * s - flat.size))
+                    .reshape(n_old, s))
+    ge = tuple(ge)
+
+    t = rs.LocalTransport()
+    for r in range(n_old):
+        publish_for_serve(rows, ge, n_old, r, t, tag="serve",
+                          chunk_bytes=24)
+    tp = 2
+    for j in range(tp):
+        got = fetch_decode_params(params, pspecs, t, tag="serve",
+                                  tp=tp, tp_rank=j, chunk_bytes=24,
+                                  timeout=5.0)
+        exp = {
+            "emb": np.asarray(params["emb"]),
+            "wi": np.asarray(params["wi"])[:, j * 3:(j + 1) * 3],
+            "wo": np.asarray(params["wo"])[j * 3:(j + 1) * 3, :],
+        }
+        for k in exp:
+            assert np.asarray(got[k]).tobytes() == exp[k].tobytes(), k
+
+
+def test_handoff_drift_raises():
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.serve.handoff import fetch_decode_params
+    params = {"w": jnp.zeros((4, 4), jnp.float32)}
+    t = rs.LocalTransport()
+    t.put("serve/meta", rs.plan_meta_json(
+        [rs.StreamSpec("p0", 999, "float32", "shard")], 2))
+    with pytest.raises(HorovodTpuError, match="drift"):
+        fetch_decode_params(params, {"w": P(None, "tp")}, t,
+                            tag="serve", tp=2, tp_rank=0, timeout=2.0)
