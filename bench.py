@@ -517,6 +517,135 @@ def reshard_report(timeout: float = 600.0) -> dict:
     return rec
 
 
+def run_chaos_child() -> None:
+    """Runner-launched rank of the chaos bench: one fault-loaded
+    `ChaosSoak` (horovod_tpu/faults/chaos.py, docs/CHAOS.md) per rank,
+    result JSON written to $HVD_CHAOS_OUT/rank{r}.json."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import horovod_tpu as hvd
+    from horovod_tpu.faults.chaos import ChaosSoak
+
+    hvd.init()
+    res = ChaosSoak(
+        seed=int(os.environ.get("HVD_CHAOS_SEED", "7"))).run()
+    with open(os.path.join(os.environ["HVD_CHAOS_OUT"],
+                           f"rank{hvd.rank()}.json"), "w") as f:
+        json.dump(res, f)
+    hvd.shutdown()
+
+
+def _pctl(xs, q):
+    """Nearest-rank percentile of a sorted list."""
+    if not xs:
+        return None
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+
+def chaos_report(timeout: float = 600.0) -> dict:
+    """Chaos extra: MTTR percentiles + steps-lost-per-injection from a
+    real np>=2 fault-loaded soak (HOROVOD_BENCH_CHAOS_NP, default 2)."""
+    np_ = int(os.environ.get("HOROVOD_BENCH_CHAOS_NP", "2"))
+    out = tempfile.mkdtemp(prefix="bench_chaos_")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HVD_CHAOS_OUT"] = out
+    env.setdefault("HOROVOD_CHAOS_GENERATIONS", "6")
+    env.setdefault("HOROVOD_CHAOS_STEPS_PER_GEN", "5")
+    env.setdefault("HOROVOD_AUTOTUNE", "1")
+    env.setdefault("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "1")
+    env.setdefault("HOROVOD_TIMELINE", os.path.join(out, "tl.json"))
+    env.setdefault("HOROVOD_TIMELINE_ALL_RANKS", "1")
+    env.setdefault("HOROVOD_TIMELINE_MARK_CYCLES", "1")
+    env.setdefault("HOROVOD_TIMELINE_DISABLE_NATIVE", "1")
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+         sys.executable, os.path.abspath(__file__), "--chaos-child"],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    if r.returncode != 0:
+        log(f"chaos fleet rc={r.returncode} "
+            f"stderr tail: {r.stderr[-1500:]}")
+        return {}
+    with open(os.path.join(out, "rank0.json")) as f:
+        res = json.load(f)
+    events = res["events"]
+    mttr = sorted(float(e["mttr_ms"]) for e in events
+                  if e["outcome"] == "recovered")
+    lost = [int(e["steps_lost"]) for e in events]
+    bests = [w["autotune_best"] for w in res["windows"]
+             if w.get("autotune_best") is not None]
+    return {
+        "np": np_,
+        "generations": len(res["windows"]),
+        "events": len(events),
+        "kinds": sorted(res["kinds_injected"]),
+        "recovered": sum(1 for e in events
+                         if e["outcome"] == "recovered"),
+        "degraded": sum(1 for e in events if e["outcome"] == "degraded"),
+        "mttr_p50_ms": round(_pctl(mttr, 0.50), 2) if mttr else None,
+        "mttr_p99_ms": round(_pctl(mttr, 0.99), 2) if mttr else None,
+        "steps_lost_total": sum(lost),
+        "steps_lost_per_injection": (round(sum(lost) / len(lost), 3)
+                                     if lost else 0.0),
+        "loud_reinits": res["loud_reinits"],
+        "reactions": res["reactions"],
+        "autotune_best_final": bests[-1] if bests else None,
+        "split_brain": res["split_brain"],
+        "final_digest_mismatch": res["final_digest_mismatch"],
+    }
+
+
+def main_chaos():
+    """`bench.py --chaos`: run the chaos extra standalone and append the
+    record to BENCH_chaos.json (JSON lines, same provenance stamps and
+    HOROVOD_BENCH_CACHE_MAX_AGE_H stale gate as BENCH_serve.json —
+    duplicated here because the bench parent never imports the
+    package)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(repo, "BENCH_chaos.json")
+    prev = None
+    if os.path.exists(path):
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        if lines:
+            prev = json.loads(lines[-1])
+            age_h = (time.time()
+                     - prev.get("captured_unix", 0.0)) / 3600.0
+            prev["stale"] = age_h > CACHE_MAX_AGE_H
+            if prev["stale"]:
+                log(f"previous chaos record is {age_h:.1f}h old "
+                    f"(> {CACHE_MAX_AGE_H:g}h gate) — not comparing")
+    try:
+        rec = chaos_report()
+    except Exception as e:  # noqa: BLE001
+        log(f"chaos bench failed: {type(e).__name__}: {e}")
+        rec = {}
+    if not rec:
+        emit({"bench": "chaos", "error": "chaos soak failed; see stderr"})
+        sys.exit(1)
+    rec = {"bench": "chaos", **rec}
+    if (prev is not None and not prev.get("stale")
+            and prev.get("bench") == "chaos"
+            and prev.get("mttr_p50_ms") and rec.get("mttr_p50_ms")):
+        rec["mttr_p50_vs_prev"] = round(
+            rec["mttr_p50_ms"] / prev["mttr_p50_ms"], 3)
+    now = time.time()
+    rec["captured_unix"] = now
+    rec["captured_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime(now))
+    with open(path, "a") as f:
+        f.write(json.dumps(rec, sort_keys=True) + "\n")
+    log(f"chaos np={rec['np']}: {rec['events']} events "
+        f"({rec['recovered']} recovered / {rec['degraded']} degraded), "
+        f"MTTR p50/p99 {rec['mttr_p50_ms']}/{rec['mttr_p99_ms']} ms, "
+        f"{rec['steps_lost_per_injection']} steps lost/injection, "
+        f"{len(rec['kinds'])} fault kinds")
+    emit(rec)
+
+
 def _load_trace_core():
     """The fleet tracer's analyzer (horovod_tpu/trace/core.py), loaded
     by file path so the bench parent never imports the package (and so
@@ -1221,6 +1350,10 @@ if __name__ == "__main__":
         run_zero_bytes_child(int(sys.argv[2]))
     elif len(sys.argv) >= 2 and sys.argv[1] == "--reshard-child":
         run_reshard_child()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--chaos-child":
+        run_chaos_child()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--chaos":
+        main_chaos()
     elif len(sys.argv) >= 3 and sys.argv[1] == "--bench-child":
         emit(run_bench(sys.argv[2]))
     else:

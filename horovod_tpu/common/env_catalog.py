@@ -146,6 +146,12 @@ CATALOG: Tuple[EnvVar, ...] = (
     _v("HOROVOD_FAULT_HOSTS", "(all)", "faults",
        "Comma-separated hosts the fault spec applies to.",
        "FAULT_TOLERANCE.md"),
+    _v("HOROVOD_CHAOS_GENERATIONS", "8", "faults",
+       "Analysis-window generations one chaos soak runs "
+       "(faults/chaos.py; each generation ends in a merged-trace "
+       "window + digest check).", "CHAOS.md"),
+    _v("HOROVOD_CHAOS_STEPS_PER_GEN", "6", "faults",
+       "Training steps per chaos-soak generation.", "CHAOS.md"),
     _v("HOROVOD_RETRY_MAX_ATTEMPTS", "5", "faults",
        "Attempts for the shared RetryPolicy (global default; "
        "`HOROVOD_<SITE>_RETRY_MAX_ATTEMPTS` overrides per site, e.g. "
@@ -218,6 +224,18 @@ CATALOG: Tuple[EnvVar, ...] = (
     _v("HOROVOD_TRACE_FLOW_EVENTS", "1", "trace",
        "1 links the same collective across ranks with Chrome flow "
        "events (s/t/f) in the merged fleet trace.", "TRACE.md"),
+    _v("HOROVOD_STRAGGLER_PATIENCE", "3", "trace",
+       "Consecutive analysis windows one rank must be blamed before "
+       "the straggler reaction policy acts (trace/reaction.py).",
+       "CHAOS.md"),
+    _v("HOROVOD_STRAGGLER_SKEW_THRESHOLD", "0.75", "trace",
+       "Skew share (straggler wait / critical path) at or above which "
+       "the reaction escalates straight to graceful degradation "
+       "instead of a bucket rebalance.", "CHAOS.md"),
+    _v("HOROVOD_STRAGGLER_COOLDOWN", "2", "trace",
+       "Analysis windows the reaction policy sleeps after firing, so "
+       "post-reaction windows measure the settled fleet before a new "
+       "blame streak can build.", "CHAOS.md"),
 
     # -- autotune / gradient pipeline -----------------------------------
     _v("HOROVOD_AUTOTUNE", "0", "autotune",
@@ -377,6 +395,10 @@ CATALOG: Tuple[EnvVar, ...] = (
        "Hours before bench.py's cached last-known-good on-chip record "
        "is reported as stale instead of silently reused.",
        "BENCHMARKS.md"),
+    _v("HOROVOD_BENCH_CHAOS_NP", "2", "bench",
+       "Fleet size of the `bench.py --chaos` fault-loaded soak "
+       "(BENCH_chaos.json MTTR record).",
+       "CHAOS.md"),
     _v("HOROVOD_SERVE_PAGE_TOKENS", "16", "serve",
        "KV-cache pool page size in tokens (autotuner knob "
        "serve_page_tokens; compiled-shape key of the serving step).",
@@ -413,10 +435,12 @@ CATALOG: Tuple[EnvVar, ...] = (
        "serve_flightrec_depth, host_only: never part of the "
        "program-cache key); <= 0 disables the recorder.",
        "SERVING.md"),
-    _v("HOROVOD_SERVE_FLIGHTREC_DIR", ".", "serve",
+    _v("HOROVOD_SERVE_FLIGHTREC_DIR", "$TMPDIR/horovod_flightrec", "serve",
        "Directory flight-recorder dumps are written to on a trigger "
        "(crash, pool exhaustion, SLO breach, guard escalation, "
-       "injected replica death).",
+       "injected replica death).  Defaults under the system temp dir "
+       "so crash dumps never land in (and get committed from) the "
+       "working tree.",
        "SERVING.md"),
     _v("HOROVOD_RESHARD_PEAK_BYTES", "67108864", "reshard",
        "Per-host staging ceiling of a live reshard in bytes; chunks "

@@ -109,6 +109,17 @@ CATALOG = {
         "corruption after the sha256 is computed (like the guard "
         "points) — the receiver must detect the mismatch and raise "
         "ReshardError, never assemble corrupt state.",
+    # chaos soak (faults/chaos.py; see docs/CHAOS.md)
+    "chaos.step":
+        "Top of one chaos-soak training step, fired by the soak loop "
+        "itself: delay = a worker stall the peers must ride out, err = "
+        "an injected step failure routed into the recovery path.",
+    "chaos.straggler_delay":
+        "Per eager collective dispatch (ops/collectives.py bracket) "
+        "while armed: delay injects a per-rank, per-bucket slowdown — "
+        "the straggler signature the trace reaction policy must blame "
+        "and rebalance away from; err raises HorovodInternalError like "
+        "the collective.* points.",
 }
 
 _lock = threading.Lock()

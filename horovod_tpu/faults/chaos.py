@@ -1,0 +1,645 @@
+"""Chaos soak: fault-loaded fleet training with closed-loop reaction.
+
+ROADMAP item 5 end to end (docs/CHAOS.md): one `ChaosSoak` runs a real
+np=N gloo training fleet for `HOROVOD_CHAOS_GENERATIONS` generations
+while a seeded plan fires a rotating mix of injections across ranks —
+
+  straggler_delay        per-collective slowdown on one rank (armed for
+                         a whole block of generations; the trace
+                         reaction policy must blame it and rebalance)
+  worker_stall           one rank sleeps at the top of a step; the
+                         peers must ride it out inside the collective
+  nan_grad               one rank's batch is poisoned; the guard
+                         sentinel must skip the step on ALL ranks
+  param_bitflip          one rank's replica silently diverges; the
+                         digest check must catch it and every rank
+                         restores the committed snapshot
+  collective_abort       the next allreduce raises
+                         HorovodInternalError on every rank in
+                         lockstep; all restore the committed snapshot
+  reshard_chunk_corrupt  a live-reshard drill publishes corrupted
+                         chunks; every rank must fail into the
+                         local-copy fallback (never assemble them)
+  reshard_peer_die       a reshard peer abandons mid-publish; same
+                         deterministic all-rank fallback
+
+After every event the soak verifies re-convergence — cross-replica
+param digests clean (or a deliberate committed-snapshot restore) and a
+split-brain check that all ranks agree on (generation, step, digest) —
+and records the measured MTTR into `hvd_recovery_ms{kind}` /
+`hvd_chaos_events_total{kind,outcome}`.
+
+Each generation ends in an ONLINE analysis window: every rank re-reads
+its own (partial) timeline, the fleet allgathers the window's events,
+`trace.core.analyze` attributes the critical path identically
+everywhere, and the measurements feed (a) the metrics surface, (b)
+`ParameterManager.record_trace` — the autotuner searching its knobs
+live while faults fire — and (c) the `StragglerReactionPolicy`, whose
+rebalance deliberately trips the fused optimizer's LOUD re-init
+ValueError on the next update (the soak re-inits and counts it).
+
+The training loop is EAGER on purpose: per-bucket collectives dispatch
+through the `_traced` bracket, so the timeline carries real bucket
+spans and `chaos.straggler_delay` lands per bucket — the signature the
+reaction removes by collapsing the partition to one bucket.
+
+Everything is deterministic from (seed, np): all ranks compute the
+identical plan, so collective injections stay in lockstep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import FaultInjected
+from .. import faults as _faults
+from ..common import util
+from ..common.exceptions import HorovodInternalError
+
+logger = logging.getLogger("horovod_tpu.faults.chaos")
+
+__all__ = ["KINDS", "ChaosEvent", "ChaosInjection", "ChaosSoak",
+           "build_plan"]
+
+#: Every fault kind the soak can inject, in rotation order.
+KINDS = (
+    "straggler_delay",
+    "worker_stall",
+    "nan_grad",
+    "param_bitflip",
+    "collective_abort",
+    "reshard_chunk_corrupt",
+    "reshard_peer_die",
+)
+_ROTATION = tuple(k for k in KINDS if k != "straggler_delay")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosInjection:
+    """One planned injection: fire `kind` at (generation, step) against
+    `target` (-1 = every rank, for lockstep collective aborts)."""
+    gen: int
+    step: int
+    kind: str
+    target: int
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    """One injection's measured outcome."""
+    kind: str
+    gen: int
+    step: int
+    target: int
+    outcome: str        # "recovered" | "degraded" | "skipped"
+    mttr_ms: float
+    steps_lost: int = 0
+    detail: str = ""
+
+
+def build_plan(generations: int, steps_per_gen: int, n: int,
+               seed: int = 0, straggler_gens: int = 0,
+               kinds=_ROTATION) -> List[ChaosInjection]:
+    """The deterministic soak plan.  The straggler block occupies the
+    FIRST `straggler_gens` generations exclusively (its delay is armed
+    continuously, so sharing those generations with one-shot events
+    would clobber the armed schedule); the remaining generations cycle
+    through `kinds`, two injections per generation at early/mid steps,
+    targets drawn from a seeded RNG.  Every rank builds the identical
+    plan from (seed, n) alone."""
+    rng = random.Random(f"chaos:{seed}:{n}")
+    plan: List[ChaosInjection] = []
+    straggler_gens = min(straggler_gens, generations)
+    if straggler_gens > 0 and n > 1:
+        target = rng.randrange(n)
+        plan.append(ChaosInjection(0, 0, "straggler_delay", target))
+    slots = [1] if steps_per_gen < 4 else [1, steps_per_gen - 2]
+    ki = 0
+    for g in range(straggler_gens, generations):
+        for s in slots:
+            if ki >= len(kinds) * 2:
+                break  # one full rotation is plenty; tail gens stay clean
+            kind = kinds[ki % len(kinds)]
+            ki += 1
+            target = -1 if kind == "collective_abort" else rng.randrange(n)
+            plan.append(ChaosInjection(g, s, kind, target))
+    return plan
+
+
+def _snap(tree):
+    """Host-side deep copy of a pytree (the committed snapshot)."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda x: np.array(x) if hasattr(x, "shape") else x, tree)
+
+
+def _thaw(tree):
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, tree)
+
+
+def _host(tree):
+    """Host-normalize a pytree: eager gloo collectives hand back
+    process-spanning global arrays under multi-process jax.distributed;
+    re-staging one the next step trips device_put's fully-addressable
+    check, so every step's outputs come back through numpy first (same
+    contract as the guard/trace worker mains)."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, tree)
+
+
+class ChaosSoak:
+    """One fault-loaded training soak (see module docstring).
+
+    Construct on every rank of an initialized fleet, then `run()`; the
+    returned dict is JSON-serializable (tests/data/chaos_main.py writes
+    it per rank, bench.py --chaos aggregates MTTR percentiles).
+    """
+
+    def __init__(
+        self,
+        generations: Optional[int] = None,
+        steps_per_gen: Optional[int] = None,
+        seed: int = 0,
+        straggler_gens: Optional[int] = None,
+        straggler_delay_ms: int = 20,
+        stall_ms: int = 250,
+        dim: int = 64,
+        n_leaves: int = 8,
+        local_batch: int = 4,
+        lr: float = 0.05,
+        fusion_threshold_bytes: int = 512,
+        reshard_timeout: float = 8.0,
+        kinds=_ROTATION,
+    ):
+        self.generations = (util.env_int("CHAOS_GENERATIONS", 8)
+                            if generations is None else int(generations))
+        self.steps_per_gen = (util.env_int("CHAOS_STEPS_PER_GEN", 6)
+                              if steps_per_gen is None
+                              else int(steps_per_gen))
+        if self.steps_per_gen < 2:
+            raise ValueError("chaos soak needs >= 2 steps per generation")
+        self.seed = int(seed)
+        self.straggler_gens = straggler_gens
+        self.straggler_delay_ms = int(straggler_delay_ms)
+        self.stall_ms = int(stall_ms)
+        self.dim = int(dim)
+        self.n_leaves = int(n_leaves)
+        self.local_batch = int(local_batch)
+        self.lr = float(lr)
+        self.fusion_threshold_bytes = int(fusion_threshold_bytes)
+        self.reshard_timeout = float(reshard_timeout)
+        self.kinds = tuple(kinds)
+        self.events: List[ChaosEvent] = []
+        self.windows: List[dict] = []
+        self.reactions: List[dict] = []
+        self.loud_reinits = 0
+        self._drill_seq = 0
+
+    # -- bookkeeping -----------------------------------------------------
+    def _record(self, kind: str, gen: int, step: int, target: int,
+                outcome: str, t0: float, steps_lost: int = 0,
+                detail: str = "") -> ChaosEvent:
+        from ..metrics import catalog as _met
+        mttr = (time.perf_counter() - t0) * 1e3
+        ev = ChaosEvent(kind=kind, gen=gen, step=step, target=target,
+                        outcome=outcome, mttr_ms=round(mttr, 3),
+                        steps_lost=steps_lost, detail=detail)
+        self.events.append(ev)
+        if _met.enabled():
+            _met.chaos_events.labels(kind, outcome).inc()
+            _met.recovery_ms.labels(kind).set(ev.mttr_ms)
+        logger.warning("chaos event %s g%d s%d target=%d -> %s "
+                       "(MTTR %.1f ms, %d steps lost) %s",
+                       kind, gen, step, target, outcome, ev.mttr_ms,
+                       steps_lost, detail)
+        return ev
+
+    # -- recovery verification -------------------------------------------
+    def _digest_mismatch(self, w) -> Optional[int]:
+        from ..guard import digest as _gdigest
+        return _gdigest.check_replica_divergence(
+            _gdigest.param_digests(list(w.values())))
+
+    def _digest_head(self, w) -> str:
+        from ..guard import digest as _gdigest
+        return str(_gdigest.param_digests(list(w.values()))[0])[:16]
+
+    # -- timeline window -------------------------------------------------
+    @staticmethod
+    def _timeline_path(rank: int) -> Optional[str]:
+        import os
+        base = util.getenv("TIMELINE")
+        if not base:
+            return None
+        if rank != 0 and util.env_bool("TIMELINE_ALL_RANKS", False):
+            stem, ext = os.path.splitext(base)
+            return f"{stem}.rank{rank}{ext or '.json'}"
+        return base if rank == 0 else None
+
+    @staticmethod
+    def _window_events(events: List[dict], lo: int, hi: int) -> List[dict]:
+        """The window's slice of one rank's timeline: CYCLE instants
+        lo-1..hi (step n's critical path needs the n-1 boundary) and
+        collective spans whose issue-step stamp lands in the window
+        (the stamp is the completed-cycle count, so steps lo..hi carry
+        stamps lo-1..hi-1)."""
+        out = []
+        for ev in events:
+            name = str(ev.get("name", ""))
+            if ev.get("ph") == "i" and name.startswith("CYCLE_"):
+                try:
+                    c = int(name[6:])
+                except ValueError:
+                    continue
+                if lo - 1 <= c <= hi:
+                    out.append(ev)
+            elif ev.get("ph") == "X" and ev.get("cat") == "collective":
+                st = ev.get("step")
+                if st is not None and lo - 1 <= int(st) <= hi - 1:
+                    out.append(ev)
+        return out
+
+    def _analyze_window(self, rank: int, n: int, lo: int, hi: int):
+        """Merged-trace analysis of global steps [lo, hi] — identical
+        on every rank (same allgathered events, same float math), so
+        the downstream reaction + autotune decisions stay in lockstep."""
+        from ..ops import functions as F
+        from ..trace import core as _tcore
+        from ..trace.measure import TraceMeasurements
+        path = self._timeline_path(rank)
+        if path is None:
+            return None
+        time.sleep(0.05)  # let the writer thread drain its queue
+        try:
+            mine = self._window_events(_tcore.load_events(path), lo, hi)
+        except (OSError, ValueError):
+            mine = []
+        per_rank = F.allgather_object(mine)
+        traces = {r: evs for r, evs in enumerate(per_rank)}
+        report = _tcore.analyze(traces)
+        return TraceMeasurements.from_report(report)
+
+    # -- reshard drill ---------------------------------------------------
+    def _reshard_drill(self, inj: ChaosInjection, rank: int, n: int,
+                       w: Dict[str, Any]) -> None:
+        """Same-N identity reshard of the flat param vector through the
+        rendezvous KV transport while `reshard.chunk_corrupt` /
+        `reshard.peer_die` is armed on the target: every rank must fail
+        DETERMINISTICALLY into the local-copy fallback (params are
+        replicated — the local copy IS the checkpoint), then
+        digest-verify the fleet."""
+        from ..parallel import reshard as rs
+        t0 = time.perf_counter()
+        transport = rs.KVTransport.from_env(
+            f"chaos{self._drill_seq}")
+        self._drill_seq += 1
+        if transport is None or n < 2:
+            self._record(inj.kind, inj.gen, inj.step, inj.target,
+                         "skipped", t0, detail="no KV transport")
+            return
+        flat = np.concatenate(
+            [np.asarray(v, np.float32).ravel() for v in w.values()])
+        spec = rs.StreamSpec("chaosw", int(flat.size), "float32", "shard")
+        lo, hi = rs._owned_range(flat.size, n, rank)
+        local = {"chaosw": flat[lo:hi].copy()}
+        point = ("reshard.chunk_corrupt"
+                 if inj.kind == "reshard_chunk_corrupt"
+                 else "reshard.peer_die")
+        if rank == inj.target:
+            _faults.install(f"{point}:err", seed=self.seed)
+        degraded = False
+        detail = ""
+        try:
+            out, _ = rs.reshard_streams(
+                [spec], local, n, n, rank, rank, transport,
+                tag=f"drill{self._drill_seq}", chunk_bytes=256,
+                timeout=self.reshard_timeout)
+            # Uninjected success would mean the armed fault never fired
+            # — still verify the payload round-tripped bitwise.
+            ok = np.array_equal(out["chaosw"], local["chaosw"])
+            detail = f"reshard completed (bitwise={ok})"
+        except (rs.ReshardError, FaultInjected) as e:
+            degraded = True
+            detail = f"{type(e).__name__}: fell back to local copy"
+        finally:
+            if rank == inj.target:
+                _faults.clear()
+        # The fallback: params were never touched (the drill moved a
+        # copy), so "restore" is the local replica itself.  Verify the
+        # fleet is still digest-clean and agrees the drill degraded.
+        from ..ops import functions as F
+        verdicts = F.allgather_object(degraded)
+        mism = self._digest_mismatch(w)
+        if all(verdicts) and mism is None:
+            self._record(inj.kind, inj.gen, inj.step, inj.target,
+                         "recovered", t0, detail=detail)
+        else:
+            self._record(inj.kind, inj.gen, inj.step, inj.target,
+                         "degraded", t0,
+                         detail=f"{detail}; verdicts={verdicts} "
+                                f"mismatch={mism}")
+
+    # -- the soak --------------------------------------------------------
+    def run(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        import horovod_tpu as hvd
+        from ..metrics import catalog as _met
+        from ..ops import functions as F
+        from ..trace.reaction import StragglerReactionPolicy
+        from ..utils import autotune as _at
+        from ..utils import timeline as _tl
+
+        if not hvd.is_initialized():
+            hvd.init()
+        rank, n = hvd.rank(), hvd.size()
+        policy = StragglerReactionPolicy()
+        straggler_gens = self.straggler_gens
+        if straggler_gens is None:
+            # Long enough to build the blame streak, react, cool down,
+            # and measure at least one settled post-reaction window.
+            straggler_gens = min(self.generations - 1,
+                                 policy.patience + policy.cooldown + 1)
+        plan = build_plan(self.generations, self.steps_per_gen, n,
+                          seed=self.seed, straggler_gens=straggler_gens,
+                          kinds=self.kinds)
+        by_step: Dict[tuple, List[ChaosInjection]] = {}
+        straggler_target = -1
+        for inj in plan:
+            if inj.kind == "straggler_delay":
+                straggler_target = inj.target
+            else:
+                by_step.setdefault((inj.gen, inj.step), []).append(inj)
+
+        # -- model + optimizer + guard (eager update path) ---------------
+        keys = [f"p{i:02d}" for i in range(self.n_leaves)]
+        host = np.random.RandomState(0)
+        true_w = {k: host.uniform(-1, 1, (self.dim,)).astype(np.float32)
+                  for k in keys}
+        x_all = host.uniform(-1, 1, (n * self.local_batch,
+                                     self.dim)).astype(np.float32)
+        rows = slice(rank * self.local_batch, (rank + 1) * self.local_batch)
+        x_local = x_all[rows]
+        y_local = {k: (x_all @ true_w[k])[rows] for k in keys}
+
+        scaler = hvd.DynamicLossScale(init_scale=256.0,
+                                      growth_interval=100000)
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(self.lr), guard=scaler, fused_apply=True,
+            fusion_threshold_bytes=self.fusion_threshold_bytes)
+        guard = hvd.TrainingGuard(scaler=scaler, digest_interval=0,
+                                  max_nonfinite=100)
+        w = {k: jnp.zeros((self.dim,), jnp.float32) for k in keys}
+        opt_state = opt.init(w)
+
+        @jax.jit
+        def grads_fn(w, x, y, scale):
+            def loss(w):
+                return sum(jnp.mean((x @ w[k] - y[k]) ** 2)
+                           for k in keys) * scale
+            return jax.grad(loss)(w)
+
+        def update(grads, w, opt_state):
+            """Eager per-bucket reduce + fused apply; a partition change
+            (reaction rebalance or autotune proposal) trips the loud
+            re-init contract — re-init and retry, counting it."""
+            try:
+                updates, opt_state = opt.update(grads, opt_state, w)
+            except ValueError as e:
+                if "re-init the optimizer state" not in str(e):
+                    raise
+                self.loud_reinits += 1
+                logger.warning("loud re-init #%d: %s",
+                               self.loud_reinits, e)
+                opt_state = opt.init(w)
+                updates, opt_state = opt.update(grads, opt_state, w)
+            return optax.apply_updates(w, updates), opt_state
+
+        pm = _at.get_manager()
+        tl = _tl.get_timeline()
+        committed = (_snap(w), _snap(opt_state), 0)
+        pending_nan: Optional[dict] = None
+        pending_flip: Optional[dict] = None
+        split_brain = False
+        t = 0
+
+        for g in range(self.generations):
+            gen_lo = t + 1
+            straggling = (straggler_target >= 0 and g < straggler_gens)
+            if straggling and g == 0 and rank == straggler_target:
+                _faults.install(
+                    f"chaos.straggler_delay:delay:"
+                    f"{self.straggler_delay_ms}ms", seed=self.seed)
+            for s in range(self.steps_per_gen):
+                t += 1
+                if tl is not None:
+                    tl.mark_cycle()
+                injs = by_step.get((g, s), ())
+                stall = next((i for i in injs
+                              if i.kind == "worker_stall"), None)
+                stall_t0 = time.perf_counter()
+                if stall is not None and rank == stall.target:
+                    _faults.install(f"chaos.step:delay:{self.stall_ms}ms",
+                                    seed=self.seed)
+                if _faults.active():
+                    try:
+                        _faults.point("chaos.step")
+                    except FaultInjected:
+                        pass  # err-mode step failure: ride into recovery
+                if stall is not None and rank == stall.target:
+                    _faults.clear()
+
+                nan = next((i for i in injs if i.kind == "nan_grad"), None)
+                flip = next((i for i in injs
+                             if i.kind == "param_bitflip"), None)
+                armed_guard = False
+                if nan is not None and rank == nan.target:
+                    _faults.install("guard.nan_grad@1:err", seed=self.seed)
+                    armed_guard = True
+                if flip is not None and rank == flip.target:
+                    _faults.install("guard.param_bitflip@1:err",
+                                    seed=self.seed)
+                    armed_guard = True
+                batch = {"x": x_local, "y": y_local}
+                batch, w = guard.maybe_inject(batch, w)
+                if armed_guard:
+                    _faults.clear()
+                if nan is not None:
+                    pending_nan = {"inj": nan, "t0": time.perf_counter(),
+                                   "flagged": 0}
+                if flip is not None:
+                    pending_flip = {"inj": flip,
+                                    "t0": time.perf_counter()}
+
+                abort = next((i for i in injs
+                              if i.kind == "collective_abort"), None)
+                abort_t0 = time.perf_counter()
+                if abort is not None:
+                    _faults.install("collective.allreduce@1:err",
+                                    seed=self.seed)
+                scale = float(np.asarray(opt_state.guard.loss_scale))
+                grads = _host(grads_fn(w, batch["x"], batch["y"], scale))
+                try:
+                    w, opt_state = update(grads, w, opt_state)
+                    w, opt_state = _host(w), _host(opt_state)
+                    failed = False
+                except HorovodInternalError:
+                    failed = True
+                if abort is not None:
+                    _faults.clear()
+                    # Lockstep abort on every rank: restore the
+                    # committed snapshot fleet-wide and verify.
+                    w, opt_state = _thaw(committed[0]), _thaw(committed[1])
+                    mism = self._digest_mismatch(w)
+                    self._record(
+                        "collective_abort", g, s, -1,
+                        "recovered" if (failed and mism is None)
+                        else "degraded",
+                        abort_t0, steps_lost=t - committed[2],
+                        detail=f"raised={failed} mismatch={mism}")
+                elif failed:
+                    raise HorovodInternalError(
+                        "unplanned collective failure in chaos soak "
+                        f"at g{g} s{s}")
+
+                v = guard.observe(opt_state, w, t)
+                if stall is not None:
+                    # The step completed — the fleet rode out the stall
+                    # inside the first collective of the step.
+                    self._record("worker_stall", g, s, stall.target,
+                                 "recovered", stall_t0)
+                if pending_nan is not None:
+                    if v.flagged:
+                        pending_nan["flagged"] += 1
+                    elif pending_nan["flagged"] > 0:
+                        inj = pending_nan["inj"]
+                        self._record(
+                            "nan_grad", inj.gen, inj.step, inj.target,
+                            "recovered", pending_nan["t0"],
+                            steps_lost=pending_nan["flagged"],
+                            detail=f"loss scale {v.loss_scale:g} after "
+                                   "lockstep skip")
+                        pending_nan = None
+                    elif t - (pending_nan["inj"].gen
+                              * self.steps_per_gen) > 2 * self.steps_per_gen:
+                        inj = pending_nan["inj"]
+                        self._record("nan_grad", inj.gen, inj.step,
+                                     inj.target, "degraded",
+                                     pending_nan["t0"],
+                                     detail="sentinel never flagged")
+                        pending_nan = None
+                if pending_flip is not None:
+                    mism = self._digest_mismatch(w)
+                    if mism is not None:
+                        inj = pending_flip["inj"]
+                        w = _thaw(committed[0])
+                        opt_state = _thaw(committed[1])
+                        clean = self._digest_mismatch(w)
+                        self._record(
+                            "param_bitflip", inj.gen, inj.step,
+                            inj.target,
+                            "recovered" if clean is None else "degraded",
+                            pending_flip["t0"],
+                            steps_lost=t - committed[2],
+                            detail=f"digest bucket {mism}; restored "
+                                   f"committed step {committed[2]}")
+                        pending_flip = None
+
+                for inj in injs:
+                    if inj.kind in ("reshard_chunk_corrupt",
+                                    "reshard_peer_die"):
+                        self._reshard_drill(inj, rank, n, w)
+
+            # -- end of generation: window analysis + commit -------------
+            if (straggling and g == straggler_gens - 1
+                    and rank == straggler_target):
+                _faults.clear()
+            m = self._analyze_window(rank, n, gen_lo, t)
+            decision = policy.observe(m) if m is not None else None
+            if decision is not None and decision.fired:
+                self.reactions.append({
+                    "gen": g, "action": decision.action,
+                    "rank": decision.rank, "streak": decision.streak,
+                    "skew_share": decision.skew_share,
+                    "reason": decision.reason})
+            if m is not None and pm is not None:
+                m.apply_to_metrics()
+                m.feed_autotune(pm, items_per_step=self.local_batch * n)
+            elif m is not None:
+                m.apply_to_metrics()
+            best = samples = None
+            if pm is not None:
+                _, brate = pm._bo.best
+                best = None if brate == float("-inf") else round(brate, 3)
+                samples = len(pm._bo._ys)
+            self.windows.append({
+                "gen": g,
+                "steps": [gen_lo, t],
+                "straggler_armed": bool(straggling),
+                "skew_share": (round(m.skew_share, 4)
+                               if m is not None else None),
+                "wait_ms_per_step": (round(m.wait_ms_per_step, 3)
+                                     if m is not None else None),
+                "straggler_rank": (m.straggler_rank
+                                   if m is not None else None),
+                "critical_path_ms": (round(m.critical_path_ms, 3)
+                                     if m is not None else None),
+                "reaction": (decision.action
+                             if decision is not None else "none"),
+                "autotune_best": best,
+                "autotune_samples": samples,
+            })
+            if _met.enabled():
+                _met.chaos_generations.set(g + 1)
+
+            mism = self._digest_mismatch(w)
+            if mism is None:
+                committed = (_snap(w), _snap(opt_state), t)
+            else:
+                # A corruption slipped past per-step detection (e.g. a
+                # flip injected on the last step): restore loudly.
+                w, opt_state = _thaw(committed[0]), _thaw(committed[1])
+                self._record("param_bitflip", g, self.steps_per_gen - 1,
+                             -1, "recovered", time.perf_counter(),
+                             steps_lost=t - committed[2],
+                             detail=f"window digest bucket {mism}")
+            fleet = F.allgather_object((g, t, self._digest_head(w)))
+            if any(f != fleet[0] for f in fleet[1:]):
+                split_brain = True
+                logger.error("split brain at g%d: %s", g, fleet)
+
+        _faults.clear()
+        final_mism = self._digest_mismatch(w)
+        res = {
+            "rank": rank,
+            "np": n,
+            "generations": self.generations,
+            "steps_per_gen": self.steps_per_gen,
+            "total_steps": t,
+            "seed": self.seed,
+            "plan": [dataclasses.asdict(i) for i in plan],
+            "events": [dataclasses.asdict(e) for e in self.events],
+            "kinds_injected": sorted({e.kind for e in self.events}),
+            "windows": self.windows,
+            "reactions": self.reactions,
+            "loud_reinits": self.loud_reinits,
+            "split_brain": split_brain,
+            "final_digest_mismatch": final_mism,
+            "final_w": {k: np.asarray(v).tolist() for k, v in w.items()},
+            "straggler_target": straggler_target,
+            "straggler_gens": straggler_gens,
+            "autotune_enabled": pm is not None,
+        }
+        return res

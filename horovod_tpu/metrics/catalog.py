@@ -144,6 +144,33 @@ straggler_rank = _REG.gauge(
     "hvd_straggler_rank",
     "Rank most often last to arrive at the step barrier in the last "
     "trace analysis (-1 = none identified).")
+straggler_streak = _REG.gauge(
+    "hvd_straggler_streak",
+    "Consecutive analysis windows the current straggler has been "
+    "blamed (trace/reaction.py; resets on a different blame, a "
+    "reaction, or a generation change).")
+straggler_reactions = _REG.counter(
+    "hvd_straggler_reactions_total",
+    "Straggler reactions fired by the trace reaction policy.",
+    ("action",))
+reaction_max_buckets = _REG.gauge(
+    "hvd_reaction_max_buckets",
+    "Bucket-count cap armed by the straggler rebalance (0 = no "
+    "override active).")
+
+# -- chaos soak (faults/chaos.py, docs/CHAOS.md) -----------------------------
+chaos_events = _REG.counter(
+    "hvd_chaos_events_total",
+    "Injected chaos-soak events by kind and terminal outcome "
+    "(recovered / degraded / skipped).", ("kind", "outcome"))
+recovery_ms = _REG.gauge(
+    "hvd_recovery_ms",
+    "Measured MTTR of the most recent chaos-soak event of each kind: "
+    "injection to digest-verified recovery (ms).", ("kind",))
+chaos_generations = _REG.gauge(
+    "hvd_chaos_generations",
+    "Analysis-window generations the running chaos soak has completed "
+    "(digest-verified and split-brain-checked).")
 
 # -- elastic driver (runner/elastic/driver.py) ------------------------------
 elastic_rank_added = _REG.counter(

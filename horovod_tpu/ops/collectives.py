@@ -198,6 +198,14 @@ class _traced:
                     _faults.point(pt)
                 except FaultInjected as e:
                     raise HorovodInternalError(str(e)) from e
+            # Chaos-soak straggler: a delay here lands BEFORE the
+            # timeline activity_start, so this rank's bucket spans start
+            # late and the fleet tracer blames it — exactly the
+            # signature the reaction policy reads.
+            try:
+                _faults.point("chaos.straggler_delay")
+            except FaultInjected as e:
+                raise HorovodInternalError(str(e)) from e
         if self._si is not None:
             self._key = self._si.record_start(self._desc)
         if self._tl is not None:
@@ -475,6 +483,13 @@ def _stage_shard(c, d: jax.Device):
     through `np.asarray` would be a D2H+H2D per call.
     """
     if isinstance(c, jax.Array) and not c.is_deleted():
+        if not c.is_fully_addressable:
+            # Output of a prior eager collective: a replicated global
+            # array spanning other processes.  device_put refuses those,
+            # but every process holds the full value in its local shard —
+            # stage from that (keeps chained eager collectives, e.g.
+            # bucket reduce → sentinel-flag reduce, device-resident).
+            c = c.addressable_shards[0].data
         return jax.device_put(c[None], d)
     return jax.device_put(np.asarray(c)[None], d)
 

@@ -93,6 +93,53 @@ def _buckets_by_size(tensors, threshold_bytes, bucket_order="forward"):
         threshold_bytes, bucket_order)
 
 
+# -- straggler-reaction partition override ------------------------------
+# The trace reaction policy (trace/reaction.py) rebalances the bucket
+# partition away from a blamed rank by capping the bucket COUNT: fewer,
+# larger buckets mean the straggler pays its per-collective overhead
+# once per step instead of once per bucket.  Module-level so every
+# partition consumer (allreduce_gradients, fused apply, ZeRO shard
+# groups, zero3 placement) sees the same override, and generation-
+# counted so compiled-program caches and fused optimizer state are
+# loudly invalidated instead of silently diverging.
+_REACTION = {"max_buckets": 0, "avoid_rank": -1, "generation": 0}
+
+
+def set_reaction_rebalance(max_buckets: int, avoid_rank: int = -1) -> int:
+    """Arm the straggler rebalance: cap the gradient bucket partition at
+    `max_buckets` buckets (1 = one fused bucket, the strongest form).
+    `avoid_rank` records WHO the rebalance shields — informational for
+    metrics/tests; the partition itself is rank-symmetric so every rank
+    must arm the same override in lockstep.  Returns the new reaction
+    generation (part of the megastep autotune key, so armed/disarmed
+    flips force a retrace; fused-apply state trips the loud re-init
+    ValueError on the next update)."""
+    _REACTION["max_buckets"] = max(0, int(max_buckets))
+    _REACTION["avoid_rank"] = int(avoid_rank)
+    _REACTION["generation"] += 1
+    if _met.enabled():
+        _met.reaction_max_buckets.set(_REACTION["max_buckets"])
+    return _REACTION["generation"]
+
+
+def clear_reaction_rebalance() -> int:
+    """Disarm the straggler rebalance (also bumps the generation — the
+    partition changes back, so the same loud-re-init rules apply)."""
+    return set_reaction_rebalance(0, -1)
+
+
+def reaction_rebalance():
+    """(max_buckets, avoid_rank) of the armed override; (0, -1) when
+    disarmed."""
+    return (_REACTION["max_buckets"], _REACTION["avoid_rank"])
+
+
+def reaction_generation() -> int:
+    """Monotone counter bumped on every arm/disarm — joins the megastep
+    autotune key next to the wire error-feedback generation."""
+    return _REACTION["generation"]
+
+
 def gradient_bucket_partition(
     leaves: Sequence[Any],
     compression=Compression.none,
@@ -125,10 +172,17 @@ def gradient_bucket_partition(
         # The autotuner's per-bucket-count knob: force at least
         # `min_buckets` buckets by capping the effective threshold.
         m = current_min_buckets()
+        cap = fusion_threshold_bytes
         if m > 1 and nbytes:
-            return min(fusion_threshold_bytes,
-                       max(1, -(-sum(nbytes) // m)))
-        return fusion_threshold_bytes
+            cap = min(cap, max(1, -(-sum(nbytes) // m)))
+        # Straggler-reaction override: at most `max_buckets` buckets by
+        # RAISING the threshold (wins over both knobs above).  Exact for
+        # max_buckets=1 — threshold >= total and the greedy split is
+        # strict-`>`, so one bucket forms; best-effort for larger caps.
+        mb = _REACTION["max_buckets"]
+        if mb >= 1 and nbytes:
+            cap = max(cap, -(-sum(nbytes) // mb))
+        return cap
 
     if _coop:
         float_idx = [i for i, t in enumerate(leaves)
@@ -854,7 +908,10 @@ def data_parallel(
                     util.getenv("FUSED_CHUNK_BYTES"),
                     util.getenv("ZERO_STAGE"),
                     util.getenv("ZERO_GATHER_WIRE"),
-                    _wire.error_feedback_generation() or None)
+                    _wire.error_feedback_generation() or None,
+                    # Straggler-reaction arm/disarm changes the bucket
+                    # partition the traced program baked in.
+                    reaction_generation() or None)
         pm = _at.get_manager()
         if pm is None:
             return env_part if any(env_part) else None

@@ -47,6 +47,17 @@ _hook_lock = threading.Lock()
 _exit_hook_installed = False
 
 
+def default_out_dir() -> str:
+    """HOROVOD_SERVE_FLIGHTREC_DIR, defaulting UNDER the system temp
+    dir — never the working tree, so crash dumps cannot end up
+    committed (the PR-13/14 `serve_flightrec.local.*.json` leak)."""
+    d = util.getenv("SERVE_FLIGHTREC_DIR")
+    if d:
+        return d
+    import tempfile
+    return os.path.join(tempfile.gettempdir(), "horovod_flightrec")
+
+
 def _install_exit_hook() -> None:
     """Register the fault-exit dump trigger once per process.  The
     ``exit`` fault mode calls ``os._exit`` which skips atexit, so the
@@ -90,7 +101,7 @@ class FlightRecorder:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self.depth = depth
         self.out_dir = out_dir if out_dir is not None else \
-            (util.getenv("SERVE_FLIGHTREC_DIR") or ".")
+            default_out_dir()
         self._ring: "deque" = deque(maxlen=depth)
         self._lock = threading.Lock()
         self._seq = 0
@@ -161,6 +172,7 @@ class FlightRecorder:
             "dumped_unix": time.time(),
             "events": events,
         }
+        os.makedirs(self.out_dir, exist_ok=True)
         final = self._path()
         tmp = final + ".tmp"
         with open(tmp, "w") as f:

@@ -18,7 +18,12 @@ from .core import (  # noqa: F401
     write_merged,
 )
 from .measure import TraceMeasurements  # noqa: F401
+from .reaction import (  # noqa: F401
+    ReactionDecision,
+    StragglerReactionPolicy,
+)
 
 __all__ = ["analyze", "clock_offsets", "cycle_arrivals", "load_events",
            "load_rank_traces", "merge", "write_merged",
-           "TraceMeasurements"]
+           "TraceMeasurements", "ReactionDecision",
+           "StragglerReactionPolicy"]

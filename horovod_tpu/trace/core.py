@@ -280,8 +280,14 @@ def analyze(traces_or_paths: Union[Traces, Sequence[str]],
                   if cycles else set())
     common = sorted(common_set)
 
-    # Collective spans per (step, name, tid) across ranks.
+    # Collective spans per (step, name, tid, occurrence) across ranks.
+    # Unnamed eager buckets all share name/tid ("ALLREDUCE"), so a step
+    # with B gradient buckets emits B identical keys per rank; pairing
+    # the k-th occurrence on each rank is sound because dispatch order
+    # is the SPMD program order — without it, later spans overwrite
+    # earlier ones and per-step wait undercounts to one bucket's skew.
     coll: Dict[tuple, List[tuple]] = {}
+    occ: Dict[tuple, int] = {}
     for r in ranks:
         for ev in aligned[r]:
             if ev.get("ph") != "X" or ev.get("cat") != "collective":
@@ -289,9 +295,11 @@ def analyze(traces_or_paths: Union[Traces, Sequence[str]],
             n = _bucket_window(ev, cycles[r])
             if n is None:
                 continue
-            key = (n, str(ev.get("name", "")), str(ev.get("tid", "")))
+            base = (n, str(ev.get("name", "")), str(ev.get("tid", "")))
+            k = occ.get((r,) + base, 0)
+            occ[(r,) + base] = k + 1
             start = float(ev.get("ts", 0.0))
-            coll.setdefault(key, []).append(
+            coll.setdefault(base + (k,), []).append(
                 (r, start, start + float(ev.get("dur", 0.0))))
 
     steps: List[dict] = []
@@ -307,7 +315,7 @@ def analyze(traces_or_paths: Union[Traces, Sequence[str]],
                      - min(cycles[r][n - 1] for r in ranks)) / 1e3
         buckets = []
         step_wait = step_wire = 0.0
-        for (bn, name, tid), entries in sorted(coll.items()):
+        for (bn, name, tid, _k), entries in sorted(coll.items()):
             if bn != n:
                 continue
             starts = {r: s for r, s, _ in entries}
@@ -322,6 +330,14 @@ def analyze(traces_or_paths: Union[Traces, Sequence[str]],
                 wait_ms, wire_ms, blamed = 0.0, (e - s) / 1e3, None
             step_wait += wait_ms
             step_wire += wire_ms
+            # Bucket-level blame votes too: barrier-arrival skew is
+            # median-aligned away for a PERSISTENT straggler (every
+            # step equally late ⇒ the offset is absorbed into its
+            # clock), but its per-bucket dispatch starts stay late
+            # within each step, so span starts are the robust signal.
+            if blamed is not None and wait_ms > 0:
+                straggler_votes[blamed] = (
+                    straggler_votes.get(blamed, 0) + 1)
             buckets.append({
                 "name": name, "tid": tid, "ranks": len(entries),
                 "wait_ms": round(wait_ms, 3), "wire_ms": round(wire_ms, 3),

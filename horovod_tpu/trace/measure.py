@@ -29,6 +29,11 @@ class TraceMeasurements:
     step_skew_ms: float = 0.0
     straggler_rank: int = -1
     skew_share: float = 0.0
+    #: Mean straggler-wait milliseconds per analyzed step — the ABSOLUTE
+    #: time lost to late arrivals.  `skew_share` is a ratio of the
+    #: critical path, so a reaction that shrinks the whole step can
+    #: RAISE it while shrinking this; reaction efficacy reads this one.
+    wait_ms_per_step: float = 0.0
     wire_share: float = 0.0
     collective_share_measured: float = 0.0
     #: Median measured milliseconds per collective bucket, keyed by the
@@ -39,7 +44,9 @@ class TraceMeasurements:
     def from_report(cls, report: dict) -> "TraceMeasurements":
         s = report.get("summary", {})
         per_bucket: Dict[str, list] = {}
+        step_waits: list = []
         for step in report.get("steps", ()):
+            step_waits.append(float(step.get("wait_ms", 0.0)))
             for b in step.get("buckets", ()):
                 key = f"{b['name']}/{b['tid']}"
                 per_bucket.setdefault(key, []).append(
@@ -50,6 +57,8 @@ class TraceMeasurements:
             step_skew_ms=float(s.get("step_skew_ms_median", 0.0)),
             straggler_rank=int(s.get("straggler_rank", -1)),
             skew_share=float(s.get("skew_share", 0.0)),
+            wait_ms_per_step=(round(statistics.fmean(step_waits), 3)
+                              if step_waits else 0.0),
             wire_share=float(s.get("wire_share", 0.0)),
             collective_share_measured=float(
                 s.get("collective_share_measured", 0.0)),

@@ -1,0 +1,45 @@
+"""Worker main for the REAL multi-process chaos soak (docs/CHAOS.md).
+
+Launched by the runner with -np 2 (fast tier-1 variant) or -np 4 (slow
+soak): every rank runs the same `ChaosSoak` — fault-loaded eager
+training with per-generation merged-trace windows, the straggler
+reaction policy, and the online autotuner — and writes the soak's
+JSON-serializable result to $HVD_TEST_OUT/rank{r}.json for the test to
+assert on (events all recovered, no split brain, reaction fired,
+autotune best non-worsening, final params bitwise-identical).
+
+Soak shape comes from the standard env knobs
+(HOROVOD_CHAOS_GENERATIONS / HOROVOD_CHAOS_STEPS_PER_GEN /
+HOROVOD_STRAGGLER_*) plus HVD_CHAOS_SEED, so the launching test
+controls the plan deterministically.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+# The axon sitecustomize pins the TPU plugin regardless of env; tests
+# must never claim the shared chip (same override as tests/conftest.py).
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.faults.chaos import ChaosSoak  # noqa: E402
+
+
+def main():
+    hvd.init()
+    soak = ChaosSoak(seed=int(os.environ.get("HVD_CHAOS_SEED", "7")))
+    res = soak.run()
+    out_dir = os.environ["HVD_TEST_OUT"]
+    with open(os.path.join(out_dir, f"rank{hvd.rank()}.json"), "w") as f:
+        json.dump(res, f)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -516,3 +516,144 @@ class TestReshardCrossProcess:
             assert out["die_restore_digest_mismatch"] is None, out
         assert res[1]["die_points_hit"] == 1, res[1]
         assert res[0]["die_points_hit"] == 0, res[0]
+
+
+CHAOS_WORKER = os.path.join(REPO_ROOT, "tests", "data", "chaos_main.py")
+
+
+def _launch_chaos(np_, out_dir, generations, steps_per_gen,
+                  extra_env=None, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["HVD_TEST_OUT"] = str(out_dir)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        # Per-rank cycle-marked timelines feed the online windows; the
+        # Python writer keeps partial files readable mid-run.
+        "HOROVOD_TIMELINE": str(out_dir / "tl.json"),
+        "HOROVOD_TIMELINE_ALL_RANKS": "1",
+        "HOROVOD_TIMELINE_MARK_CYCLES": "1",
+        "HOROVOD_TIMELINE_DISABLE_NATIVE": "1",
+        # Online autotuner against the merged-trace objective.
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+        # Reaction policy tight enough to fire inside the soak.
+        "HOROVOD_STRAGGLER_PATIENCE": "2",
+        "HOROVOD_STRAGGLER_COOLDOWN": "1",
+        "HOROVOD_CHAOS_GENERATIONS": str(generations),
+        "HOROVOD_CHAOS_STEPS_PER_GEN": str(steps_per_gen),
+        "HVD_CHAOS_SEED": "7",
+    })
+    env.update(extra_env or {})
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+         "python", CHAOS_WORKER],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO_ROOT)
+    assert r.returncode == 0, f"launch failed:\n{r.stdout}\n{r.stderr}"
+    res = {}
+    for rank in range(np_):
+        path = out_dir / f"rank{rank}.json"
+        assert path.exists(), \
+            f"rank {rank} wrote no result:\n{r.stdout}\n{r.stderr}"
+        res[rank] = json.loads(path.read_text())
+    return res
+
+
+def _assert_soak_invariants(res, np_):
+    """The re-convergence contract every soak run must satisfy."""
+    for rank, out in res.items():
+        assert not out["split_brain"], out
+        assert out["final_digest_mismatch"] is None, out
+        for ev in out["events"]:
+            assert ev["outcome"] in ("recovered", "degraded"), ev
+            assert ev["mttr_ms"] >= 0, ev
+    # Final params bitwise-identical across every surviving rank.
+    for rank in range(1, np_):
+        assert res[rank]["final_w"] == res[0]["final_w"], \
+            f"rank {rank} params diverged from rank 0"
+    # All ranks observed the identical event stream (lockstep plan).
+    for rank in range(1, np_):
+        assert ([ (e["kind"], e["gen"], e["step"]) for e in
+                  res[rank]["events"] ]
+                == [ (e["kind"], e["gen"], e["step"]) for e in
+                     res[0]["events"] ])
+    # Online autotuner: samples flowing, best-observed objective
+    # (best-so-far items/sec) non-worsening across windows.
+    out0 = res[0]
+    assert out0["autotune_enabled"]
+    bests = [w["autotune_best"] for w in out0["windows"]
+             if w["autotune_best"] is not None]
+    assert bests, "autotuner never recorded a window sample"
+    assert all(b2 >= b1 for b1, b2 in zip(bests, bests[1:])), bests
+    samples = [w["autotune_samples"] for w in out0["windows"]]
+    assert samples[-1] >= 1 and samples == sorted(samples), samples
+
+
+@pytest.mark.integration
+class TestChaosSoakFast:
+    """np=2 tier-1 chaos soak (docs/CHAOS.md): the orchestrator itself —
+    straggler block with a live reaction, one-shot guard/collective
+    injections, per-generation merged-trace windows feeding the online
+    autotuner — small enough for tier-1."""
+
+    def test_two_process_soak(self, tmp_path):
+        res = _launch_chaos(2, tmp_path, generations=5, steps_per_gen=4)
+        _assert_soak_invariants(res, 2)
+        out = res[0]
+        # The straggler block armed and the blame stream fired a
+        # reaction (patience 2 inside a 4-generation block).
+        assert out["straggler_target"] >= 0
+        assert any(r["action"] == "rebalance" for r in out["reactions"]), \
+            out["reactions"]
+        blamed = [w["straggler_rank"] for w in out["windows"]
+                  if w["straggler_armed"]]
+        assert out["straggler_target"] in blamed, out["windows"]
+        # The rebalance repartition went through the LOUD re-init path.
+        assert out["loud_reinits"] >= 1, out
+        # Both one-shot injections of the event generation recovered.
+        kinds = {e["kind"]: e for e in out["events"]}
+        assert "worker_stall" in kinds and "nan_grad" in kinds, kinds
+        assert kinds["nan_grad"]["outcome"] == "recovered", kinds
+        assert kinds["nan_grad"]["steps_lost"] >= 1, kinds
+        # Reactions were computed in lockstep on every rank.
+        assert res[1]["reactions"] == out["reactions"]
+
+
+@pytest.mark.slow
+class TestChaosSoakFleet:
+    """np=4 fault-loaded soak — ISSUE 15's acceptance run: >= 5 distinct
+    injected fault kinds in one run, every event digest-verified
+    recovered (or deliberately degraded), per-event MTTR, straggler
+    reaction fires and post-reaction skew drops, autotuner online with
+    a non-worsening best objective, final params bitwise-identical."""
+
+    def test_four_process_fault_loaded_soak(self, tmp_path):
+        res = _launch_chaos(
+            4, tmp_path, generations=8, steps_per_gen=5,
+            extra_env={"HOROVOD_WIRE_POLICY": "bf16:65536"},
+            timeout=540)
+        _assert_soak_invariants(res, 4)
+        out = res[0]
+        # >= 5 distinct fault kinds survived in ONE run.
+        assert len(out["kinds_injected"]) >= 5, out["kinds_injected"]
+        recovered = {e["kind"] for e in out["events"]
+                     if e["outcome"] == "recovered"}
+        assert len(recovered) >= 5, out["events"]
+        # Straggler reaction fired and the post-reaction merged-trace
+        # ABSOLUTE wait per step dropped while the delay stayed armed
+        # (skew_share is a ratio of the critical path, so collapsing to
+        # one bucket can raise it even as the time lost shrinks —
+        # wait_ms_per_step is the efficacy signal, see trace/measure.py).
+        assert any(r["action"] == "rebalance" for r in out["reactions"])
+        fired_gen = min(r["gen"] for r in out["reactions"])
+        pre = [w["wait_ms_per_step"] for w in out["windows"]
+               if w["straggler_armed"] and w["gen"] <= fired_gen
+               and w["wait_ms_per_step"] is not None]
+        post = [w["wait_ms_per_step"] for w in out["windows"]
+                if w["straggler_armed"] and w["gen"] > fired_gen
+                and w["wait_ms_per_step"] is not None]
+        assert pre and post, out["windows"]
+        assert min(post) < max(pre), (pre, post)
+        assert out["loud_reinits"] >= 1, out

@@ -1,0 +1,146 @@
+"""Unit tests for the straggler reaction policy (trace/reaction.py) and
+its partition actuator (parallel/data_parallel.set_reaction_rebalance).
+
+Pure single-process tests — the policy is fed hand-built measurement
+objects, and the partition override is asserted on
+`gradient_bucket_partition` directly.  The end-to-end loop (merged-trace
+blame -> rebalance -> loud re-init -> measured wait drop) lives in the
+multi-process chaos soak (tests/test_multiprocess.py, docs/CHAOS.md).
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+from horovod_tpu.parallel import data_parallel as dp
+from horovod_tpu.trace import ReactionDecision, StragglerReactionPolicy
+
+
+def _m(rank, skew):
+    return types.SimpleNamespace(straggler_rank=rank, skew_share=skew)
+
+
+@pytest.fixture(autouse=True)
+def _clean_reaction_state():
+    saved = dict(dp._REACTION)
+    yield
+    dp._REACTION.clear()
+    dp._REACTION.update(saved)
+
+
+# ---------------------------------------------------------------------------
+# Policy hysteresis
+# ---------------------------------------------------------------------------
+
+def test_patience_streak_then_rebalance_then_cooldown():
+    fired = []
+    p = StragglerReactionPolicy(patience=3, cooldown=2, skew_threshold=0.75,
+                                on_rebalance=fired.append)
+    assert not p.observe(_m(1, 0.2)).fired and p.streak == 1
+    assert not p.observe(_m(1, 0.2)).fired and p.streak == 2
+    d = p.observe(_m(1, 0.2))
+    assert d == ReactionDecision(action="rebalance", rank=1, streak=3,
+                                 skew_share=0.2, reason="patience exhausted")
+    assert fired == [1]
+    assert p.rebalanced_against == 1
+    # Cooldown: the next `cooldown` windows are settling time — blames
+    # there must not start a new streak.
+    for _ in range(2):
+        d = p.observe(_m(1, 0.9))
+        assert d.reason == "cooldown" and not d.fired
+    assert p.streak == 0
+
+
+def test_blame_switch_resets_streak():
+    fired = []
+    p = StragglerReactionPolicy(patience=2, cooldown=0,
+                                on_rebalance=fired.append)
+    p.observe(_m(0, 0.2))
+    p.observe(_m(3, 0.2))       # different rank: streak restarts at 1
+    assert p.streak == 1 and p.streak_rank == 3
+    assert not fired
+    assert p.observe(_m(3, 0.2)).action == "rebalance"
+    assert fired == [3]
+
+
+def test_noise_floor_blames_reset_streak():
+    p = StragglerReactionPolicy(patience=2, cooldown=0, min_skew_share=0.05,
+                                on_rebalance=lambda r: None)
+    p.observe(_m(1, 0.2))
+    d = p.observe(_m(1, 0.01))  # an idle fleet always blames SOMEONE
+    assert d.reason == "no credible straggler"
+    assert p.streak == 0
+    # The earlier streak is gone: two more credible blames are needed.
+    assert not p.observe(_m(1, 0.2)).fired
+
+
+def test_high_skew_escalates_straight_to_degrade():
+    degraded = []
+    p = StragglerReactionPolicy(patience=2, cooldown=0, skew_threshold=0.5,
+                                on_rebalance=lambda r: None,
+                                on_degrade=degraded.append)
+    p.observe(_m(2, 0.8))
+    d = p.observe(_m(2, 0.8))
+    assert d.action == "degrade" and d.rank == 2
+    assert "over threshold" in d.reason
+    assert degraded == [2]
+
+
+def test_reblame_after_rebalance_escalates_to_degrade():
+    acted = []
+    p = StragglerReactionPolicy(patience=2, cooldown=1, skew_threshold=0.75,
+                                on_rebalance=lambda r: acted.append(("rb", r)),
+                                on_degrade=lambda r: acted.append(("dg", r)))
+    p.observe(_m(1, 0.2))
+    assert p.observe(_m(1, 0.2)).action == "rebalance"
+    p.observe(_m(1, 0.2))       # cooldown window
+    # Rank 1 keeps drawing blame though the partition is already
+    # collapsed — rebalancing again can't help; escalate.
+    p.observe(_m(1, 0.2))
+    d = p.observe(_m(1, 0.2))
+    assert d.action == "degrade" and d.reason == "still blamed after rebalance"
+    assert acted == [("rb", 1), ("dg", 1)]
+
+
+def test_env_knobs_configure_defaults(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STRAGGLER_PATIENCE", "5")
+    monkeypatch.setenv("HOROVOD_STRAGGLER_SKEW_THRESHOLD", "0.33")
+    monkeypatch.setenv("HOROVOD_STRAGGLER_COOLDOWN", "7")
+    p = StragglerReactionPolicy()
+    assert p.patience == 5
+    assert p.skew_threshold == 0.33
+    assert p.cooldown == 7
+
+
+def test_reset_forgets_history_and_disarms_rebalance():
+    p = StragglerReactionPolicy(patience=1, cooldown=0)
+    gen0 = dp.reaction_generation()
+    assert p.observe(_m(1, 0.2)).action == "rebalance"
+    assert dp.reaction_rebalance() == (1, 1)
+    assert dp.reaction_generation() == gen0 + 1
+    p.reset()   # elastic generation change: rank numbers reassigned
+    assert p.rebalanced_against == -1
+    assert dp.reaction_rebalance() == (0, -1)
+    assert dp.reaction_generation() == gen0 + 2
+
+
+# ---------------------------------------------------------------------------
+# Partition actuator
+# ---------------------------------------------------------------------------
+
+def test_rebalance_collapses_partition_and_bumps_generation():
+    leaves = [np.zeros((64,), np.float32) for _ in range(8)]
+    multi = dp.gradient_bucket_partition(leaves,
+                                         fusion_threshold_bytes=512)
+    assert len(multi) > 1
+    gen0 = dp.reaction_generation()
+    dp.set_reaction_rebalance(max_buckets=1, avoid_rank=3)
+    assert dp.reaction_rebalance() == (1, 3)
+    assert dp.reaction_generation() == gen0 + 1
+    one = dp.gradient_bucket_partition(leaves, fusion_threshold_bytes=512)
+    assert len(one) == 1
+    assert sorted(one[0]) == list(range(8))
+    dp.clear_reaction_rebalance()
+    assert dp.gradient_bucket_partition(
+        leaves, fusion_threshold_bytes=512) == multi
