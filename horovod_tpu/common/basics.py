@@ -254,10 +254,12 @@ def init(
         # inspector publishes snapshots itself when running).
         from ..metrics import exposition as _met_exp
         from ..metrics import fleet as _met_fleet
+        from ..metrics import history as _met_hist
 
         _met_exp.init_from_env(_global_state.process_index,
                                _global_state.num_processes)
         _met_fleet.maybe_start_kv_publisher()
+        _met_hist.init_from_env()
 
         logger.info(
             "horovod_tpu initialized: size=%d local_size=%d process=%d/%d "
@@ -289,12 +291,14 @@ def shutdown() -> None:
 
         from ..metrics import exposition as _met_exp
         from ..metrics import fleet as _met_fleet
+        from ..metrics import history as _met_hist
 
         _coll.clear_caches()
         _tl_mod.stop_timeline()
         _stall_mod.shutdown_inspector()
         _at_mod.shutdown_manager()
         _met_fleet.stop_kv_publisher()
+        _met_hist.stop_history()
         _met_exp.stop_server()
         _global_state = None
         # Elastic multi-process mode must also drop the live backends:

@@ -198,6 +198,36 @@ CATALOG: Tuple[EnvVar, ...] = (
     _v("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.0", "metrics",
        "Seconds after which a stalled job aborts; 0 disables shutdown.",
        "METRICS.md"),
+    _v("HOROVOD_METRICS_HISTORY_INTERVAL", "0 (off)", "metrics",
+       "Seconds between background history-ring samples of every "
+       "metric series (metrics/history.py); 0/unset disables the "
+       "sampler.", "TELEMETRY.md"),
+    _v("HOROVOD_METRICS_HISTORY_DEPTH", "512", "metrics",
+       "Points kept per series ring before the oldest are evicted.",
+       "TELEMETRY.md"),
+    _v("HOROVOD_METRICS_HISTORY_DIR", "(system temp)", "metrics",
+       "Directory for the history JSONL dumps written on "
+       "flight-recorder triggers.", "TELEMETRY.md"),
+    _v("HOROVOD_SLO_BUDGET_TARGET", "0.99", "metrics",
+       "Availability target of an SLO error budget (metrics/budget.py); "
+       "0.99 means 1% of events may be bad before the budget is spent.",
+       "TELEMETRY.md"),
+    _v("HOROVOD_SLO_BUDGET_WINDOW", "3600", "metrics",
+       "Seconds of history one error budget is computed over.",
+       "TELEMETRY.md"),
+    _v("HOROVOD_SLO_BUDGET_FAST", "60", "metrics",
+       "Fast burn-rate window seconds (page when fast AND slow burn "
+       "both exceed 1x — the multi-window SRE rule).", "TELEMETRY.md"),
+    _v("HOROVOD_SLO_BUDGET_SLOW", "600", "metrics",
+       "Slow burn-rate window seconds.", "TELEMETRY.md"),
+    _v("HOROVOD_SLO_STEP_MS", "(unset)", "metrics",
+       "Training step-time SLO threshold in ms; setting it arms a "
+       "train_step error budget in the chaos soak / training loop.",
+       "TELEMETRY.md"),
+    _v("HOROVOD_ANOMALY_Z", "4.0", "metrics",
+       "EWMA z-score threshold for the anomaly detectors "
+       "(metrics/anomaly.py); higher = fewer, louder trips.",
+       "TELEMETRY.md"),
 
     # -- timeline --------------------------------------------------------
     _v("HOROVOD_TIMELINE", "(unset)", "timeline",

@@ -356,6 +356,8 @@ class ChaosSoak:
         import optax
 
         import horovod_tpu as hvd
+        from ..metrics import anomaly as _anomaly
+        from ..metrics import budget as _budget
         from ..metrics import catalog as _met
         from ..ops import functions as F
         from ..trace.reaction import StragglerReactionPolicy
@@ -382,6 +384,24 @@ class ChaosSoak:
                 straggler_target = inj.target
             else:
                 by_step.setdefault((inj.gen, inj.step), []).append(inj)
+
+        # -- anomaly layer: chaos doubles as the sensors' recall
+        # harness.  Per-step wall time feeds the z-score detector under
+        # the series name the runtime publishes it as
+        # (hvd_critical_path_ms); the step counter feeds the stall
+        # detector.  After the soak, every trip is attributed to the
+        # injection (or armed straggler block) it landed on — trips on
+        # clean steps are FALSE POSITIVES the tier-1 soak asserts to
+        # zero (docs/TELEMETRY.md).
+        monitor = _anomaly.AnomalyMonitor()
+        step_slo_ms = util.env_float("SLO_STEP_MS", 0.0)
+        train_budget = (_budget.SloBudget("train_step")
+                        if step_slo_ms > 0 else None)
+        inj_steps: Dict[int, List[str]] = {}
+        for inj in plan:
+            if inj.kind != "straggler_delay":
+                g_step = inj.gen * self.steps_per_gen + inj.step + 1
+                inj_steps.setdefault(g_step, []).append(inj.kind)
 
         # -- model + optimizer + guard (eager update path) ---------------
         keys = [f"p{i:02d}" for i in range(self.n_leaves)]
@@ -446,6 +466,7 @@ class ChaosSoak:
                 t += 1
                 if tl is not None:
                     tl.mark_cycle()
+                step_t0 = time.perf_counter()
                 injs = by_step.get((g, s), ())
                 stall = next((i for i in injs
                               if i.kind == "worker_stall"), None)
@@ -562,6 +583,20 @@ class ChaosSoak:
                                     "reshard_peer_die"):
                         self._reshard_drill(inj, rank, n, w)
 
+                step_ms = (time.perf_counter() - step_t0) * 1e3
+                # The first step of every generation pays compile /
+                # rotation overhead that dwarfs the injected faults;
+                # feeding those into the EWMA baseline inflates its
+                # variance until real stalls score below threshold, so
+                # only steady-state steps train (and trip) the detector.
+                if s != 0:
+                    monitor.observe("hvd_critical_path_ms", step_ms,
+                                    step=t)
+                monitor.observe_counter("hvd_steps_total", float(t),
+                                        step=t)
+                if train_budget is not None:
+                    train_budget.record_latency(step_ms, step_slo_ms)
+
             # -- end of generation: window analysis + commit -------------
             if (straggling and g == straggler_gens - 1
                     and rank == straggler_target):
@@ -603,6 +638,8 @@ class ChaosSoak:
             })
             if _met.enabled():
                 _met.chaos_generations.set(g + 1)
+            if train_budget is not None:
+                train_budget.export()
 
             mism = self._digest_mismatch(w)
             if mism is None:
@@ -622,6 +659,36 @@ class ChaosSoak:
 
         _faults.clear()
         final_mism = self._digest_mismatch(w)
+
+        # -- sensor recall: attribute every anomaly trip --------------
+        straggler_steps = (
+            set(range(1, straggler_gens * self.steps_per_gen + 1))
+            if straggler_target >= 0 else set())
+        injected_kinds = {k for ks in inj_steps.values() for k in ks}
+        if straggler_steps:
+            injected_kinds.add("straggler_delay")
+        detections: List[dict] = []
+        detected_kinds: set = set()
+        false_positives = 0
+        for a in monitor.events:
+            st = a.step or 0
+            # A spike lands on the injection step itself; the restore /
+            # recovery tail of the same injection may spill one step.
+            kinds_here = inj_steps.get(st) or inj_steps.get(st - 1)
+            if kinds_here:
+                matched = kinds_here[0]
+            elif st in straggler_steps:
+                matched = "straggler_delay"
+            else:
+                matched = None
+                false_positives += 1
+            if matched is not None:
+                detected_kinds.add(matched)
+            detections.append({
+                "step": st, "series": a.series, "kind": a.kind,
+                "score": a.score, "value": round(a.value, 3),
+                "matched": matched})
+
         res = {
             "rank": rank,
             "np": n,
@@ -641,5 +708,15 @@ class ChaosSoak:
             "straggler_target": straggler_target,
             "straggler_gens": straggler_gens,
             "autotune_enabled": pm is not None,
+            "anomaly": {
+                "z_thresh": monitor.z_thresh,
+                "events": detections,
+                "detected_kinds": sorted(detected_kinds),
+                "injected_kinds": sorted(injected_kinds),
+                "false_positives": false_positives,
+                "recall": round(
+                    len(detected_kinds & injected_kinds)
+                    / max(1, len(injected_kinds)), 3),
+            },
         }
         return res

@@ -12,11 +12,25 @@ Quick start::
     HOROVOD_METRICS_PORT=9090 horovodrun_tpu -np 8 python train.py
     curl :9090/metrics                    # per-worker scrape
     python -m horovod_tpu.metrics         # merged cluster view (via KV)
+    python -m horovod_tpu.metrics top     # live console (sparklines)
+
+The telemetry plane on top of the registry (docs/TELEMETRY.md):
+history.py keeps bounded in-process rings of every series
+(HOROVOD_METRICS_HISTORY_INTERVAL), budget.py tracks SLO error budgets
+with multi-window burn rates, anomaly.py trips EWMA z-score and
+counter-stall detectors, and top.py renders the live console.
 
 See docs/METRICS.md for the metric catalog and scrape config.
 """
 
 from . import catalog  # noqa: F401  (declares every hvd_* series)
+from .anomaly import (  # noqa: F401
+    Anomaly,
+    AnomalyMonitor,
+    CounterStallDetector,
+    EwmaDetector,
+)
+from .budget import SloBudget  # noqa: F401
 from .exposition import (  # noqa: F401
     render,
     start_server,
@@ -29,6 +43,14 @@ from .fleet import (  # noqa: F401
     read_fleet,
     render_fleet,
     snapshot,
+)
+from .history import (  # noqa: F401
+    MetricsHistory,
+    Ring,
+    SortedWindow,
+    get_history,
+    start_history,
+    stop_history,
 )
 from .registry import (  # noqa: F401
     Counter,

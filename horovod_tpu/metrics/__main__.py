@@ -10,6 +10,13 @@ throughput, compile-cache hit rate.
     python -m horovod_tpu.metrics --kv host:port --secret s3cr3t
     python -m horovod_tpu.metrics --scrape host1:9090 --scrape host2:9090
     python -m horovod_tpu.metrics --raw                 # JSON snapshots
+    python -m horovod_tpu.metrics top                   # live console
+    python -m horovod_tpu.metrics top --once --scrape host1:9090
+
+`top` is the live ANSI console (metrics/top.py, docs/TELEMETRY.md):
+same --kv/--secret/--scrape source selection, redrawn every --interval
+seconds with sparklines, SLO burn-rate lines and anomaly highlights;
+--once prints a single frame and exits (tests/CI).
 """
 
 from __future__ import annotations
@@ -95,10 +102,7 @@ def _scrape(endpoints) -> list:
     return snaps
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        prog="python -m horovod_tpu.metrics",
-        description="Merged cluster metrics view (KV or HTTP scrape).")
+def _add_source_args(ap) -> None:
     ap.add_argument("--kv", metavar="ADDR:PORT",
                     help="rendezvous KV address (default: "
                          "HOROVOD_RENDEZVOUS_ADDR/PORT env)")
@@ -108,26 +112,62 @@ def main(argv=None) -> int:
                     metavar="HOST:PORT",
                     help="scrape worker HTTP endpoints instead of the KV "
                          "(repeatable)")
+
+
+def _make_fetch(ap, args):
+    """Zero-arg snapshot poller from the parsed source options (shared
+    by the one-shot view and the `top` console)."""
+    if args.scrape:
+        endpoints = list(args.scrape)
+        return lambda: _scrape(endpoints)
+    addr_port = args.kv
+    if not addr_port:
+        addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
+        port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
+        if not addr or not port:
+            ap.error("no --kv/--scrape and no HOROVOD_RENDEZVOUS_ADDR/"
+                     "PORT in the environment")
+        addr_port = f"{addr}:{port}"
+    secret = args.secret or os.environ.get("HOROVOD_SECRET_KEY")
+    if not secret:
+        ap.error("no --secret and no HOROVOD_SECRET_KEY in the "
+                 "environment")
+    client = _kv_client(addr_port, secret)
+    return lambda: read_fleet(client)
+
+
+def _main_top(argv) -> int:
+    from .top import run_top
+
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.metrics top",
+        description="Live fleet console (KV or HTTP scrape).")
+    _add_source_args(ap)
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print a single frame and exit (tests/CI)")
+    ap.add_argument("--color", action="store_true",
+                    help="force ANSI colors even off a tty")
+    args = ap.parse_args(argv)
+    fetch = _make_fetch(ap, args)
+    return run_top(fetch, interval=args.interval, once=args.once,
+                   color=True if args.color else None)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "top":
+        return _main_top(argv[1:])
+
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.metrics",
+        description="Merged cluster metrics view (KV or HTTP scrape).")
+    _add_source_args(ap)
     ap.add_argument("--raw", action="store_true",
                     help="print raw JSON snapshots instead of the view")
     args = ap.parse_args(argv)
-
-    if args.scrape:
-        snaps = _scrape(args.scrape)
-    else:
-        addr_port = args.kv
-        if not addr_port:
-            addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR")
-            port = os.environ.get("HOROVOD_RENDEZVOUS_PORT")
-            if not addr or not port:
-                ap.error("no --kv/--scrape and no HOROVOD_RENDEZVOUS_ADDR/"
-                         "PORT in the environment")
-            addr_port = f"{addr}:{port}"
-        secret = args.secret or os.environ.get("HOROVOD_SECRET_KEY")
-        if not secret:
-            ap.error("no --secret and no HOROVOD_SECRET_KEY in the "
-                     "environment")
-        snaps = read_fleet(_kv_client(addr_port, secret))
+    snaps = _make_fetch(ap, args)()
 
     if args.raw:
         print(json.dumps(snaps, indent=2, sort_keys=True))

@@ -266,6 +266,29 @@ serve_e2e_latency = _REG.histogram(
     "End-to-end request latency: submit to completion/eviction "
     "(= queue delay + prefill + decode).")
 
+# -- telemetry plane (metrics/{budget,anomaly}.py, docs/TELEMETRY.md) -------
+slo_budget_remaining = _REG.gauge(
+    "hvd_slo_budget_remaining",
+    "Fraction of the SLO error-budget window's failure allowance left "
+    "(1 = untouched, 0 = exhausted, negative = overdrawn), per named "
+    "budget (serve_latency, train_step).",
+    ("slo",))
+slo_burn_rate = _REG.gauge(
+    "hvd_slo_burn_rate",
+    "Error-budget burn rate over the fast/slow alert windows (1.0 "
+    "exactly exhausts the budget over its window; a breach needs both "
+    "windows over threshold — Google-SRE multi-window alerting).",
+    ("slo", "window"))
+anomaly_events = _REG.counter(
+    "hvd_anomaly_events_total",
+    "Anomaly-detector trips by offending series and detector kind "
+    "(ewma_z spike / counter_stall; see docs/TELEMETRY.md).",
+    ("series", "kind"))
+anomaly_active = _REG.gauge(
+    "hvd_anomaly_active",
+    "Series currently held anomalous by the monitor (trips that have "
+    "not yet cleared back inside the detector envelope).")
+
 # -- live resharding (horovod_tpu/parallel/reshard.py, docs/RESHARD.md) -----
 reshard_bytes = _REG.gauge(
     "hvd_reshard_bytes",

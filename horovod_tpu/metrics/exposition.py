@@ -5,7 +5,9 @@ The endpoint is a stdlib `http.server` on a daemon thread (started from
 common/basics.py), serving:
 
     /metrics   Prometheus text format 0.0.4
-    /healthz   "ok" (liveness probe)
+    /healthz   200 "ok ..." / 503 "heartbeat stale ..." from the
+               heartbeat-lease liveness check (external probes need
+               no Prometheus parsing; set_liveness_probe overrides)
 
 Multi-process-per-host launches offset the port by the process index so
 every worker on a host gets a distinct endpoint; HOROVOD_METRICS_PORT=0
@@ -27,7 +29,49 @@ from .registry import MetricsRegistry, get_registry
 logger = logging.getLogger("horovod_tpu.metrics")
 
 __all__ = ["render", "start_server", "stop_server", "server_port",
-           "init_from_env"]
+           "init_from_env", "set_liveness_probe"]
+
+#: Pluggable liveness probe behind /healthz: () -> (ok, detail).  None
+#: selects the default heartbeat-lease check (_default_liveness).
+_liveness_probe = None
+
+
+def set_liveness_probe(fn) -> None:
+    """Override the /healthz probe (tests, embedders); None restores
+    the heartbeat-lease default."""
+    global _liveness_probe
+    _liveness_probe = fn
+
+
+def _default_liveness():
+    """Healthy unless this worker runs heartbeat leases AND its last
+    beat is older than the lease TTL — the exact staleness the elastic
+    driver would declare the worker dead for, surfaced as 503 so an
+    external probe agrees with the control plane without parsing
+    Prometheus text."""
+    try:
+        from ..runner import elastic_worker as _ew
+        ttl = _ew.lease_ttl()
+        age = _ew.heartbeat_age()
+    except Exception:  # noqa: BLE001 — liveness must not 500
+        return True, "ok"
+    if ttl <= 0 or age is None:
+        return True, "ok"  # no lease regime: process up == alive
+    if age <= ttl:
+        return True, f"ok (heartbeat {age:.1f}s ago)"
+    return False, (f"heartbeat stale: {age:.1f}s since last beat "
+                   f"(lease ttl {ttl:.1f}s)")
+
+
+def _liveness():
+    probe = _liveness_probe
+    if probe is None:
+        return _default_liveness()
+    # lint: allow-swallow(a broken probe must read as unhealthy, not 500)
+    try:
+        return probe()
+    except Exception as e:  # noqa: BLE001
+        return False, f"liveness probe failed: {type(e).__name__}"
 
 
 def _fmt(v: float) -> str:
@@ -80,8 +124,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")
         elif self.path == "/healthz":
-            body = b"ok\n"
-            self.send_response(200)
+            ok, detail = _liveness()
+            body = (detail.rstrip("\n") + "\n").encode()
+            self.send_response(200 if ok else 503)
             self.send_header("Content-Type", "text/plain")
         else:
             body = b"not found\n"

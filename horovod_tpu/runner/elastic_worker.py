@@ -37,6 +37,20 @@ _known_gen = -1
 # incarnations must never publish identical payloads.
 _HEARTBEAT_NONCE = f"{os.getpid():x}-{os.urandom(4).hex()}"
 
+# Monotonic timestamp of the last heartbeat that reached the KV — the
+# local liveness signal behind the metrics endpoint's /healthz (a probe
+# that can't parse Prometheus text still learns "this worker's lease is
+# being renewed").  None until the first successful beat.
+_last_beat_monotonic: Optional[float] = None
+
+
+def heartbeat_age() -> Optional[float]:
+    """Seconds since the last successfully published heartbeat, or None
+    when no heartbeat has ever landed (heartbeats disabled, not elastic,
+    or the loop hasn't beaten yet)."""
+    last = _last_beat_monotonic
+    return None if last is None else time.monotonic() - last
+
 
 def lease_ttl() -> float:
     """Heartbeat lease TTL in seconds (0 disables heartbeats).  The
@@ -61,6 +75,8 @@ def publish_heartbeat(client: RendezvousClient, seq: int,
     client.put(key, json.dumps(
         {"seq": seq, "nonce": _HEARTBEAT_NONCE, "ts": time.time()}))
     client.renew_lease(f"worker/{key.rsplit('/', 1)[1]}", ttl)
+    global _last_beat_monotonic
+    _last_beat_monotonic = time.monotonic()
 
 
 def _heartbeat_loop(ttl: float) -> None:

@@ -46,6 +46,29 @@ _RECORDERS: "weakref.WeakSet" = weakref.WeakSet()
 _hook_lock = threading.Lock()
 _exit_hook_installed = False
 
+#: Trigger siblings: callables invoked with the dump reason whenever a
+#: flight-recorder dump fires, so companion planes (the metrics history
+#: rings — metrics/history.py) dump alongside the event ring and a
+#: crash/SLO-breach/guard-escalation capture carries both.
+_SIBLINGS: List = []
+
+
+def register_sibling(fn) -> None:
+    """Register a `fn(reason)` to run on every dump trigger (idempotent
+    per callable)."""
+    with _hook_lock:
+        if fn not in _SIBLINGS:
+            _SIBLINGS.append(fn)
+
+
+def _run_siblings(reason: str) -> None:
+    for fn in list(_SIBLINGS):
+        # lint: allow-swallow(dump triggers run on failure paths)
+        try:
+            fn(reason)
+        except Exception:  # noqa: BLE001
+            logger.exception("flight-recorder sibling dump failed")
+
 
 def default_out_dir() -> str:
     """HOROVOD_SERVE_FLIGHTREC_DIR, defaulting UNDER the system temp
@@ -73,17 +96,32 @@ def _install_exit_hook() -> None:
 
 def dump_all(reason: str) -> List[str]:
     """Dump every live recorder in this process; returns the paths
-    written.  Never raises — this runs on failure paths."""
+    written.  Never raises — this runs on failure paths.  Siblings run
+    exactly once per trigger, even with zero live recorders (a guard
+    escalation in a training-only process still dumps the history)."""
     paths: List[str] = []
     for rec in list(_RECORDERS):
         # lint: allow-swallow(dump triggers run on failure paths)
         try:
-            p = rec.dump(reason)
+            p = rec.dump(reason, _siblings=False)
             if p:
                 paths.append(p)
         except Exception:  # noqa: BLE001
             logger.exception("flight-recorder dump failed")
+    _run_siblings(reason)
     return paths
+
+
+def record_all(kind: str, data: Optional[Dict] = None,
+               step: Optional[int] = None) -> None:
+    """Append one event to every live recorder (the anomaly monitor's
+    note channel).  Never raises."""
+    for rec in list(_RECORDERS):
+        # lint: allow-swallow(notes are best-effort on failure paths)
+        try:
+            rec.record(kind, data, step=step)
+        except Exception:  # noqa: BLE001
+            logger.debug("flight-recorder note failed", exc_info=True)
 
 
 class FlightRecorder:
@@ -151,11 +189,13 @@ class FlightRecorder:
         return os.path.join(self.out_dir,
                             f"serve_flightrec.{host}.{os.getpid()}.json")
 
-    def dump(self, reason: str) -> str:
+    def dump(self, reason: str, _siblings: bool = True) -> str:
         """Atomically write the ring to ``<dir>/serve_flightrec.
         <host>.<pid>.json`` (tmp + fsync + os.replace, the checkpoint
         publish pattern) and return the path.  Repeated dumps overwrite
-        — the newest ring supersedes older, shorter histories."""
+        — the newest ring supersedes older, shorter histories.
+        ``_siblings=False`` is `dump_all`'s dedupe: it runs them once
+        itself after walking every recorder."""
         with self._lock:
             events = list(self._ring)
             total = self._seq
@@ -172,7 +212,6 @@ class FlightRecorder:
             "dumped_unix": time.time(),
             "events": events,
         }
-        os.makedirs(self.out_dir, exist_ok=True)
         final = self._path()
         tmp = final + ".tmp"
         with open(tmp, "w") as f:
@@ -183,6 +222,8 @@ class FlightRecorder:
         self.dumps.append(final)
         logger.warning("flight recorder dumped %d events to %s (%s)",
                        len(events), final, reason)
+        if _siblings:
+            _run_siblings(reason)
         return final
 
     def close(self) -> None:
@@ -199,4 +240,5 @@ def load_dump(path: str) -> Dict:
     return payload
 
 
-__all__ = ["FlightRecorder", "dump_all", "load_dump"]
+__all__ = ["FlightRecorder", "dump_all", "record_all",
+           "register_sibling", "load_dump"]

@@ -569,6 +569,9 @@ class InferenceServer:
         p99 = self.slo.p99_ms()
         if p99:
             _met.serve_p99_ms.set(p99)
+        # Error-budget gauges ride the same cadence (the burn-rate
+        # signals the autoscaler consumes — docs/TELEMETRY.md).
+        self.slo.export_budget()
 
     def flush_metrics(self) -> None:
         """Unconditional gauge sample — called at drain and atexit so a

@@ -19,21 +19,33 @@ plus a minimum dwell between flips so one outlier step can't toggle
 the compiled-program mix.  Decisions are appended to ``decisions`` —
 ``(step, "spec_on" | "spec_off", p99_ms)`` — so tests replay the
 control trace deterministically from a recorded latency sequence.
+
+The window is a `metrics.history.SortedWindow`: one bisect per insert
+instead of the original deque + full ``np.percentile`` re-sort per
+query, with bitwise-identical p99 output (pinned by test).
+
+Every recorded latency also feeds an error budget
+(`metrics.budget.SloBudget`, exported as ``hvd_slo_budget_remaining``
+/ ``hvd_slo_burn_rate``); with ``burn_rate=True`` the controller flips
+on the budget's multi-window breach latch instead of the raw p99
+threshold — the burn-rate signal tolerates a lone outlier that a p99
+crossing would act on (docs/TELEMETRY.md).
 """
 
 from __future__ import annotations
 
-from collections import deque
 from typing import Callable, List, Optional, Tuple
 
-import numpy as np
-
 from ..common.exceptions import InvalidRequestError
+from ..metrics.budget import SloBudget
+from ..metrics.history import SortedWindow
 
 
 class SloController:
     def __init__(self, slo_ms: Optional[float], window: int = 64,
-                 hysteresis: float = 0.7, dwell_steps: int = 8):
+                 hysteresis: float = 0.7, dwell_steps: int = 8,
+                 budget: Optional[SloBudget] = None,
+                 burn_rate: bool = False):
         """``slo_ms`` None or <= 0 disables the controller (speculation
         stays off unless the server forces it)."""
         if not 0.0 < hysteresis <= 1.0:
@@ -46,7 +58,11 @@ class SloController:
         self.slo_ms = slo_ms if slo_ms and slo_ms > 0 else None
         self.hysteresis = hysteresis
         self.dwell_steps = dwell_steps
-        self._lat = deque(maxlen=window)
+        self._lat = SortedWindow(window)
+        self.budget = budget
+        if self.budget is None and self.slo_ms is not None:
+            self.budget = SloBudget("serve_latency")
+        self.burn_rate = bool(burn_rate)
         self.spec_on = False
         self._last_flip = -(dwell_steps + 1)
         self.decisions: List[Tuple[int, str, float]] = []
@@ -58,27 +74,46 @@ class SloController:
             Callable[[int, str, float], None]] = None
 
     def record(self, step_ms: float) -> None:
-        self._lat.append(float(step_ms))
+        step_ms = float(step_ms)
+        self._lat.append(step_ms)
+        if self.budget is not None and self.slo_ms is not None:
+            self.budget.record_latency(step_ms, self.slo_ms)
 
     def p99_ms(self) -> float:
-        if not self._lat:
+        if not len(self._lat):
             return 0.0
-        return float(np.percentile(np.asarray(self._lat), 99))
+        return self._lat.quantile(99.0)
+
+    def export_budget(self) -> None:
+        """Publish the budget gauges (the server's gauge-flush cadence
+        calls this alongside its own samples)."""
+        if self.budget is not None:
+            self.budget.export()
+
+    def _over(self, p99: float) -> bool:
+        if self.burn_rate and self.budget is not None:
+            return self.budget.breaching()
+        return p99 > self.slo_ms
+
+    def _under(self, p99: float) -> bool:
+        if self.burn_rate and self.budget is not None:
+            return not self.budget.breaching()
+        return p99 < self.slo_ms * self.hysteresis
 
     def update(self, step: int) -> bool:
         """One control decision; returns the (possibly new) spec state."""
-        if self.slo_ms is None or not self._lat:
+        if self.slo_ms is None or not len(self._lat):
             return self.spec_on
         if step - self._last_flip <= self.dwell_steps:
             return self.spec_on
         p99 = self.p99_ms()
-        if not self.spec_on and p99 > self.slo_ms:
+        if not self.spec_on and self._over(p99):
             self.spec_on = True
             self._last_flip = step
             self.decisions.append((step, "spec_on", p99))
             if self.on_flip is not None:
                 self.on_flip(step, "spec_on", p99)
-        elif self.spec_on and p99 < self.slo_ms * self.hysteresis:
+        elif self.spec_on and self._under(p99):
             self.spec_on = False
             self._last_flip = step
             self.decisions.append((step, "spec_off", p99))

@@ -7,7 +7,7 @@ is the analyzer catalog + how-to-add-a-plugin guide.
 """
 
 from .core import Analyzer, Finding, Project, run_all  # noqa: F401
-from .catalogs import FaultPoints, MetricsCatalog
+from .catalogs import AnomalyCatalog, FaultPoints, MetricsCatalog
 from .envvars import EnvVarRegistry
 from .excepts import ExceptionDiscipline
 from .locks import LockDiscipline
@@ -24,6 +24,7 @@ ALL = [
     EnvVarRegistry(),
     ExceptionDiscipline(),
     MetricsCatalog(),
+    AnomalyCatalog(),
     FaultPoints(),
     WireRegistry(),
     PallasGuard(),
@@ -32,5 +33,6 @@ ALL = [
 
 __all__ = ["Analyzer", "Finding", "Project", "run_all", "ALL",
            "LockDiscipline", "JitPurity", "EnvVarRegistry",
-           "ExceptionDiscipline", "MetricsCatalog", "FaultPoints",
-           "WireRegistry", "PallasGuard", "TimelineCatalog"]
+           "ExceptionDiscipline", "MetricsCatalog", "AnomalyCatalog",
+           "FaultPoints", "WireRegistry", "PallasGuard",
+           "TimelineCatalog"]

@@ -92,6 +92,66 @@ class MetricsCatalog(Analyzer):
 
 
 # ---------------------------------------------------------------------------
+# anomaly detector classes <-> docs/TELEMETRY.md detector catalog
+# ---------------------------------------------------------------------------
+
+ANOMALY_MODULE = "horovod_tpu/metrics/anomaly.py"
+TELEMETRY_DOC = "docs/TELEMETRY.md"
+
+_DETECTOR_KIND_RE = re.compile(r"^\s+kind\s*=\s*\"([a-z0-9_]+)\"",
+                               re.MULTILINE)
+_DETECTOR_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`", re.MULTILINE)
+_DETECTOR_SECTION_RE = re.compile(
+    r"<!-- detector-catalog:start -->(.*?)<!-- detector-catalog:end -->",
+    re.DOTALL)
+
+
+class AnomalyCatalog(Analyzer):
+    name = "anomaly-catalog"
+    description = ("every anomaly detector kind documented in the "
+                   "docs/TELEMETRY.md detector catalog, and vice versa")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        root = project.root
+        mod_path = root / ANOMALY_MODULE
+        if not mod_path.is_file():
+            return [Finding(self.name, "error", ANOMALY_MODULE, 1,
+                            f"error: {ANOMALY_MODULE} missing")]
+        declared = set(_DETECTOR_KIND_RE.findall(mod_path.read_text()))
+        if not declared:
+            return [Finding(self.name, "error", ANOMALY_MODULE, 1,
+                            f"error: no `kind = \"...\"` detector classes "
+                            f"found in {ANOMALY_MODULE} (parser out of "
+                            "date?)")]
+        doc_path = root / TELEMETRY_DOC
+        if not doc_path.is_file():
+            return [Finding(self.name, "error", TELEMETRY_DOC, 1,
+                            f"error: {TELEMETRY_DOC} missing — every "
+                            f"detector in {ANOMALY_MODULE} must be "
+                            "documented there")]
+        m = _DETECTOR_SECTION_RE.search(doc_path.read_text())
+        if not m:
+            return [Finding(self.name, "error", TELEMETRY_DOC, 1,
+                            f"error: no <!-- detector-catalog:start/end "
+                            f"--> section in {TELEMETRY_DOC}")]
+        documented = {name for name in _DETECTOR_ROW_RE.findall(m.group(1))
+                      if name != "detector"}
+        for name in sorted(declared - documented):
+            findings.append(Finding(
+                self.name, "undocumented-detector", ANOMALY_MODULE, 1,
+                f"undocumented anomaly detector: {name} (kind declared in "
+                f"{ANOMALY_MODULE}, no detector-catalog row in "
+                f"{TELEMETRY_DOC})"))
+        for name in sorted(documented - declared):
+            findings.append(Finding(
+                self.name, "stale-doc-entry", TELEMETRY_DOC, 1,
+                f"stale doc entry: {name} (in the {TELEMETRY_DOC} detector "
+                f"catalog, no matching kind in {ANOMALY_MODULE})"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
 # fault-point catalog <-> call sites <-> docs/FAULT_TOLERANCE.md
 # ---------------------------------------------------------------------------
 

@@ -570,6 +570,44 @@ def test_metrics_catalog_catches_sharded_gauge_doc_drift(tmp_path):
         ("undocumented-metric", True)]
 
 
+def test_anomaly_catalog_clean_on_repo():
+    """Detector kinds in metrics/anomaly.py and the TELEMETRY.md
+    detector table must agree on the real tree."""
+    from hvdlint import AnomalyCatalog
+    assert AnomalyCatalog().run(Project(REPO)) == []
+
+
+def test_anomaly_catalog_catches_undocumented_detector(tmp_path):
+    """A new detector class with no TELEMETRY.md row must be flagged."""
+    from hvdlint import AnomalyCatalog
+    src = _repo_text("horovod_tpu/metrics/anomaly.py") + (
+        "\n\nclass MadDetector:\n    kind = \"mad_outlier\"\n")
+    proj = make_project(tmp_path, {
+        "horovod_tpu/metrics/anomaly.py": src,
+        "docs/TELEMETRY.md": _repo_text("docs/TELEMETRY.md"),
+    })
+    findings = AnomalyCatalog().run(proj)
+    assert [(f.rule, "mad_outlier" in f.message) for f in findings] == [
+        ("undocumented-detector", True)]
+
+
+def test_anomaly_catalog_catches_stale_doc_row(tmp_path):
+    """A detector-catalog row whose class is gone must be flagged."""
+    from hvdlint import AnomalyCatalog
+    doc = _repo_text("docs/TELEMETRY.md").replace(
+        "<!-- detector-catalog:end -->",
+        "| `ghost_detector` | nothing | never |\n"
+        "<!-- detector-catalog:end -->")
+    proj = make_project(tmp_path, {
+        "horovod_tpu/metrics/anomaly.py":
+            _repo_text("horovod_tpu/metrics/anomaly.py"),
+        "docs/TELEMETRY.md": doc,
+    })
+    findings = AnomalyCatalog().run(proj)
+    assert [(f.rule, "ghost_detector" in f.message) for f in findings] \
+        == [("stale-doc-entry", True)]
+
+
 def test_metrics_catalog_catches_ag_fusion_knob_drift(tmp_path):
     """Strip the `ag_fusion` mention from a copy of docs/AUTOTUNE.md:
     the analyzer must report the knob as undocumented."""

@@ -619,6 +619,18 @@ class TestChaosSoakFast:
         assert kinds["nan_grad"]["steps_lost"] >= 1, kinds
         # Reactions were computed in lockstep on every rank.
         assert res[1]["reactions"] == out["reactions"]
+        # Anomaly detectors (docs/TELEMETRY.md): the injected faults
+        # are ground truth — at least one injected kind must be
+        # flagged by the step-time / step-counter monitors, every trip
+        # must attribute to an injection (zero false positives on
+        # clean steps), and trips name the offending series.
+        anom = out["anomaly"]
+        assert anom["false_positives"] == 0, anom["events"]
+        assert len(anom["detected_kinds"]) >= 1, anom
+        assert set(anom["detected_kinds"]) <= set(anom["injected_kinds"])
+        for ev in anom["events"]:
+            assert ev["series"] in ("hvd_critical_path_ms",
+                                    "hvd_steps_total"), ev
 
 
 @pytest.mark.slow

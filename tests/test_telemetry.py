@@ -1,0 +1,556 @@
+"""Telemetry plane: history rings, SLO error budgets, anomaly
+detectors, the flight-recorder sibling hook, /healthz liveness and the
+`hvd top` console (docs/TELEMETRY.md).
+
+Budgets and detectors are driven with hand-computed fixtures — every
+burn rate and z-score asserted here was derived on paper first, so a
+regression is an arithmetic change, not a snapshot diff.
+"""
+
+import json
+import math
+import os
+import random
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.metrics import exposition
+from horovod_tpu.metrics.anomaly import (
+    AnomalyMonitor, CounterStallDetector, EwmaDetector)
+from horovod_tpu.metrics.budget import SloBudget
+from horovod_tpu.metrics.history import (
+    MetricsHistory, Ring, SortedWindow, _hist_delta_quantile, quantile)
+from horovod_tpu.metrics.registry import MetricsRegistry
+from horovod_tpu.serve.slo import SloController
+
+
+# ---------------------------------------------------------------------------
+# quantile / SortedWindow
+# ---------------------------------------------------------------------------
+
+def test_quantile_matches_numpy_percentile():
+    rng = random.Random(11)
+    for n in (1, 2, 3, 7, 64, 101):
+        vals = sorted(rng.uniform(-50, 50) for _ in range(n))
+        for q in (0.0, 25.0, 50.0, 90.0, 99.0, 100.0):
+            assert quantile(vals, q) == pytest.approx(
+                float(np.percentile(vals, q)), abs=1e-12)
+
+
+def test_sorted_window_parity_with_eviction():
+    """After wraparound the window must equal np.percentile over the
+    surviving suffix — the eviction bisect must remove the right
+    element even with duplicates."""
+    rng = random.Random(7)
+    win = SortedWindow(16)
+    seq = [round(rng.uniform(0, 10), 1) for _ in range(100)]  # dupes
+    for i, v in enumerate(seq):
+        win.append(v)
+        tail = seq[max(0, i - 15):i + 1]
+        assert len(win) == len(tail)
+        assert win.quantile(99.0) == pytest.approx(
+            float(np.percentile(tail, 99.0)), abs=1e-12)
+
+
+def test_sorted_window_empty_and_bounds():
+    win = SortedWindow(4)
+    assert win.quantile(50.0) == 0.0
+    with pytest.raises(ValueError):
+        SortedWindow(0)
+    with pytest.raises(ValueError):
+        quantile([], 50.0)
+
+
+# ---------------------------------------------------------------------------
+# Ring + MetricsHistory
+# ---------------------------------------------------------------------------
+
+def test_ring_wraparound_keeps_newest():
+    ring = Ring(depth=4)
+    for i in range(10):
+        ring.append(float(i), float(i * 10))
+    assert ring.points() == [(6.0, 60.0), (7.0, 70.0),
+                             (8.0, 80.0), (9.0, 90.0)]
+    assert len(ring) == 4
+
+
+def test_history_counter_rate_simple():
+    h = MetricsHistory(depth=16)
+    for ts, v in ((0.0, 0.0), (1.0, 5.0), (2.0, 10.0)):
+        h.record("c", v, kind="counter", ts=ts)
+    assert h.rate("c") == pytest.approx(5.0)
+
+
+def test_history_counter_rate_handles_reset():
+    """A counter that drops restarted (worker respawn): the post-reset
+    value is the increment, PromQL-rate style.  0->8, reset, 0->2 over
+    10s = (8 + 2) / 10."""
+    h = MetricsHistory(depth=16)
+    for ts, v in ((0.0, 0.0), (5.0, 8.0), (7.0, 0.0), (10.0, 2.0)):
+        h.record("c", v, kind="counter", ts=ts)
+    assert h.rate("c") == pytest.approx(1.0)
+
+
+def test_history_rate_window_filter():
+    h = MetricsHistory(depth=16)
+    for ts, v in ((0.0, 0.0), (10.0, 100.0), (11.0, 101.0),
+                  (12.0, 102.0)):
+        h.record("c", v, kind="counter", ts=ts)
+    assert h.rate("c", window_s=2.5, now=12.0) == pytest.approx(1.0)
+    assert h.rate("c", window_s=0.5, now=12.0) is None  # one point
+
+
+def test_window_stats_fixture():
+    h = MetricsHistory(depth=32)
+    for i, v in enumerate([3.0, 1.0, 4.0, 1.0, 5.0]):
+        h.record("g", v, ts=float(i))
+    st = h.window_stats("g")
+    assert st["n"] == 5
+    assert st["min"] == 1.0 and st["max"] == 5.0
+    assert st["mean"] == pytest.approx(2.8)
+    assert st["p50"] == pytest.approx(3.0)
+    assert st["p99"] == pytest.approx(
+        float(np.percentile([3.0, 1.0, 4.0, 1.0, 5.0], 99)))
+
+
+def test_hist_delta_quantile_interpolates():
+    # One bucket (1.0, 2.0] holding all 10 observations: p50 lands
+    # mid-bucket by linear interpolation.
+    bounds = [1.0, 2.0, float("inf")]
+    assert _hist_delta_quantile(bounds, [0, 10, 0], 50.0) == \
+        pytest.approx(1.5)
+    # +Inf bucket clamps to the last finite bound.
+    assert _hist_delta_quantile(bounds, [0, 0, 4], 99.0) == 2.0
+    assert _hist_delta_quantile(bounds, [0, 0, 0], 50.0) is None
+
+
+def test_history_samples_registry_series():
+    reg = MetricsRegistry()
+    c = reg.counter("hvd_t_ticks_total", "ticks")
+    g = reg.gauge("hvd_t_level", "level", ("which",))
+    hist_m = reg.histogram("hvd_t_lat_seconds", "lat",
+                           buckets=(0.1, 1.0))
+    h = MetricsHistory(depth=8, registry=reg)
+    c.inc(3)
+    g.labels("a").set(7.5)
+    hist_m.observe(0.05)
+    h.sample(now=100.0)
+    hist_m.observe(0.5)
+    hist_m.observe(0.6)
+    h.sample(now=101.0)
+    assert h.points("hvd_t_ticks_total") == [(100.0, 3.0), (101.0, 3.0)]
+    assert h.points("hvd_t_level", ("a",)) == [(100.0, 7.5),
+                                               (101.0, 7.5)]
+    # count ring is cumulative; delta-p50 covers only the 2 new obs.
+    assert h.points("hvd_t_lat_seconds:count") == [(100.0, 1.0),
+                                                   (101.0, 3.0)]
+    (ts, p50), = h.points("hvd_t_lat_seconds:p50")
+    assert ts == 101.0
+    assert 0.1 < p50 <= 1.0
+    assert h.samples_taken == 2
+
+
+def test_history_dump_roundtrip(tmp_path):
+    h = MetricsHistory(depth=8)
+    h.record("g", 1.25, ts=1.0)
+    h.record("g", 2.5, ts=2.0)
+    h.record("c", 4.0, labels=("x",), kind="counter", ts=2.0)
+    path = str(tmp_path / "hist" / "dump.jsonl")
+    out = h.dump("unit-test", path=path)
+    assert out == path
+    lines = [json.loads(ln) for ln in
+             open(path).read().splitlines()]
+    header, series = lines[0], lines[1:]
+    assert header["reason"] == "unit-test"
+    assert header["depth"] == 8
+    by_name = {(s["series"], tuple(s["labels"])): s for s in series}
+    assert by_name[("g", ())]["points"] == [[1.0, 1.25], [2.0, 2.5]]
+    assert by_name[("c", ("x",))]["kind"] == "counter"
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_flightrec_trigger_dumps_history(tmp_path, monkeypatch):
+    """Any flight-recorder dump trigger must also dump the history —
+    the sibling contract (docs/TELEMETRY.md)."""
+    from horovod_tpu.metrics import history as hist_mod
+    from horovod_tpu.serve import flightrec
+
+    monkeypatch.setenv("HOROVOD_METRICS_HISTORY_DIR", str(tmp_path))
+    hist_mod.stop_history()
+    try:
+        h = hist_mod.start_history(interval=3600.0)
+        h.record("g", 1.0, ts=1.0)
+        fr_dir = tmp_path / "fr"
+        fr_dir.mkdir()
+        rec = flightrec.FlightRecorder(depth=8, out_dir=str(fr_dir))
+        try:
+            rec.record("tick", {"n": 1})
+            rec.dump("unit-test")
+            dumped = [f for f in os.listdir(tmp_path)
+                      if f.startswith("metrics_history.")]
+            assert len(dumped) == 1
+            header = json.loads(open(
+                tmp_path / dumped[0]).readline())
+            assert header["reason"] == "unit-test"
+        finally:
+            flightrec._RECORDERS.discard(rec)
+    finally:
+        hist_mod.stop_history()
+
+
+# ---------------------------------------------------------------------------
+# SLO error budgets
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_hand_fixture():
+    """target 0.9 => 10% error allowance.  2 bad of 20 in-window is a
+    10% bad fraction = burn 1.0; 4 bad of 10 is burn 4.0."""
+    b = SloBudget("t", target=0.9, budget_window_s=1000.0,
+                  fast_window_s=10.0, slow_window_s=100.0)
+    for i in range(20):
+        b.record(i not in (3, 7), now=float(i))
+    assert b.burn_rate(1000.0, now=19.0) == pytest.approx(1.0)
+
+    b2 = SloBudget("t2", target=0.9, budget_window_s=1000.0)
+    for i in range(10):
+        b2.record(i >= 4, now=float(i))
+    assert b2.burn_rate(1000.0, now=9.0) == pytest.approx(4.0)
+    # Empty window burns nothing.
+    assert b2.burn_rate(0.5, now=100.0) == 0.0
+
+
+def test_budget_remaining_fixture():
+    """target 0.9, 20 events => allowance 2 bad.  1 bad spends half,
+    2 spends all, 3 overdraws."""
+    for n_bad, expect in ((0, 1.0), (1, 0.5), (2, 0.0), (3, -0.5)):
+        b = SloBudget("t", target=0.9, budget_window_s=1000.0)
+        for i in range(20):
+            b.record(i >= n_bad, now=float(i))
+        assert b.budget_remaining(now=19.0) == pytest.approx(expect)
+    assert SloBudget("empty", target=0.9).budget_remaining() == 1.0
+
+
+def test_budget_window_ages_out():
+    b = SloBudget("t", target=0.9, budget_window_s=10.0)
+    b.record(False, now=0.0)
+    for i in range(1, 10):
+        b.record(True, now=float(i))
+    assert b.budget_remaining(now=9.0) < 1.0
+    # The bad event falls out of the budget window.
+    for i in range(11, 16):
+        b.record(True, now=float(i))
+    assert b.budget_remaining(now=15.0) == 1.0
+
+
+def test_breaching_needs_both_windows_and_latches():
+    b = SloBudget("t", target=0.9, budget_window_s=1000.0,
+                  fast_window_s=10.0, slow_window_s=100.0)
+    # 100s of clean traffic, then a 10s burst of 50% bad: fast window
+    # burns 5x but the slow window holds under 1x -> no page.
+    t = 0.0
+    for i in range(100):
+        b.record(True, now=float(i))
+    for i in range(10):
+        t = 100.0 + i
+        b.record(i % 2 == 0, now=t)
+    assert b.burn_rate(10.0, now=t) >= 1.0
+    assert b.burn_rate(100.0, now=t) < 1.0
+    assert not b.breaching(now=t)
+    # Sustain the burst until the slow window burns too -> breach...
+    for i in range(90):
+        t = 110.0 + i
+        b.record(i % 2 == 0, now=t)
+    assert b.breaching(now=t)
+    # ...which latches until BOTH windows drop under half threshold.
+    assert b.breaching(now=t + 1)
+    for i in range(200):
+        t = 200.0 + i
+        b.record(True, now=t)
+    assert not b.breaching(now=t)
+
+
+def test_budget_export_sets_gauges():
+    from horovod_tpu.metrics import catalog as met
+    b = SloBudget("unit_export", target=0.9, budget_window_s=1000.0,
+                  fast_window_s=10.0, slow_window_s=100.0)
+    for i in range(20):
+        b.record(i != 0, now=float(i))
+    b.export(now=19.0)
+    assert met.slo_budget_remaining.labels("unit_export").get() == \
+        pytest.approx(0.5)
+    fast = met.slo_burn_rate.labels("unit_export", "fast").get()
+    slow = met.slo_burn_rate.labels("unit_export", "slow").get()
+    assert fast == pytest.approx(0.0)   # bad event left the fast window
+    assert slow == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# Anomaly detectors
+# ---------------------------------------------------------------------------
+
+def test_ewma_no_trip_during_warmup_or_steady_state():
+    det = EwmaDetector(warmup=8, z_thresh=4.0)
+    rng = random.Random(3)
+    for _ in range(200):
+        assert det.update(20.0 + rng.uniform(-0.5, 0.5)) is None
+
+
+def test_ewma_trips_on_spike_score_is_pre_update():
+    det = EwmaDetector(alpha=0.3, warmup=4, z_thresh=4.0,
+                       rel_floor=0.25)
+    for _ in range(20):
+        det.update(10.0)
+    # Near-constant series: std floors at rel_floor * mean, and the
+    # score uses the baseline BEFORE the spike is absorbed.
+    m, floor = det.mean, max(det.min_std, det.rel_floor * det.mean)
+    std = max(det.std, floor)
+    assert m == pytest.approx(10.0, rel=1e-2)
+    z = det.update(100.0)
+    assert z == pytest.approx((100.0 - m) / std)
+
+
+def test_ewma_one_sided_ignores_improvement():
+    det = EwmaDetector(warmup=4, z_thresh=3.0, one_sided=True)
+    for _ in range(20):
+        det.update(100.0)
+    assert det.update(1.0) is None  # faster is never an anomaly
+    two = EwmaDetector(warmup=4, z_thresh=3.0, one_sided=False)
+    for _ in range(20):
+        two.update(100.0)
+    assert two.update(1.0) is not None
+
+
+def test_ewma_level_shift_trips_once_then_absorbs():
+    det = EwmaDetector(alpha=0.5, warmup=4, z_thresh=4.0)
+    for _ in range(10):
+        det.update(10.0)
+    trips = [det.update(100.0) is not None for _ in range(10)]
+    assert trips[0] is True
+    assert sum(trips) <= 2  # the new level becomes the baseline
+    assert det.mean == pytest.approx(100.0, rel=1e-3)
+
+
+def test_counter_stall_detector_trips_once_and_rearms():
+    det = CounterStallDetector(stall_samples=3)
+    trips = [det.update(v) for v in
+             [0, 1, 2, 2, 2, 2, 2, 2, 3, 3, 3, 3]]
+    # First sample primes; stall trips exactly when the 3rd flat
+    # sample lands; movement re-arms and the second stall trips again.
+    assert [t is not None for t in trips] == [
+        False, False, False, False, False, True,
+        False, False, False, False, False, True]
+    assert det.stalled
+
+
+def test_counter_stall_needs_prior_movement():
+    det = CounterStallDetector(stall_samples=2)
+    assert all(det.update(0.0) is None for _ in range(10))
+    assert not det.stalled  # never moved => not "stalled", just idle
+
+
+def test_monitor_records_active_and_clears():
+    mon = AnomalyMonitor(z_thresh=4.0, warmup=4, emit=False)
+    for i in range(20):
+        mon.observe("s", 10.0, step=i)
+    a = mon.observe("s", 100.0, step=20)
+    assert a is not None and a.series == "s" and a.kind == "ewma_z"
+    assert "s" in mon.active and mon.events == [a]
+    # Back under half-threshold clears the active flag.
+    for i in range(30):
+        mon.observe("s", 10.0, step=21 + i)
+    assert "s" not in mon.active
+    assert len(mon.events) == 1
+
+
+def test_monitor_emits_metrics_and_flightrec(tmp_path):
+    from horovod_tpu.metrics import catalog as met
+    from horovod_tpu.serve import flightrec
+
+    before = met.anomaly_events.labels("hvd_unit_series",
+                                       "ewma_z").get()
+    rec = flightrec.FlightRecorder(depth=8,
+                                   out_dir=str(tmp_path))
+    try:
+        mon = AnomalyMonitor(z_thresh=4.0, warmup=4)
+        for i in range(10):
+            mon.observe("hvd_unit_series", 5.0, step=i)
+        assert mon.observe("hvd_unit_series", 500.0, step=10) is not None
+        assert met.anomaly_events.labels(
+            "hvd_unit_series", "ewma_z").get() == before + 1
+        assert met.anomaly_active._solo().get() >= 1
+        kinds = [e["kind"] for e in rec.snapshot()]
+        assert "anomaly" in kinds
+    finally:
+        flightrec._RECORDERS.discard(rec)
+
+
+def test_monitor_watch_scans_history_series():
+    h = MetricsHistory(depth=32)
+    mon = AnomalyMonitor(z_thresh=4.0, warmup=4, emit=False)
+    mon.watch(h, gauges=("hvd_g",), counters=("hvd_c",))
+    for i in range(12):
+        h.record("hvd_g", 10.0, ts=float(i))
+        h.record("hvd_c", float(i), kind="counter", ts=float(i))
+        h.sample(now=float(i))
+    h.record("hvd_g", 200.0, ts=12.0)
+    h.record("hvd_c", 11.0, kind="counter", ts=12.0)  # counter stalls
+    for i in range(12, 20):
+        h.sample(now=float(i))
+    kinds = {(e.series, e.kind) for e in mon.events}
+    assert ("hvd_g", "ewma_z") in kinds
+    assert ("hvd_c", "counter_stall") in kinds
+
+
+# ---------------------------------------------------------------------------
+# SloController integration
+# ---------------------------------------------------------------------------
+
+def test_slo_controller_p99_parity_pinned():
+    """The ring-backed p99 must equal np.percentile over the window —
+    the original implementation's exact output on a fixed sequence."""
+    rng = random.Random(42)
+    ctl = SloController(slo_ms=50.0, window=64)
+    seq = [rng.uniform(1.0, 100.0) for _ in range(200)]
+    for i, v in enumerate(seq):
+        ctl.record(v)
+        expect = float(np.percentile(seq[max(0, i - 63):i + 1], 99))
+        assert ctl.p99_ms() == pytest.approx(expect, abs=1e-12)
+
+
+def test_slo_controller_burn_rate_mode_follows_breach_latch():
+    """burn_rate=True swaps the raw p99 crossings for the budget's
+    breach latch: the same recorded latencies flip speculation when
+    (and only when) the budget reports a breach."""
+    budget = SloBudget("unit_ctl", target=0.9)
+    ctl = SloController(slo_ms=50.0, window=8, dwell_steps=0,
+                        budget=budget, burn_rate=True)
+    state = {"breach": False}
+    budget.breaching = lambda now=None: state["breach"]
+    ctl.record(90.0)  # p99 over slo_ms, but the budget says no breach
+    assert ctl.update(0) is False
+    state["breach"] = True
+    assert ctl.update(1) is True
+    ctl.record(10.0)
+    assert ctl.update(2) is True  # still breached: p99 has no say
+    state["breach"] = False
+    assert ctl.update(3) is False
+
+
+def test_slo_controller_default_budget_armed():
+    ctl = SloController(slo_ms=50.0)
+    assert ctl.budget is not None and ctl.budget.name == "serve_latency"
+    ctl.record(10.0)
+    assert len(ctl.budget._events) == 1
+    assert SloController(slo_ms=None).budget is None
+
+
+# ---------------------------------------------------------------------------
+# /healthz liveness
+# ---------------------------------------------------------------------------
+
+def test_healthz_503_when_probe_unhealthy():
+    port = exposition.start_server(0, addr="127.0.0.1")
+    try:
+        exposition.set_liveness_probe(
+            lambda: (False, "heartbeat stale: 99s"))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert ei.value.code == 503
+        assert b"stale" in ei.value.read()
+        exposition.set_liveness_probe(lambda: (True, "ok (manual)"))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert r.read() == b"ok (manual)\n"
+        # A probe that raises reads as unhealthy, never as a 500.
+        def boom():
+            raise RuntimeError("probe broke")
+        exposition.set_liveness_probe(boom)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert ei.value.code == 503
+    finally:
+        exposition.set_liveness_probe(None)
+        exposition.stop_server()
+
+
+def test_default_liveness_tracks_heartbeat_age(monkeypatch):
+    from horovod_tpu.runner import elastic_worker as ew
+
+    monkeypatch.setenv("HOROVOD_ELASTIC_LEASE_TTL", "15")
+    monkeypatch.setattr(ew, "_last_beat_monotonic", None)
+    ok, _ = exposition._default_liveness()
+    assert ok  # no heartbeats yet: process up == alive
+    import time as _time
+    monkeypatch.setattr(ew, "_last_beat_monotonic", _time.monotonic())
+    ok, detail = exposition._default_liveness()
+    assert ok and "heartbeat" in detail
+    monkeypatch.setattr(ew, "_last_beat_monotonic",
+                        _time.monotonic() - 100.0)
+    ok, detail = exposition._default_liveness()
+    assert not ok and "stale" in detail
+
+
+# ---------------------------------------------------------------------------
+# hvd top console
+# ---------------------------------------------------------------------------
+
+def test_sparkline_shapes():
+    from horovod_tpu.metrics.top import sparkline
+    assert sparkline([]) == ""
+    assert sparkline([5.0, 5.0, 5.0]) == "▁▁▁"
+    line = sparkline([0, 1, 2, 3, 4, 5, 6, 7])
+    assert line == "▁▂▃▄▅▆▇█"
+    assert len(sparkline(list(range(100)), width=32)) == 32
+
+
+def test_top_once_smoke(capsys):
+    """`python -m horovod_tpu.metrics top --once --scrape ...` against
+    a live exposition server renders one frame and exits 0."""
+    from horovod_tpu.metrics import catalog as met
+    from horovod_tpu.metrics.__main__ import main
+
+    met.steps.inc(5)
+    met.slo_budget_remaining.labels("serve_latency").set(0.75)
+    met.slo_burn_rate.labels("serve_latency", "fast").set(2.0)
+    met.slo_burn_rate.labels("serve_latency", "slow").set(1.5)
+    met.anomaly_events.labels("hvd_critical_path_ms", "ewma_z").inc()
+    port = exposition.start_server(0, addr="127.0.0.1")
+    try:
+        rc = main(["top", "--once", "--scrape", f"127.0.0.1:{port}"])
+    finally:
+        exposition.stop_server()
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "hvd top" in out
+    assert "SLO serve_latency" in out
+    assert "budget 75.0%" in out
+    assert "hvd_critical_path_ms [ewma_z]" in out
+    assert "\x1b[" not in out  # --once never emits ANSI color
+
+
+def test_top_once_no_snapshots_exits_nonzero(capsys):
+    from horovod_tpu.metrics.top import run_top
+    rc = run_top(lambda: [], once=True)
+    assert rc == 1
+    assert "no metrics snapshots" in capsys.readouterr().out
+
+
+def test_top_state_derives_rates_from_polls():
+    from horovod_tpu.metrics.top import TopState
+
+    def snap(ts, steps):
+        return [{"rank": 0, "ts": ts, "metrics": {
+            "hvd_steps_total": {"kind": "counter", "labelnames": [],
+                                "samples": [[[], float(steps)]]}}}]
+    st = TopState()
+    st.update(snap(0.0, 100), now=0.0)
+    st.update(snap(2.0, 110), now=2.0)
+    assert st.series("steps/s") == [pytest.approx(5.0)]
+    # Counter reset (respawn): rate restarts from the new total.
+    st.update(snap(4.0, 6), now=4.0)
+    assert st.series("steps/s")[-1] == pytest.approx(3.0)
