@@ -646,6 +646,147 @@ def main_chaos():
     emit(rec)
 
 
+def run_autoscale_child() -> None:
+    """`bench.py --autoscale-child`: the autoscaler A/B + scale-event
+    chaos (horovod_tpu/serve/autoscale.py, docs/AUTOSCALE.md), result
+    JSON written to $HVD_AUTOSCALE_OUT.
+
+    For each traffic shape the same seeded trace drives the REAL
+    decision core twice — autoscaled vs a static fleet pinned at the
+    autoscaled run's MEAN size (same chips, only the control loop
+    differs) — and records SLO-violation-minutes and chip-hours.  The
+    bursty shape is the acceptance anchor: autoscaling must win on
+    violation-minutes at the same mean size.  Then run_scale_chaos
+    fires serve.replica_die DURING live grow events on a real replica
+    fleet and must report every event recovered digest-verified."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from horovod_tpu.serve.autoscale import (
+        AutoscaleConfig,
+        run_scale_chaos,
+        simulate_autoscale,
+    )
+    from horovod_tpu.serve.loadgen import make_shaped_trace
+
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=8,
+                          cooldown_steps=4, dwell_steps=2, grow_step=2)
+    shapes = {
+        "burst": dict(base_every=4.0, burst_every=128, burst_size=80),
+        "diurnal": dict(base_every=4.0, period=256, amplitude=0.9),
+        "multi_tenant": dict(base_every=4.0),
+    }
+    ab = {}
+    for shape, kw in shapes.items():
+        trace = make_shaped_trace(shape, 7, 500, 64, **kw)
+        auto = simulate_autoscale(trace, cfg)
+        static = simulate_autoscale(
+            trace, cfg, static_size=max(1, round(auto["fleet_mean"])))
+        ab[shape] = {"autoscaled": auto, "static": static,
+                     "violation_minutes_saved": round(
+                         static["slo_violation_minutes"]
+                         - auto["slo_violation_minutes"], 4)}
+
+    chaos = run_scale_chaos(
+        n_events=int(os.environ.get("HVD_AUTOSCALE_EVENTS", "2")),
+        seed=0)
+    with open(os.environ["HVD_AUTOSCALE_OUT"], "w") as f:
+        json.dump({"ab": ab, "scale_chaos": chaos}, f)
+
+
+def autoscale_report(timeout: float = 600.0) -> dict:
+    """Autoscale extra: run the child out-of-process (the parent never
+    imports the package) and flatten its record."""
+    out = tempfile.mkdtemp(prefix="bench_autoscale_")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HVD_AUTOSCALE_OUT"] = os.path.join(out, "autoscale.json")
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--autoscale-child"],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    if r.returncode != 0:
+        log(f"autoscale child rc={r.returncode} "
+            f"stderr tail: {r.stderr[-1500:]}")
+        return {}
+    with open(env["HVD_AUTOSCALE_OUT"]) as f:
+        res = json.load(f)
+    burst = res["ab"]["burst"]
+    chaos = res["scale_chaos"]
+    return {
+        "ab": res["ab"],
+        "burst_auto_violation_minutes":
+            burst["autoscaled"]["slo_violation_minutes"],
+        "burst_static_violation_minutes":
+            burst["static"]["slo_violation_minutes"],
+        "burst_fleet_mean": burst["autoscaled"]["fleet_mean"],
+        "burst_chip_hours": burst["autoscaled"]["chip_hours"],
+        "autoscaled_wins_burst":
+            burst["autoscaled"]["slo_violation_minutes"]
+            < burst["static"]["slo_violation_minutes"],
+        "scale_chaos": chaos,
+        "scale_events": len(chaos.get("events", [])),
+        "scale_events_faulted": sum(
+            1 for e in chaos.get("events", []) if e["faulted"]),
+        "all_recovered": chaos.get("all_recovered", False),
+    }
+
+
+def main_autoscale():
+    """`bench.py --autoscale`: run the autoscale extra standalone and
+    append the record to BENCH_autoscale.json (JSON lines, same
+    provenance stamps and HOROVOD_BENCH_CACHE_MAX_AGE_H stale gate as
+    the other bench files)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(repo, "BENCH_autoscale.json")
+    prev = None
+    if os.path.exists(path):
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        if lines:
+            prev = json.loads(lines[-1])
+            age_h = (time.time()
+                     - prev.get("captured_unix", 0.0)) / 3600.0
+            prev["stale"] = age_h > CACHE_MAX_AGE_H
+            if prev["stale"]:
+                log(f"previous autoscale record is {age_h:.1f}h old "
+                    f"(> {CACHE_MAX_AGE_H:g}h gate) — not comparing")
+    try:
+        rec = autoscale_report()
+    except Exception as e:  # noqa: BLE001
+        log(f"autoscale bench failed: {type(e).__name__}: {e}")
+        rec = {}
+    if not rec:
+        emit({"bench": "autoscale",
+              "error": "autoscale bench failed; see stderr"})
+        sys.exit(1)
+    rec = {"bench": "autoscale", **rec}
+    if (prev is not None and not prev.get("stale")
+            and prev.get("bench") == "autoscale"
+            and prev.get("burst_auto_violation_minutes") is not None
+            and rec.get("burst_auto_violation_minutes") is not None
+            and prev["burst_auto_violation_minutes"] > 0):
+        rec["burst_violation_vs_prev"] = round(
+            rec["burst_auto_violation_minutes"]
+            / prev["burst_auto_violation_minutes"], 3)
+    now = time.time()
+    rec["captured_unix"] = now
+    rec["captured_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime(now))
+    with open(path, "a") as f:
+        f.write(json.dumps(rec, sort_keys=True) + "\n")
+    log(f"autoscale burst: auto {rec['burst_auto_violation_minutes']} "
+        f"vs static {rec['burst_static_violation_minutes']} "
+        f"violation-minutes at mean fleet {rec['burst_fleet_mean']} "
+        f"(wins={rec['autoscaled_wins_burst']}); scale chaos "
+        f"{rec['scale_events']} events "
+        f"({rec['scale_events_faulted']} faulted), "
+        f"all_recovered={rec['all_recovered']}")
+    emit(rec)
+
+
 def run_obs_child() -> None:
     """`bench.py --obs-child`: sampler-overhead A/B for the telemetry
     history plane (horovod_tpu/metrics/history.py, docs/TELEMETRY.md),
@@ -1529,6 +1670,10 @@ if __name__ == "__main__":
         run_chaos_child()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--chaos":
         main_chaos()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--autoscale-child":
+        run_autoscale_child()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--autoscale":
+        main_autoscale()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--obs-child":
         run_obs_child()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--obs":

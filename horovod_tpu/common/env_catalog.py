@@ -472,6 +472,47 @@ CATALOG: Tuple[EnvVar, ...] = (
        "so crash dumps never land in (and get committed from) the "
        "working tree.",
        "SERVING.md"),
+    _v("HOROVOD_AUTOSCALE_MIN_REPLICAS", "1", "serve",
+       "Floor of the autoscaled decode fleet; shrink never retires "
+       "below it (the budget latch additionally forbids any shrink "
+       "while the SLO budget is breaching).",
+       "AUTOSCALE.md"),
+    _v("HOROVOD_AUTOSCALE_MAX_REPLICAS", "8", "serve",
+       "Ceiling of the autoscaled decode fleet; pressure beyond it "
+       "walks the degrade ladder instead (borrow training chips, then "
+       "priority shed).",
+       "AUTOSCALE.md"),
+    _v("HOROVOD_AUTOSCALE_COOLDOWN", "32", "serve",
+       "Observations after a scale event during which no further "
+       "event fires; reversals wait twice as long (anti-flap). "
+       "Autotuner knob autoscale_cooldown, host_only.",
+       "AUTOSCALE.md"),
+    _v("HOROVOD_AUTOSCALE_DWELL", "8", "serve",
+       "Consecutive observations a pressure/relief condition must "
+       "persist before a scale event fires (the hysteresis dwell, "
+       "same idea as the SLO controller's). Autotuner knob "
+       "autoscale_dwell, host_only.",
+       "AUTOSCALE.md"),
+    _v("HOROVOD_AUTOSCALE_OCC_HIGH", "0.85", "serve",
+       "Occupancy high watermark: sustained occupancy at or above it "
+       "WITH a backlog is scale-up pressure.",
+       "AUTOSCALE.md"),
+    _v("HOROVOD_AUTOSCALE_OCC_LOW", "0.30", "serve",
+       "Occupancy low watermark: sustained occupancy at or below it "
+       "with an empty queue and a healthy error budget is scale-down "
+       "relief.",
+       "AUTOSCALE.md"),
+    _v("HOROVOD_AUTOSCALE_QUEUE_MS", "1000", "serve",
+       "Head-of-line queue-wait threshold in ms; the oldest queued "
+       "request waiting past it is scale-up pressure regardless of "
+       "occupancy (0 disables the signal).",
+       "AUTOSCALE.md"),
+    _v("HOROVOD_AUTOSCALE_TENANT_CLASSES", "premium:0,standard:1,batch:2",
+       "serve",
+       "Tenant SLO classes as name:priority pairs (lower = more "
+       "important); priority load-shedding drops the highest-number "
+       "class first, newest requests first.",
+       "AUTOSCALE.md"),
     _v("HOROVOD_RESHARD_PEAK_BYTES", "67108864", "reshard",
        "Per-host staging ceiling of a live reshard in bytes; chunks "
        "are sized to at most a quarter of it and the measured peak is "

@@ -266,6 +266,24 @@ serve_e2e_latency = _REG.histogram(
     "End-to-end request latency: submit to completion/eviction "
     "(= queue delay + prefill + decode).")
 
+# -- autoscaling (horovod_tpu/serve/autoscale.py, docs/AUTOSCALE.md) --------
+autoscale_fleet_size = _REG.gauge(
+    "hvd_autoscale_fleet_size",
+    "Live decode replicas under autoscale control (after the last "
+    "scale event's convergence; borrowed training chips count while "
+    "on loan).")
+autoscale_events = _REG.counter(
+    "hvd_autoscale_events_total",
+    "Scale events by verdict (grow/shrink/borrow/handback/shed; an "
+    "event that hits a mid-actuation fault also counts under "
+    "'aborted').",
+    ("verdict",))
+autoscale_shed = _REG.counter(
+    "hvd_autoscale_shed_total",
+    "Requests dropped by priority load-shedding — the degrade rung "
+    "below shrink: lowest tenant SLO class first, newest first, "
+    "queued only (admitted work always finishes).")
+
 # -- telemetry plane (metrics/{budget,anomaly}.py, docs/TELEMETRY.md) -------
 slo_budget_remaining = _REG.gauge(
     "hvd_slo_budget_remaining",

@@ -61,6 +61,11 @@ class TopState:
         self._rings: Dict[str, deque] = {}
         self._prev_counters: Dict[str, float] = {}
         self._prev_ts: Optional[float] = None
+        #: Last autoscale verdict, derived client-side: whichever
+        #: hvd_autoscale_events_total{verdict} series grew between
+        #: polls fired most recently (None until one grows).
+        self.last_verdict: Optional[str] = None
+        self._prev_verdicts: Dict[str, float] = {}
 
     def _push(self, name: str, value: float) -> None:
         ring = self._rings.get(name)
@@ -108,10 +113,19 @@ class TopState:
                 ("hvd_serve_p99_ms", (), "mean"),
                 ("hvd_serve_batch_occupancy", (), "mean"),
                 ("hvd_serve_pool_pages_free", (), "min"),
+                ("hvd_autoscale_fleet_size", (), "max"),
                 ("hvd_critical_path_ms", (), "max")):
             st = self._gauge_stats(agg, name, key)
             if st is not None:
                 self._push(name, st[stat])
+        ev = agg.get("hvd_autoscale_events_total")
+        if ev:
+            for key, total in sorted(ev["samples"].items()):
+                verdict = _label(ev, key, "verdict")
+                if total > self._prev_verdicts.get(verdict, 0.0) \
+                        and self._prev_ts is not None:
+                    self.last_verdict = verdict
+                self._prev_verdicts[verdict] = total
         self._prev_ts = ts
         return agg
 
@@ -159,7 +173,8 @@ def render_frame(snaps: List[dict], state: TopState,
             ("hvd_critical_path_ms", "step critical path ms", "{:.1f}"),
             ("hvd_serve_p99_ms", "serve p99 ms", "{:.2f}"),
             ("hvd_serve_batch_occupancy", "batch occupancy", "{:.2f}"),
-            ("hvd_serve_pool_pages_free", "KV pages free", "{:.0f}")]
+            ("hvd_serve_pool_pages_free", "KV pages free", "{:.0f}"),
+            ("hvd_autoscale_fleet_size", "autoscale fleet", "{:.0f}")]
     spark_lines = []
     for key, label, fmt in rows:
         vals = state.series(key)
@@ -193,6 +208,29 @@ def render_frame(snaps: List[dict], state: TopState,
             lines.append(_c(
                 f"SLO {slo}: budget {remaining * 100:.1f}%  "
                 f"burn fast {fast:.2f}x / slow {slow:.2f}x", code, color))
+
+    # -- autoscale -------------------------------------------------------
+    fleet_g = state._gauge_stats(agg, "hvd_autoscale_fleet_size")
+    ev = agg.get("hvd_autoscale_events_total")
+    if fleet_g is not None or (ev and ev["samples"]):
+        lines.append("")
+        parts = []
+        if fleet_g is not None:
+            parts.append(f"fleet {int(fleet_g['max'])}")
+        if state.last_verdict is not None:
+            parts.append(f"last verdict {state.last_verdict}")
+        elif ev and ev["samples"]:
+            # --once mode has no poll delta: show lifetime counts.
+            counts = ", ".join(
+                f"{_label(ev, key, 'verdict')}={int(total)}"
+                for key, total in sorted(ev["samples"].items()))
+            parts.append(f"events {counts}")
+        shed = agg.get("hvd_autoscale_shed_total")
+        if shed and shed["samples"]:
+            n = int(sum(shed["samples"].values()))
+            if n:
+                parts.append(_c(f"shed {n}", _YELLOW, color))
+        lines.append("autoscale: " + "  ".join(parts))
 
     # -- anomalies -------------------------------------------------------
     active = agg.get("hvd_anomaly_active")

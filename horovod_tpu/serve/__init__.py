@@ -9,26 +9,61 @@ Layout (docs/SERVING.md):
   - replica.py   elastic multi-replica serving (ReplicaManager)
   - flightrec.py always-on crash/breach flight recorder (FlightRecorder)
   - handoff.py   train→serve reshard without full gather (docs/RESHARD.md)
+  - autoscale.py traffic-driven fleet autoscaling (AutoscaleController,
+                 docs/AUTOSCALE.md)
 """
 
+from .autoscale import (
+    AutoscaleConfig,
+    AutoscaleController,
+    BorrowLedger,
+    ReplicaFleetActuator,
+    SignalSnapshot,
+    simulate_autoscale,
+    snapshot_from_manager,
+    snapshot_from_server,
+)
 from .flightrec import FlightRecorder
-from .handoff import fetch_decode_params, handoff_meta, publish_for_serve
+from .handoff import (
+    fetch_decode_params,
+    handoff_meta,
+    publish_for_serve,
+    restore_train_state,
+    stash_train_state,
+)
 from .pool import PagedKVPool, PoolExhaustedError
-from .scheduler import ActiveSeq, ContinuousScheduler, POLICIES, Request
+from .scheduler import (
+    ActiveSeq,
+    ContinuousScheduler,
+    DEFAULT_TENANT_PRIORITY,
+    POLICIES,
+    Request,
+)
 from .server import InferenceServer
 from .slo import SloController
 
 __all__ = [
     "ActiveSeq",
+    "AutoscaleConfig",
+    "AutoscaleController",
+    "BorrowLedger",
     "ContinuousScheduler",
+    "DEFAULT_TENANT_PRIORITY",
     "FlightRecorder",
     "InferenceServer",
     "fetch_decode_params",
     "handoff_meta",
     "publish_for_serve",
+    "restore_train_state",
+    "stash_train_state",
+    "simulate_autoscale",
+    "snapshot_from_manager",
+    "snapshot_from_server",
     "POLICIES",
     "PagedKVPool",
     "PoolExhaustedError",
+    "ReplicaFleetActuator",
     "Request",
+    "SignalSnapshot",
     "SloController",
 ]

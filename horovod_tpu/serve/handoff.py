@@ -153,4 +153,66 @@ def fetch_decode_params(params_template: Any, pspecs: Any, transport,
     return out
 
 
-__all__ = ["fetch_decode_params", "handoff_meta", "publish_for_serve"]
+# -- chip borrowing (serve/autoscale.py BorrowLedger's actuation edges) ------
+
+def stash_train_state(rows, group_elems: Tuple[int, ...], n_old: int,
+                      old_rank: int, transport, tag: str = "borrow",
+                      chunk_bytes: Optional[int] = None,
+                      peak_bytes: Optional[int] = None,
+                      wire: Optional[str] = None) -> "_rs.ReshardReport":
+    """Borrow, step 1: before lending chips to serving, the training
+    job publishes its zero3 param rows under the ``borrow`` tag — the
+    same peak-bounded, per-chunk-sha256 publish as the decode handoff,
+    just a different namespace.  A `ReshardError` here (e.g. a peer
+    dying mid-publish) means the borrow ABORTS with training state
+    untouched — the ledger never records chips that were not safely
+    stashed."""
+    return publish_for_serve(rows, group_elems, n_old, old_rank,
+                             transport, tag=tag,
+                             chunk_bytes=chunk_bytes,
+                             peak_bytes=peak_bytes, wire=wire)
+
+
+def restore_train_state(group_elems: Tuple[int, ...], dtypes, n_new: int,
+                        new_rank: int, transport, tag: str = "borrow",
+                        chunk_bytes: Optional[int] = None,
+                        peak_bytes: Optional[int] = None,
+                        timeout: Optional[float] = None
+                        ) -> Tuple[np.ndarray, ...]:
+    """Borrow, step 2 (hand-back): training resumes by fetching its
+    stashed rows back — at ANY new world size, because the stash is a
+    reshard plan, not a checkpoint: the returning world's ``n_new``
+    ranks each fetch exactly their owned intervals (digest-verified
+    per chunk) and get compat rows ready for `zero3` restack.  No
+    stop-the-world restore anywhere on the path."""
+    timeout = _rs.default_timeout() if timeout is None else timeout
+    specs, n_old = _rs.plan_meta_parse(
+        transport.wait(f"{tag}/meta", timeout=timeout))
+    by_name = {s.name: s for s in specs}
+    for gi, elems in enumerate(group_elems):
+        spec = by_name.get(f"p{gi}")
+        if spec is None or spec.elems != elems:
+            raise HorovodTpuError(
+                f"borrow restore drift: local group {gi} ({elems} "
+                f"elems) does not match the stashed plan "
+                f"({spec.elems if spec else 'missing'})")
+    plan = _rs.ReshardPlan(specs, n_old, n_new,
+                           chunk_bytes=chunk_bytes,
+                           peak_bytes=peak_bytes)
+    tracker = _rs._PeakTracker()
+    streams: Dict[str, np.ndarray] = {}
+    for gi, elems in enumerate(group_elems):
+        lo, hi = _rs._owned_range(elems, n_new, new_rank)
+        streams[f"p{gi}"] = _rs.fetch_group_slice(
+            plan, by_name[f"p{gi}"], transport, tag, lo, hi,
+            timeout=timeout, tracker=tracker)
+    logger.info(
+        "borrow hand-back: rank %d/%d restored %d group(s) from "
+        "stash world %d (staging peak %d bytes)", new_rank, n_new,
+        len(group_elems), n_old, tracker.peak)
+    return _rs.streams_to_param_rows(streams, group_elems, dtypes,
+                                     n_new, new_rank)
+
+
+__all__ = ["fetch_decode_params", "handoff_meta", "publish_for_serve",
+           "restore_train_state", "stash_train_state"]

@@ -37,7 +37,16 @@ from .server import InferenceServer
 
 logger = logging.getLogger("horovod_tpu.serve.loadgen")
 
+#: Flat traces are 3-tuples; shaped traces append the tenant SLO class
+#: as a 4th element.  Every consumer (`run_trace`, the autoscale bench,
+#: the chaos soak) accepts either arity.
 Trace = List[Tuple[int, np.ndarray, int]]
+
+SHAPES = ("diurnal", "burst", "multi_tenant")
+
+#: Tenant mix used when a shaped trace tags classes itself:
+#: (class, weight).  Priorities live in scheduler.DEFAULT_TENANT_PRIORITY.
+TENANT_MIX = (("premium", 0.2), ("standard", 0.5), ("batch", 0.3))
 
 
 def make_trace(seed: int, n_requests: int, vocab_size: int,
@@ -73,6 +82,122 @@ def make_trace(seed: int, n_requests: int, vocab_size: int,
             mn = int(rng.randint(max_new_lo, max_new_hi + 1))
         prompt = rng.randint(0, vocab_size, size=T0).astype(np.int32)
         trace.append((int(round(i * arrival_every)), prompt, mn))
+    return trace
+
+
+def _tag_classes(rng: "np.random.RandomState", n: int) -> List[str]:
+    names = [c for c, _ in TENANT_MIX]
+    weights = np.asarray([w for _, w in TENANT_MIX], np.float64)
+    weights /= weights.sum()
+    return [str(rng.choice(names, p=weights)) for _ in range(n)]
+
+
+def make_shaped_trace(shape: str, seed: int, n_requests: int,
+                      vocab_size: int,
+                      prompt_lens: Tuple[int, ...] = (8, 16, 32),
+                      max_new_lo: int = 8, max_new_hi: int = 64,
+                      base_every: float = 4.0,
+                      period: int = 256, amplitude: float = 0.9,
+                      burst_every: int = 64, burst_size: int = 12
+                      ) -> Trace:
+    """Seeded traffic SHAPES for the autoscale bench and the chaos
+    soak — 4-tuples ``(arrival_step, prompt, max_new_tokens,
+    slo_class)``, deterministic per (shape, seed, args):
+
+      - ``diurnal``       arrival rate rides a sinusoid with the given
+                          ``period`` and ``amplitude`` around the base
+                          rate ``1/base_every`` — the day/night cycle
+                          that makes a static fleet either waste chips
+                          at the trough or violate SLOs at the peak.
+      - ``burst``         steady base arrivals plus a ``burst_size``
+                          clump every ``burst_every`` steps — the
+                          flash crowd; hysteresis/dwell tuning is
+                          exactly the question of which bursts are
+                          worth a scale event.
+      - ``multi_tenant``  the TENANT_MIX classes with distinct
+                          behaviours: premium arrives steadily,
+                          standard diurnally, batch in bulk clumps —
+                          the trace that exercises priority shedding.
+
+    Tenant tags come from the same seeded RNG for every shape, so the
+    scheduler's shed order is replayable."""
+    if shape not in SHAPES:
+        raise InvalidRequestError(
+            f"shape must be one of {SHAPES}, got {shape!r}")
+    if n_requests < 1:
+        raise InvalidRequestError(
+            f"n_requests must be >= 1, got {n_requests}")
+    rng = np.random.RandomState(seed)
+    arrivals: List[int] = []
+    classes: List[str] = []
+    if shape == "diurnal":
+        base_rate = 1.0 / max(1e-9, base_every)
+        acc, t = 0.0, 0
+        while len(arrivals) < n_requests:
+            rate = base_rate * (1.0 + amplitude
+                                * math.sin(2.0 * math.pi * t / period))
+            acc += max(0.0, rate)
+            while acc >= 1.0 and len(arrivals) < n_requests:
+                arrivals.append(t)
+                acc -= 1.0
+            t += 1
+        classes = _tag_classes(rng, n_requests)
+    elif shape == "burst":
+        t, i = 0, 0
+        acc = 0.0
+        while i < n_requests:
+            if t and t % burst_every == 0:
+                for _ in range(min(burst_size, n_requests - i)):
+                    arrivals.append(t)
+                    i += 1
+            acc += 1.0 / max(1e-9, base_every)
+            while acc >= 1.0 and i < n_requests:
+                arrivals.append(t)
+                acc -= 1.0
+                i += 1
+            t += 1
+        arrivals.sort()
+        classes = _tag_classes(rng, n_requests)
+    else:                               # multi_tenant
+        n_prem = max(1, int(0.2 * n_requests))
+        n_std = max(1, int(0.5 * n_requests))
+        n_batch = max(0, n_requests - n_prem - n_std)
+        horizon = int(n_requests * base_every)
+        tagged: List[Tuple[int, str]] = []
+        # premium: evenly spaced (a steady interactive tenant)
+        for k in range(n_prem):
+            tagged.append((int(k * horizon / n_prem), "premium"))
+        # standard: diurnal sinusoid over the same horizon
+        base_rate = n_std / max(1, horizon)
+        acc = 0.0
+        emitted = 0
+        for t in range(horizon):
+            rate = base_rate * (1.0 + amplitude
+                                * math.sin(2.0 * math.pi * t
+                                           / max(1, period)))
+            acc += max(0.0, rate)
+            while acc >= 1.0 and emitted < n_std:
+                tagged.append((t, "standard"))
+                acc -= 1.0
+                emitted += 1
+        while emitted < n_std:          # remainder lands at the end
+            tagged.append((horizon - 1, "standard"))
+            emitted += 1
+        # batch: bulk clumps (an offline tenant submitting in waves)
+        n_clumps = max(1, n_batch // max(1, burst_size))
+        for k in range(n_batch):
+            clump = min(k // max(1, burst_size), n_clumps - 1)
+            t = int((clump + 0.5) * horizon / n_clumps)
+            tagged.append((t, "batch"))
+        tagged.sort(key=lambda p: p[0])
+        arrivals = [t for t, _ in tagged]
+        classes = [c for _, c in tagged]
+    trace: Trace = []
+    for t, cls in zip(arrivals, classes):
+        T0 = int(rng.choice(prompt_lens))
+        mn = int(rng.randint(max_new_lo, max_new_hi + 1))
+        prompt = rng.randint(0, vocab_size, size=T0).astype(np.int32)
+        trace.append((int(t), prompt, mn, cls))
     return trace
 
 
@@ -123,8 +248,10 @@ def run_trace(server: InferenceServer, trace: Trace,
     steps = 0
     while steps < max_steps:
         while pending and trace[pending[0]][0] <= server.step_no:
-            _, prompt, mn = trace[pending.pop(0)]
-            server.submit(prompt, mn)
+            item = trace[pending.pop(0)]
+            server.submit(item[1], item[2],
+                          slo_class=(item[3] if len(item) > 3
+                                     else "standard"))
         if not pending and server.sched.drained():
             break
         server.step()
@@ -222,6 +349,7 @@ def read_latest_record(path: str) -> Optional[Dict]:
     return rec
 
 
-__all__ = ["Trace", "append_record", "hist_cumulative",
-           "hist_delta_quantile", "make_trace", "read_latest_record",
+__all__ = ["SHAPES", "TENANT_MIX", "Trace", "append_record",
+           "hist_cumulative", "hist_delta_quantile",
+           "make_shaped_trace", "make_trace", "read_latest_record",
            "run_trace", "server_stats"]

@@ -15,7 +15,22 @@ worker processes.  All coordination is KV keys:
   serve/assign/<rid>/<req>  request payload, JSON (manager -> replica)
   serve/result/<req>        generated tokens, JSON (replica -> manager)
   serve/heartbeat/<rid>     incrementing counter (replica liveness)
+  serve/digest/<rid>        sha256 of the replica's params (split-brain
+                            check: every member must agree)
+  serve/retire/<rid>        set to drain and exit ONE replica (shrink)
+  serve/cancel/<req>        set to shed one queued request fleet-wide
   serve/stop                set to drain and exit every replica
+
+The fleet is ELASTIC: ``scale_to(n)`` grows by spawning fresh replica
+ids (the lease plane assigns them roles — config + digest + assigns
+all flow through KV, no stop-the-world anywhere) and shrinks by
+retiring the highest ids (retirees get a ``serve/retire`` key, their
+unfinished work is reassigned to survivors, and because decode is
+deterministic a request finished by BOTH the retiree and a survivor
+produces the identical token list — redelivery stays idempotent).
+``digest_agreement`` is the no-split-brain check the scale-event chaos
+harness (serve/autoscale.py `run_scale_chaos`) asserts after every
+faulted grow/shrink.
 
 Failure model: a replica dies (crash, or the ``serve.replica_die``
 fault point — docs/FAULT_TOLERANCE.md) or its heartbeat VALUE stops
@@ -69,17 +84,23 @@ class ReplicaManager:
         self.kv = self.server.kv()
         self.kv.put("serve/config", json.dumps(config))
         self.procs: Dict[int, subprocess.Popen] = {}
-        self.assigned: Dict[int, Set[int]] = {
-            r: set() for r in range(n_replicas)}
+        self.assigned: Dict[int, Set[int]] = {}
         self.results: Dict[int, List[int]] = {}
         self._requests: Dict[int, Dict] = {}
+        self._submit_ts: Dict[int, float] = {}
         self._next_req = 0
         self._rr = 0
         self._hb_last: Dict[int, Optional[str]] = {}
         self._hb_deadline: Dict[int, float] = {}
         self._down: Set[int] = set()
+        self._shed: Set[int] = set()
         self._respawns = 0
-        for r in range(n_replicas):
+        #: Active fleet membership (rids).  Grow adds fresh ids,
+        #: shrink retires the highest — ids are never reused, so a
+        #: late heartbeat from a retired incarnation can't be mistaken
+        #: for a member.
+        self.members: Set[int] = set(range(n_replicas))
+        for r in sorted(self.members):
             self._spawn(r)
 
     # -- process control -----------------------------------------------
@@ -99,22 +120,30 @@ class ReplicaManager:
         })
         self.procs[rid] = subprocess.Popen(
             [sys.executable, "-m", "horovod_tpu.serve.replica"], env=env)
+        self.assigned.setdefault(rid, set())
         self._hb_last[rid] = None
         self._hb_deadline[rid] = time.time() + self.lease_ttl \
             + self.lease_ttl  # start grace: first beat needs model init
         logger.info("replica %d spawned (pid %d)", rid,
                     self.procs[rid].pid)
 
+    def _live(self, exclude: Optional[int] = None) -> List[int]:
+        return [r for r in sorted(self.members)
+                if r != exclude and r not in self._down
+                and not self.registry.is_blacklisted(self._host(r))]
+
     # -- request intake ------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
+    def submit(self, prompt, max_new_tokens: int,
+               slo_class: str = "standard") -> int:
         req_id = self._next_req
         self._next_req += 1
         payload = {"prompt": [int(t) for t in prompt],
-                   "max_new_tokens": int(max_new_tokens)}
+                   "max_new_tokens": int(max_new_tokens),
+                   "slo_class": slo_class}
         self._requests[req_id] = payload
-        live = [r for r in range(self.n_replicas)
-                if not self.registry.is_blacklisted(self._host(r))]
+        self._submit_ts[req_id] = time.time()
+        live = self._live()
         if not live:
             raise HorovodTpuError("no live serving replicas left")
         rid = live[self._rr % len(live)]
@@ -123,9 +152,115 @@ class ReplicaManager:
         return req_id
 
     def _assign(self, rid: int, req_id: int) -> None:
-        self.assigned[rid].add(req_id)
+        self.assigned.setdefault(rid, set()).add(req_id)
         self.kv.put(f"serve/assign/{rid}/{req_id}",
                     json.dumps(self._requests[req_id]))
+
+    # -- autoscaler signals / actuation edges ---------------------------
+
+    def fleet_size(self) -> int:
+        return len(self._live())
+
+    def unfinished_ids(self) -> Set[int]:
+        return set(self._requests) - set(self.results) - self._shed
+
+    def outstanding(self) -> int:
+        return len(self.unfinished_ids())
+
+    def oldest_unfinished_ts(self) -> Optional[float]:
+        ids = self.unfinished_ids()
+        if not ids:
+            return None
+        return min(self._submit_ts[r] for r in ids
+                   if r in self._submit_ts)
+
+    def scale_to(self, n: int, drain_timeout: float = 30.0) -> int:
+        """Grow or shrink the fleet to ``n`` live replicas without
+        stopping the world: joiners spawn fresh ids and pick up config
+        + role through the lease plane; retirees (highest ids first)
+        get a ``serve/retire`` key, their unfinished work is reassigned
+        to survivors, and the processes drain out.  Returns the
+        converged live size."""
+        if n < 1:
+            raise InvalidRequestError(f"fleet size must be >= 1, got {n}")
+        while self.fleet_size() < n:
+            rid = max(self.procs, default=-1) + 1
+            self.members.add(rid)
+            self._spawn(rid)
+        retire = sorted(self._live(), reverse=True)[:max(
+            0, self.fleet_size() - n)]
+        for rid in retire:
+            self.kv.put(f"serve/retire/{rid}", "1")
+            self.members.discard(rid)
+            unfinished = {r for r in self.assigned.get(rid, set())
+                          if r in self.unfinished_ids()}
+            self.assigned[rid] = set()
+            live = self._live()
+            for i, req_id in enumerate(sorted(unfinished)):
+                if not live:
+                    raise HorovodTpuError(
+                        f"shrink stranded {len(unfinished)} requests: "
+                        "no survivors")
+                self._assign(live[i % len(live)], req_id)
+            proc = self.procs.pop(rid)
+            try:
+                proc.wait(timeout=drain_timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            logger.info("replica %d retired", rid)
+        self.n_replicas = n
+        return self.fleet_size()
+
+    def shed(self, n: int,
+             tenant_priority: Optional[Dict[str, int]] = None) -> int:
+        """Cancel up to ``n`` unfinished requests fleet-wide, lowest-
+        priority tenant class first, newest first (the same order as
+        scheduler.shed).  Best-effort: a replica that already started
+        decoding a canceled request finishes it anyway (its result is
+        simply kept — decode is deterministic, so nothing diverges);
+        replicas skip canceled requests they have not yet claimed."""
+        if n <= 0:
+            return 0
+        prio = dict(tenant_priority or {"premium": 0, "standard": 1,
+                                        "batch": 2})
+        worst = max(prio.values(), default=0) + 1
+        ids = sorted(
+            self.unfinished_ids(),
+            key=lambda r: (-prio.get(
+                self._requests[r].get("slo_class", "standard"), worst),
+                -r))
+        out = 0
+        for req_id in ids[:n]:
+            self.kv.put(f"serve/cancel/{req_id}", "1")
+            self._shed.add(req_id)
+            out += 1
+            logger.info("request %d shed (%s)", req_id,
+                        self._requests[req_id].get("slo_class"))
+        return out
+
+    def digest_agreement(self, timeout: float = 30.0) -> bool:
+        """No-split-brain check: every live member must publish the
+        SAME params digest (serve/digest/<rid>).  Replicas rebuild from
+        the config seed, so any disagreement means a member is serving
+        different weights — the one failure mode a scale event must
+        never commit over."""
+        deadline = time.time() + timeout
+        while True:
+            live = self._live()
+            digests = {r: self.kv.get(f"serve/digest/{r}") for r in live}
+            if all(d is not None for d in digests.values()):
+                vals = set(digests.values())
+                if len(vals) > 1:
+                    logger.error("params digest SPLIT BRAIN: %s",
+                                 digests)
+                return len(vals) == 1 and bool(live)
+            if time.time() > deadline:
+                missing = [r for r, d in digests.items() if d is None]
+                logger.warning("digest check timed out waiting on "
+                               "replicas %s", missing)
+                return False
+            time.sleep(0.05)
 
     # -- failure detection / healing -----------------------------------
 
@@ -153,13 +288,11 @@ class ReplicaManager:
             proc.kill()
             proc.wait()
         self.registry.record_failure(self._host(rid), 0, why)
-        unfinished = {r for r in self.assigned[rid]
-                      if r not in self.results}
+        unfinished = {r for r in self.assigned.get(rid, set())
+                      if r in self.unfinished_ids()}
         self.assigned[rid] = set()
-        live = [r for r in range(self.n_replicas)
-                if r != rid
-                and not self.registry.is_blacklisted(self._host(r))
-                and self.procs[r].poll() is None]
+        live = [r for r in self._live(exclude=rid)
+                if self.procs[r].poll() is None]
         for i, req_id in enumerate(sorted(unfinished)):
             if not live:
                 break
@@ -205,16 +338,16 @@ class ReplicaManager:
         while True:
             now = time.time()
             self.poll_results()
-            if len(self.results) == len(self._requests):
+            if not self.unfinished_ids():
                 return dict(self.results)
-            for rid in range(self.n_replicas):
-                if rid in self._down:
+            for rid in sorted(self.members):
+                if rid in self._down or rid not in self.procs:
                     continue
                 why = self._check_replica(rid, now)
                 if why is not None:
                     self._heal(rid, why)
             if now > deadline:
-                missing = sorted(set(self._requests) - set(self.results))
+                missing = sorted(self.unfinished_ids())
                 raise HorovodTpuError(
                     f"serving timed out after {timeout:.0f}s with "
                     f"requests {missing} unfinished")
@@ -239,6 +372,24 @@ class ReplicaManager:
 
 
 # -- the replica worker process ---------------------------------------------
+
+
+def _params_digest(params) -> str:
+    """sha256 over every param leaf's bytes, leaves in tree order —
+    the same strong-digest idea parallel/reshard.py uses per stream,
+    here over the whole replica so `digest_agreement` is one compare."""
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(params):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 def _build_server(config: Dict):
@@ -285,6 +436,11 @@ def main() -> None:
         raise HorovodTpuError("replica got no serve/config within 30s")
     config = json.loads(raw)
     server, _ = _build_server(config)
+    # Publish the params digest BEFORE serving: the manager's
+    # no-split-brain check (`digest_agreement`) compares these across
+    # members after every scale event.  Deterministic seed -> a
+    # respawned incarnation republishes the identical digest.
+    client.put(f"serve/digest/{rid}", _params_digest(server.params))
     claimed: Set[str] = set()
     beat = 0
     logger.info("replica %d serving (pid %d)", rid, os.getpid())
@@ -293,13 +449,31 @@ def main() -> None:
         client.put(f"serve/heartbeat/{rid}", str(beat))
         if client.get("serve/stop"):
             break
+        if client.get(f"serve/retire/{rid}"):
+            # Shrink: stop claiming, drain what's active, exit.  The
+            # manager has already reassigned this replica's unfinished
+            # work to survivors; anything we still finish below is the
+            # identical token list (deterministic decode), so the
+            # double-finish is harmless.
+            while not server.sched.drained():
+                for seq in server.step():
+                    client.put(f"serve/result/{seq.req.req_id}",
+                               json.dumps(seq.generated))
+            logger.info("replica %d retiring", rid)
+            break
         for key in client.keys(f"serve/assign/{rid}/"):
             if key in claimed:
+                continue
+            req_id = int(key.rsplit("/", 1)[1])
+            if client.get(f"serve/cancel/{req_id}"):
+                claimed.add(key)     # shed before claim: never decode
                 continue
             claimed.add(key)
             payload = json.loads(client.get(key))
             server.submit(payload["prompt"], payload["max_new_tokens"],
-                          req_id=int(key.rsplit("/", 1)[1]))
+                          req_id=req_id,
+                          slo_class=payload.get("slo_class",
+                                                "standard"))
         # The fault point that kills a replica mid-stream in the e2e
         # test (serve.replica_die@N:exit:1, host-scoped via
         # HOROVOD_FAULT_HOSTS=replicaK).

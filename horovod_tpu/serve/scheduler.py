@@ -24,6 +24,15 @@ Policies:
   - ``static`` the baseline the bench compares against: admit only
                when the active set is EMPTY, then fill every row — a
                whole wave drains before the next one boards.
+
+Tenant SLO classes: every request carries an ``slo_class`` tag
+(default ``"standard"``).  ``shed`` is the autoscaler's degrade rung
+below shrink (docs/AUTOSCALE.md): drop queued — never active —
+requests, LOWEST-priority class first (highest numeric priority),
+newest arrivals first within a class, so a premium request is the last
+thing a saturated fleet gives up and a just-submitted batch job is the
+first.  Shed order is deterministic and logged like every other
+decision.
 """
 
 from __future__ import annotations
@@ -38,16 +47,24 @@ from ..common.exceptions import InvalidRequestError
 
 POLICIES = ("fifo", "random", "static")
 
+#: Default tenant-priority map (lower = more important).  The
+#: autoscaler overrides this from HOROVOD_AUTOSCALE_TENANT_CLASSES
+#: (autoscale.parse_tenant_classes); unknown classes shed FIRST.
+DEFAULT_TENANT_PRIORITY = {"premium": 0, "standard": 1, "batch": 2}
+
 
 @dataclass
 class Request:
-    """One generation request: prompt in, ``max_new_tokens`` out."""
+    """One generation request: prompt in, ``max_new_tokens`` out.
+    ``slo_class`` is the tenant's SLO tier — it never changes decode
+    math, only shed order under overload."""
 
     req_id: int
     prompt: np.ndarray                  # [T0] int32
     max_new_tokens: int
     arrival_step: int = 0
     eos_id: Optional[int] = None
+    slo_class: str = "standard"
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -139,6 +156,35 @@ class ContinuousScheduler:
             out.append(seq)
         return out
 
+    def shed(self, step: int, n: int,
+             tenant_priority: Optional[Dict[str, int]] = None
+             ) -> List[Request]:
+        """Drop up to ``n`` QUEUED requests (never active ones —
+        admitted work always finishes), lowest-priority tenant class
+        first, newest arrival first within a class.  Returns the shed
+        requests so the server can fail them back to callers; each is
+        logged as a ``shed`` decision."""
+        if n <= 0 or not self.queue:
+            return []
+        prio = tenant_priority if tenant_priority is not None \
+            else DEFAULT_TENANT_PRIORITY
+        # Unknown classes rank below every known one (shed first).
+        worst = max(prio.values(), default=0) + 1
+        order = sorted(
+            range(len(self.queue)),
+            key=lambda i: (-prio.get(self.queue[i].slo_class, worst),
+                           -self.queue[i].arrival_step, -i))
+        victims = order[:n]
+        picked = {i: self.queue[i] for i in victims}
+        for i in sorted(victims, reverse=True):
+            self.queue.pop(i)
+        out: List[Request] = []
+        for i in victims:                 # preserve shed-priority order
+            req = picked[i]
+            self._log(step, "shed", req.req_id, -1)
+            out.append(req)
+        return out
+
     def evict(self, step: int, row: int) -> ActiveSeq:
         try:
             seq = self.active.pop(row)
@@ -155,4 +201,5 @@ class ContinuousScheduler:
         return not self.queue and not self.active
 
 
-__all__ = ["ActiveSeq", "ContinuousScheduler", "POLICIES", "Request"]
+__all__ = ["ActiveSeq", "ContinuousScheduler",
+           "DEFAULT_TENANT_PRIORITY", "POLICIES", "Request"]

@@ -197,7 +197,8 @@ class InferenceServer:
 
     def submit(self, prompt, max_new_tokens: int,
                eos_id: Optional[int] = None,
-               req_id: Optional[int] = None) -> int:
+               req_id: Optional[int] = None,
+               slo_class: str = "standard") -> int:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size + max_new_tokens > self.max_seq_tokens:
             raise InvalidRequestError(
@@ -208,7 +209,7 @@ class InferenceServer:
         self._next_req_id = max(self._next_req_id, req_id) + 1
         req = Request(req_id=req_id, prompt=prompt,
                       max_new_tokens=max_new_tokens, eos_id=eos_id,
-                      arrival_step=self.step_no)
+                      arrival_step=self.step_no, slo_class=slo_class)
         self._submit_wall[req_id] = time.perf_counter()
         tl = get_timeline()
         self._req_obs[req_id] = {
@@ -553,6 +554,36 @@ class InferenceServer:
 
     def occupancy_mean(self) -> float:
         return self.occupancy_sum / max(1, self.device_steps)
+
+    def oldest_queue_wait_ms(self) -> float:
+        """Wall-clock wait of the oldest QUEUED request — the
+        autoscaler's head-of-line pressure signal (zero when the queue
+        is empty)."""
+        now = time.perf_counter()
+        waits = [now - self._submit_wall[r.req_id]
+                 for r in self.sched.queue
+                 if r.req_id in self._submit_wall]
+        return max(waits) * 1e3 if waits else 0.0
+
+    def shed_queued(self, n: int,
+                    tenant_priority: Optional[Dict[str, int]] = None
+                    ) -> List[Request]:
+        """Autoscaler degrade rung: drop up to ``n`` queued requests in
+        tenant-priority order (scheduler.shed) and release their
+        lifecycle state so they never count against latency stats.
+        Returns the shed requests for the caller to fail back."""
+        shed = self.sched.shed(self.step_no, n, tenant_priority)
+        for req in shed:
+            self._submit_wall.pop(req.req_id, None)
+            self._req_obs.pop(req.req_id, None)
+            if self.flightrec is not None:
+                self.flightrec.record(
+                    "shed", {"req": req.req_id,
+                             "slo_class": req.slo_class},
+                    step=self.step_no)
+        if shed and _met.enabled():
+            _met.autoscale_shed.inc(len(shed))
+        return shed
 
     def _update_gauges(self) -> None:
         # Sampled, not per-step: the p99 percentile over the SLO window

@@ -447,6 +447,16 @@ def init_from_env() -> Optional[ParameterManager]:
                 log_scale=True, integer=True, host_only=True,
                 initial=(util.env_int("RESHARD_CHUNK_BYTES", 0)
                          or (4 << 20)))
+    # Autoscaler cooldown/dwell (docs/AUTOSCALE.md): reactivity-vs-
+    # flap-cost of serving scale events.  Pure host-side control flow
+    # — host_only keeps a tuner move out of the program-cache key, so
+    # retuning the control loop never retraces a kernel.
+    pm.register("autoscale_cooldown", 4, 512, log_scale=True,
+                integer=True, host_only=True,
+                initial=max(4, util.env_int("AUTOSCALE_COOLDOWN", 32)))
+    pm.register("autoscale_dwell", 1, 128, log_scale=True,
+                integer=True, host_only=True,
+                initial=max(1, util.env_int("AUTOSCALE_DWELL", 8)))
     _manager = pm
     logger.info("autotune enabled: %s", pm.values())
     return pm
@@ -754,6 +764,40 @@ def current_reshard_chunk_bytes() -> int:
     if env > 0:
         return env
     return tuned_reshard_chunk_bytes(4 << 20)
+
+
+def tuned_autoscale_cooldown(default: int) -> int:
+    """Autoscaler cooldown honoring the autotuner when active
+    (host_only: never in `values()` / the program-cache key)."""
+    if _manager is not None and \
+            "autoscale_cooldown" in _manager._tunables:
+        return max(0, int(_manager.value("autoscale_cooldown")))
+    return default
+
+
+def current_autoscale_cooldown() -> int:
+    """The live autoscale cooldown in observations:
+    HOROVOD_AUTOSCALE_COOLDOWN (32), overridden by the autotuner when
+    active.  Host-side control flow only — no retrace."""
+    return tuned_autoscale_cooldown(
+        max(0, util.env_int("AUTOSCALE_COOLDOWN", 32)))
+
+
+def tuned_autoscale_dwell(default: int) -> int:
+    """Autoscaler hysteresis dwell honoring the autotuner when active
+    (host_only: never in `values()` / the program-cache key)."""
+    if _manager is not None and \
+            "autoscale_dwell" in _manager._tunables:
+        return max(1, int(_manager.value("autoscale_dwell")))
+    return default
+
+
+def current_autoscale_dwell() -> int:
+    """The live autoscale dwell in observations:
+    HOROVOD_AUTOSCALE_DWELL (8), overridden by the autotuner when
+    active.  Host-side control flow only — no retrace."""
+    return tuned_autoscale_dwell(
+        max(1, util.env_int("AUTOSCALE_DWELL", 8)))
 
 
 def current_serve_pool_pages() -> int:

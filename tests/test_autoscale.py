@@ -1,0 +1,542 @@
+"""Autoscaler tests (horovod_tpu/serve/autoscale.py): decision-core
+units on hand-built signal traces (hysteresis/dwell, cooldown, flap
+suppression, the budget latch, min/max bounds, the degrade ladder),
+tenant-priority shed order, the replayable decision log, the borrow
+ledger's hand-back guarantee (including a reshard fault mid-stash),
+the shaped loadgen traces, the sim A/B the bench records, and the
+np=2-style slow e2e: a bursty trace makes grow fire, serve.replica_die
+kills the joiner mid-grow, and the fleet converges digest-verified
+with token-identical results."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import horovod_tpu.faults as _faults
+from horovod_tpu.common.exceptions import InvalidRequestError
+from horovod_tpu.parallel import reshard as _rs
+from horovod_tpu.serve.autoscale import (
+    AutoscaleConfig,
+    AutoscaleController,
+    BorrowLedger,
+    SignalSnapshot,
+    parse_tenant_classes,
+    simulate_autoscale,
+)
+from horovod_tpu.serve.loadgen import SHAPES, make_shaped_trace
+from horovod_tpu.serve.scheduler import ContinuousScheduler, Request
+
+
+def _cfg(**kw):
+    base = dict(min_replicas=1, max_replicas=4, cooldown_steps=6,
+                dwell_steps=3, occ_high=0.85, occ_low=0.30,
+                queue_wait_high_ms=1000.0,
+                tenant_classes={"premium": 0, "standard": 1,
+                                "batch": 2})
+    base.update(kw)
+    return AutoscaleConfig(**base)
+
+
+def _snap(step, fleet=1, occ=0.5, depth=0, wait=0.0, **kw):
+    return SignalSnapshot(step=step, fleet_size=fleet, occupancy=occ,
+                          queue_depth=depth, queue_wait_ms=wait,
+                          pool_free_frac=1.0 - occ, **kw)
+
+
+def _pressure(step, fleet=1, **kw):
+    return _snap(step, fleet=fleet, occ=0.95, depth=4, **kw)
+
+
+def _relief(step, fleet=2, **kw):
+    return _snap(step, fleet=fleet, occ=0.1, depth=0, **kw)
+
+
+class TestDecisionCore:
+    def test_dwell_gates_grow(self):
+        c = AutoscaleController(_cfg(dwell_steps=3))
+        assert c.observe(_pressure(0)).verdict == "hold"
+        assert c.observe(_pressure(1)).verdict == "hold"
+        assert c.observe(_pressure(2)).verdict == "grow"
+
+    def test_broken_streak_resets_dwell(self):
+        c = AutoscaleController(_cfg(dwell_steps=3))
+        c.observe(_pressure(0))
+        c.observe(_pressure(1))
+        c.observe(_snap(2))                     # in band: streak resets
+        assert c.observe(_pressure(3)).verdict == "hold"
+        assert c.observe(_pressure(4)).verdict == "hold"
+        assert c.observe(_pressure(5)).verdict == "grow"
+
+    def test_cooldown_suppresses_next_event(self):
+        c = AutoscaleController(_cfg(dwell_steps=1, cooldown_steps=5))
+        d, _ = c.step(_pressure(0))
+        assert d.verdict == "grow"
+        for s in range(1, 6):                   # within cooldown
+            d = c.observe(_pressure(s, fleet=2))
+            assert d.verdict == "hold"
+            assert "cooldown" in d.reason
+        assert c.observe(_pressure(6, fleet=2)).verdict == "grow"
+
+    def test_flap_suppression_doubles_reversal_cooldown(self):
+        c = AutoscaleController(_cfg(dwell_steps=1, cooldown_steps=4,
+                                     flap_mult=2))
+        d, _ = c.step(_pressure(0))
+        assert d.verdict == "grow"
+        # A reversal (shrink) waits flap_mult * cooldown = 8, not 4.
+        assert c.observe(_relief(6)).verdict == "hold"
+        assert c.observe(_relief(8)).verdict == "hold"
+        assert c.observe(_relief(9)).verdict == "shrink"
+
+    def test_budget_latch_forbids_shrink(self):
+        # Fleet at max so the breach latch can't route to grow: while
+        # breaching or burning fast the controller must never shrink,
+        # no matter how idle the fleet looks.
+        c = AutoscaleController(_cfg(dwell_steps=1, cooldown_steps=0,
+                                     max_replicas=2))
+        assert c.observe(_relief(0, breaching=True)).verdict == "hold"
+        assert c.observe(_relief(1, burn_fast=1.5)).verdict == "hold"
+        assert c.observe(_relief(2)).verdict == "shrink"
+
+    def test_min_max_bounds(self):
+        c = AutoscaleController(_cfg(dwell_steps=1, cooldown_steps=0,
+                                     max_replicas=2))
+        assert c.observe(_relief(0, fleet=1)).verdict == "hold"
+        d, _ = c.step(_pressure(1, fleet=2))     # at max, no backlog
+        assert d.verdict == "shed"               # queue_depth=4 -> shed
+        d = c.observe(_snap(2, fleet=2, occ=0.95, depth=0))
+        # hot but nothing queued: not pressure, nothing to shed
+        assert d.verdict == "hold"
+
+    def test_degrade_ladder_borrow_then_shed(self):
+        c = AutoscaleController(_cfg(dwell_steps=1, cooldown_steps=0,
+                                     max_replicas=1))
+        d = c.observe(_pressure(0, fleet=1, borrowable=1))
+        assert d.verdict == "borrow"
+        d = c.observe(_pressure(1, fleet=1, borrowable=0))
+        assert d.verdict == "shed"
+
+    def test_handback_before_shrink(self):
+        c = AutoscaleController(_cfg(dwell_steps=1, cooldown_steps=0))
+        d = c.observe(_relief(0, fleet=3, borrowed=1))
+        assert d.verdict == "handback"
+        d = c.observe(_relief(1, fleet=2, borrowed=0))
+        assert d.verdict == "shrink"
+
+    def test_replayed_decision_log_identical(self):
+        trace = ([_pressure(s) for s in range(4)]
+                 + [_snap(s) for s in range(4, 10)]
+                 + [_relief(s, breaching=(s % 3 == 0))
+                    for s in range(10, 20)])
+        logs = []
+        for _ in range(2):
+            c = AutoscaleController(_cfg())
+            for s in trace:
+                c.step(s)
+            logs.append(json.dumps(
+                [dataclasses.asdict(d) for d in c.decisions],
+                sort_keys=True))
+        assert logs[0] == logs[1]
+
+    def test_config_validation(self):
+        with pytest.raises(InvalidRequestError):
+            _cfg(min_replicas=3, max_replicas=2)
+        with pytest.raises(InvalidRequestError):
+            _cfg(occ_high=0.2, occ_low=0.5)
+        with pytest.raises(InvalidRequestError):
+            _cfg(dwell_steps=0)
+
+    def test_config_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_AUTOSCALE_MIN_REPLICAS", "2")
+        monkeypatch.setenv("HOROVOD_AUTOSCALE_MAX_REPLICAS", "5")
+        monkeypatch.setenv("HOROVOD_AUTOSCALE_COOLDOWN", "11")
+        monkeypatch.setenv("HOROVOD_AUTOSCALE_DWELL", "4")
+        monkeypatch.setenv("HOROVOD_AUTOSCALE_OCC_HIGH", "0.7")
+        monkeypatch.setenv("HOROVOD_AUTOSCALE_OCC_LOW", "0.2")
+        monkeypatch.setenv("HOROVOD_AUTOSCALE_QUEUE_MS", "500")
+        monkeypatch.setenv("HOROVOD_AUTOSCALE_TENANT_CLASSES",
+                           "gold:0,bronze:5")
+        cfg = AutoscaleConfig()
+        assert (cfg.min_replicas, cfg.max_replicas) == (2, 5)
+        assert (cfg.cooldown_steps, cfg.dwell_steps) == (11, 4)
+        assert (cfg.occ_high, cfg.occ_low) == (0.7, 0.2)
+        assert cfg.queue_wait_high_ms == 500.0
+        assert cfg.tenant_classes == {"gold": 0, "bronze": 5}
+
+    def test_parse_tenant_classes_rejects_garbage(self):
+        with pytest.raises(InvalidRequestError):
+            parse_tenant_classes("premium")
+        with pytest.raises(InvalidRequestError):
+            parse_tenant_classes("premium:x")
+        with pytest.raises(InvalidRequestError):
+            parse_tenant_classes(",")
+
+
+class _Fleet:
+    """Minimal actuator double recording calls."""
+
+    def __init__(self, size=1, fail=False):
+        self.size = size
+        self.fail = fail
+        self.sheds = []
+
+    def fleet_size(self):
+        return self.size
+
+    def scale_to(self, n):
+        if self.fail:
+            raise RuntimeError("actuator down")
+        self.size = n
+        return n
+
+    def shed(self, n):
+        self.sheds.append(n)
+        return min(n, 2)
+
+
+class TestActuation:
+    def test_scale_event_commits(self):
+        fleet = _Fleet(1)
+        c = AutoscaleController(_cfg(dwell_steps=1), actuator=fleet)
+        d, ev = c.step(_pressure(0))
+        assert (d.verdict, ev.state) == ("grow", "committed")
+        assert fleet.size == 2 and ev.converged_size == 2
+
+    def test_mid_event_fault_aborts_and_dumps(self, tmp_path):
+        from horovod_tpu.serve.flightrec import FlightRecorder
+        rec = FlightRecorder(64, out_dir=str(tmp_path))
+        fleet = _Fleet(1, fail=True)
+        c = AutoscaleController(_cfg(dwell_steps=1), actuator=fleet,
+                                flightrec=rec)
+        d, ev = c.step(_pressure(0))
+        assert ev.state == "aborted"
+        assert ev.converged_size == 1           # lease plane's answer
+        dumps = [p for p in os.listdir(tmp_path)
+                 if p.startswith("serve_flightrec")]
+        assert len(dumps) == 1
+        payload = json.load(open(tmp_path / dumps[0]))
+        assert payload["reason"] == "scale_event_failed"
+        kinds = [e["kind"] for e in payload["events"]]
+        assert "autoscale" in kinds and "autoscale_abort" in kinds
+        rec.close()
+
+    def test_control_loop_outlives_aborted_events(self):
+        fleet = _Fleet(1, fail=True)
+        c = AutoscaleController(_cfg(dwell_steps=1, cooldown_steps=0),
+                                actuator=fleet)
+        for s in range(3):
+            _, ev = c.step(_pressure(s))
+            assert ev.state == "aborted"
+        assert len(c.events) == 3               # never raised
+
+    def test_shed_event_counts(self):
+        fleet = _Fleet(2)
+        c = AutoscaleController(
+            _cfg(dwell_steps=1, max_replicas=2), actuator=fleet)
+        d, ev = c.step(_pressure(0, fleet=2))
+        assert (d.verdict, ev.state) == ("shed", "committed")
+        assert fleet.sheds == [4] and c.shed_total == 2
+
+
+class TestBorrowLedger:
+    def test_borrow_handback_and_close_guarantee(self):
+        lent, returned = [], []
+        led = BorrowLedger(lambda n: lent.append(n) or n,
+                           lambda n: returned.append(n), capacity=3)
+        assert led.borrow(2) == 2
+        assert led.borrow(5) == 1               # capped at capacity
+        assert led.outstanding == 3 and led.borrowable() == 0
+        assert led.handback(1) == 1
+        assert led.close() == 2                 # everything back
+        assert led.outstanding == 0 and sum(returned) == sum(lent)
+
+    def test_borrow_fault_leaves_ledger_clean(self):
+        def boom(n):
+            raise RuntimeError("reshard peer died")
+        led = BorrowLedger(boom, lambda n: None, capacity=2)
+        c = AutoscaleController(
+            _cfg(dwell_steps=1, max_replicas=1), ledger=led)
+        d, ev = c.step(_pressure(0, fleet=1, borrowable=2))
+        assert (d.verdict, ev.state) == ("borrow", "aborted")
+        assert led.outstanding == 0
+
+    def test_close_hands_back_on_drain(self):
+        led = BorrowLedger(lambda n: n, lambda n: None, capacity=2)
+        c = AutoscaleController(_cfg(), ledger=led)
+        led.borrow(2)
+        c.close()
+        assert led.outstanding == 0
+
+
+class TestBorrowStashRestore:
+    """The real borrow edges: training rows roundtrip through the
+    reshard plane (stash -> restore at any world size), and a peer
+    dying mid-stash aborts with nothing recorded."""
+
+    GROUPS = (10, 6)
+
+    def _rows(self, n_old, rank):
+        g0 = np.arange(10, dtype=np.float32) + 1
+        g1 = np.arange(6, dtype=np.float32) * 0.5 - 1
+        out = []
+        for full in (g0, g1):
+            s = -(-full.size // n_old)
+            pad = np.zeros(s * n_old, full.dtype)
+            pad[:full.size] = full
+            out.append(pad.reshape(n_old, s))
+        return out
+
+    def test_roundtrip_any_world_size(self):
+        from horovod_tpu.serve.handoff import (
+            restore_train_state,
+            stash_train_state,
+        )
+        t = _rs.LocalTransport()
+        for rank in range(2):
+            stash_train_state(self._rows(2, rank), self.GROUPS, 2,
+                              rank, t)
+        # Hand-back at a DIFFERENT world size (n_new=1): one rank
+        # fetches everything.
+        rows = restore_train_state(self.GROUPS, ("float32", "float32"),
+                                   1, 0, t)
+        np.testing.assert_array_equal(
+            rows[0].reshape(-1)[:10],
+            np.arange(10, dtype=np.float32) + 1)
+        np.testing.assert_array_equal(
+            rows[1].reshape(-1)[:6],
+            np.arange(6, dtype=np.float32) * 0.5 - 1)
+
+    def test_peer_die_mid_stash_aborts_borrow(self):
+        from horovod_tpu.serve.handoff import stash_train_state
+        t = _rs.LocalTransport()
+        _faults.install("reshard.peer_die@1:err")
+        try:
+            def borrow_fn(n):
+                stash_train_state(self._rows(2, 0), self.GROUPS, 2, 0,
+                                  t)
+                return n
+            led = BorrowLedger(borrow_fn, lambda n: None, capacity=1)
+            c = AutoscaleController(
+                _cfg(dwell_steps=1, max_replicas=1), ledger=led)
+            d, ev = c.step(_pressure(0, fleet=1, borrowable=1))
+            assert ev.state == "aborted"
+            assert led.outstanding == 0         # nothing recorded
+        finally:
+            _faults.clear()
+
+
+class TestTenantShed:
+    def _sched(self):
+        sched = ContinuousScheduler(max_batch=2)
+        for i, (cls, arr) in enumerate([("premium", 0), ("batch", 0),
+                                        ("standard", 1), ("batch", 2),
+                                        ("standard", 3)]):
+            sched.submit(Request(req_id=i, prompt=np.ones(4, np.int32),
+                                 max_new_tokens=2, arrival_step=arr,
+                                 slo_class=cls), step=arr)
+        return sched
+
+    def test_shed_order_lowest_class_newest_first(self):
+        sched = self._sched()
+        shed = sched.shed(10, 4)
+        # batch (newest first: req 3 then 1), then standard (4 then 2);
+        # premium (req 0) survives.
+        assert [r.req_id for r in shed] == [3, 1, 4, 2]
+        assert [r.req_id for r in sched.queue] == [0]
+        assert [e for e in sched.decision_log if e[1] == "shed"] == [
+            (10, "shed", 3, -1), (10, "shed", 1, -1),
+            (10, "shed", 4, -1), (10, "shed", 2, -1)]
+
+    def test_shed_never_touches_active(self):
+        sched = self._sched()
+        sched.admit(5, lambda req: True)        # fills both rows
+        n_active = len(sched.active)
+        shed = sched.shed(5, 99)
+        assert len(shed) == len(sched.queue) + len(shed) - \
+            sched.queue_depth()                 # queued only
+        assert len(sched.active) == n_active
+
+    def test_unknown_class_sheds_first(self):
+        sched = ContinuousScheduler(max_batch=1)
+        for i, cls in enumerate(["standard", "mystery"]):
+            sched.submit(Request(req_id=i, prompt=np.ones(2, np.int32),
+                                 max_new_tokens=1, slo_class=cls),
+                         step=0)
+        shed = sched.shed(1, 1)
+        assert [r.req_id for r in shed] == [1]
+
+
+class TestSnapshotFromServer:
+    def test_live_server_signals(self):
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu.models import (
+            TransformerConfig,
+            transformer_init,
+        )
+        from horovod_tpu.serve import InferenceServer
+        from horovod_tpu.serve.autoscale import snapshot_from_server
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                d_head=8, d_ff=64, n_layers=2,
+                                compute_dtype=jnp.float32)
+        params = transformer_init(jax.random.PRNGKey(0), cfg)
+        srv = InferenceServer(params, cfg, max_seq_tokens=24,
+                              max_batch=2, page_tokens=4)
+        for _ in range(3):
+            srv.submit(np.ones(4, np.int32), 2)
+        s = snapshot_from_server(srv, step=5, fleet_size=2)
+        assert (s.step, s.fleet_size) == (5, 2)
+        assert s.queue_depth == 3                # nothing admitted yet
+        assert s.pool_free_frac == 1.0
+        assert s.occupancy == 0.0
+        srv.step()
+        s = snapshot_from_server(srv)
+        assert s.occupancy > 0 and s.pool_free_frac < 1.0
+        assert 0.0 <= s.pool_free_frac <= 1.0
+        list(srv.run())
+        s = snapshot_from_server(srv)
+        assert s.queue_depth == 0 and s.pool_free_frac == 1.0
+
+
+class TestShapedTraces:
+    def test_shapes_deterministic_and_tagged(self):
+        for shape in SHAPES:
+            t1 = make_shaped_trace(shape, 3, 50, 64)
+            t2 = make_shaped_trace(shape, 3, 50, 64)
+            assert len(t1) == 50
+            assert all(a[0] == b[0] and a[2] == b[2] and a[3] == b[3]
+                       and np.array_equal(a[1], b[1])
+                       for a, b in zip(t1, t2))
+            arrivals = [it[0] for it in t1]
+            assert arrivals == sorted(arrivals)
+            assert all(it[3] in ("premium", "standard", "batch")
+                       for it in t1)
+
+    def test_burst_has_clumps(self):
+        t = make_shaped_trace("burst", 0, 120, 64, base_every=4.0,
+                              burst_every=32, burst_size=16)
+        from collections import Counter
+        peak = max(Counter(it[0] for it in t).values())
+        assert peak >= 8                        # a real clump
+
+    def test_multi_tenant_has_all_classes(self):
+        t = make_shaped_trace("multi_tenant", 1, 60, 64)
+        classes = {it[3] for it in t}
+        assert classes == {"premium", "standard", "batch"}
+
+    def test_unknown_shape_rejected(self):
+        with pytest.raises(InvalidRequestError):
+            make_shaped_trace("sawtooth", 0, 10, 64)
+
+
+class TestSimBench:
+    """The A/B the bench records: under the bursty trace the
+    autoscaled fleet must beat a static fleet of the same mean size on
+    SLO-violation-minutes (the acceptance anchor)."""
+
+    def test_autoscaled_beats_static_on_burst(self):
+        cfg = _cfg(max_replicas=8, cooldown_steps=4, dwell_steps=2,
+                   grow_step=2)
+        trace = make_shaped_trace("burst", 7, 500, 64, base_every=4.0,
+                                  burst_every=128, burst_size=80)
+        auto = simulate_autoscale(trace, cfg)
+        static = simulate_autoscale(
+            trace, cfg, static_size=max(1, round(auto["fleet_mean"])))
+        assert auto["completed"] == 500
+        assert auto["slo_violation_minutes"] < \
+            static["slo_violation_minutes"]
+        # Same mean size is the point of the comparison.
+        assert abs(auto["fleet_mean"] - static["fleet_mean"]) < 0.5
+
+    def test_sim_sheds_by_class_at_max(self):
+        cfg = _cfg(max_replicas=1, cooldown_steps=2, dwell_steps=2)
+        trace = make_shaped_trace("burst", 3, 200, 64, base_every=2.0,
+                                  burst_every=32, burst_size=40)
+        rec = simulate_autoscale(trace, cfg, max_batch=2,
+                                 extra_steps=4096)
+        assert rec["shed"] > 0
+        # batch sheds first within every shed event, so it can never
+        # shed less than premium (which only goes when nothing else
+        # is queued).
+        assert rec["shed_by_class"].get("batch", 0) > 0
+        assert rec["shed_by_class"].get("batch", 0) >= \
+            rec["shed_by_class"].get("premium", 0)
+
+
+@pytest.mark.slow
+class TestAutoscaleScaleChaosE2E:
+    """Bursty trace drives the REAL control loop over a REAL
+    two-replica fleet: grow fires, serve.replica_die kills the JOINING
+    replica mid-grow, and the fleet must converge with digest
+    agreement and token-identical results (no stop-the-world restore
+    anywhere)."""
+
+    CONFIG = {
+        "cfg": dict(vocab_size=64, d_model=32, n_heads=4, d_head=8,
+                    d_ff=64, n_layers=2, compute_dtype="float32"),
+        "seed": 0,
+        "serve": dict(max_seq_tokens=24, max_batch=2, page_tokens=4),
+    }
+
+    def _trace(self):
+        return make_shaped_trace("burst", 2, 8, 64, prompt_lens=(4,),
+                                 max_new_lo=2, max_new_hi=5,
+                                 base_every=1.0, burst_every=4,
+                                 burst_size=4)
+
+    def _baseline(self):
+        from horovod_tpu.serve.replica import ReplicaManager
+        with ReplicaManager(1, self.CONFIG, lease_ttl=10.0,
+                            respawn_backoff=0.2,
+                            child_env={"JAX_PLATFORMS": "cpu"}) as mgr:
+            for it in self._trace():
+                mgr.submit(it[1].tolist(), it[2], slo_class=it[3])
+            return mgr.wait_all(timeout=180)
+
+    def test_grow_under_fire_converges_digest_verified(self):
+        from horovod_tpu.serve.autoscale import (
+            ReplicaFleetActuator,
+            snapshot_from_manager,
+        )
+        from horovod_tpu.serve.replica import ReplicaManager
+        baseline = self._baseline()
+        with ReplicaManager(1, self.CONFIG, lease_ttl=10.0,
+                            respawn_backoff=0.2,
+                            child_env={"JAX_PLATFORMS": "cpu"}) as mgr:
+            ctrl = AutoscaleController(
+                _cfg(dwell_steps=2, cooldown_steps=2, max_replicas=2),
+                actuator=ReplicaFleetActuator(mgr))
+            for it in self._trace():
+                mgr.submit(it[1].tolist(), it[2], slo_class=it[3])
+            # The burst is outstanding: pressure builds, grow fires —
+            # with the fault armed so the JOINER dies mid-scale-event.
+            mgr.child_env.update({
+                "HOROVOD_FAULT_SPEC": "serve.replica_die@3:exit:1",
+                "HOROVOD_FAULT_HOSTS": "replica1",
+            })
+            grew = None
+            for step in range(64):
+                d, ev = ctrl.step(snapshot_from_manager(mgr, step,
+                                                        max_batch=2))
+                if ev is not None and d.verdict == "grow":
+                    grew = ev
+                    break
+            assert grew is not None, \
+                [d.verdict for d in ctrl.decisions]
+            results = mgr.wait_all(timeout=180)
+            mgr.child_env.pop("HOROVOD_FAULT_SPEC")
+            mgr.child_env.pop("HOROVOD_FAULT_HOSTS")
+            assert mgr._respawns >= 1           # the joiner died
+            assert mgr.fleet_size() == 2        # ...and converged
+            assert mgr.digest_agreement(timeout=60.0)  # no split brain
+            assert results == baseline          # token-identical
+
+    def test_run_scale_chaos_all_recover(self):
+        from horovod_tpu.serve.autoscale import run_scale_chaos
+        rec = run_scale_chaos(n_events=2, seed=0)
+        assert rec["all_recovered"], rec
+        assert any(e["faulted"] for e in rec["events"])
+        assert rec["respawns"] >= 1
